@@ -1,0 +1,13 @@
+"""igneous_tpu: a TPU-native framework for Neuroglancer Precomputed pipelines.
+
+Capabilities mirror seung-lab/igneous (downsampling, transfer, meshing,
+skeletonization, CCL, contrast, voxel stats, queue/CLI tooling) with the
+per-chunk compute implemented as JAX/XLA/Pallas device programs batched over
+a TPU mesh, and the queue/object-store fabric as first-party host code.
+"""
+
+from .lib import Bbox, Vec
+from .volume import Volume, CloudVolume
+from .storage import CloudFiles
+
+__version__ = "0.1.0"

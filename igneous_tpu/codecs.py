@@ -1,0 +1,47 @@
+"""Chunk encodings for Precomputed volumes.
+
+Byte-format parity targets (so Neuroglancer / the reference stack can read
+outputs): ``raw`` and ``compressed_segmentation``. The reference gets these
+from cloud-volume (see /root/reference/igneous/task_creation/common.py:215-236
+for the encodings it routes).
+
+Layout convention: in-memory chunks are numpy arrays with shape (x, y, z, c).
+``raw`` stores them Fortran-ordered, i.e. x varies fastest in the byte stream
+and channel slowest — exactly the Precomputed "raw" spec.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .cseg import compress as cseg_compress, decompress as cseg_decompress
+
+
+def encode_raw(img: np.ndarray) -> bytes:
+  return img.tobytes("F")
+
+
+def decode_raw(data: bytes, shape, dtype) -> np.ndarray:
+  arr = np.frombuffer(bytearray(data), dtype=dtype)
+  return arr.reshape(shape, order="F")
+
+
+def encode(img: np.ndarray, encoding: str, block_size=(8, 8, 8)) -> bytes:
+  if img.ndim == 3:
+    img = img[..., np.newaxis]
+  if encoding == "raw":
+    return encode_raw(img)
+  if encoding == "compressed_segmentation":
+    return cseg_compress(img, block_size=block_size)
+  raise NotImplementedError(f"Encoding not supported: {encoding}")
+
+
+def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8)) -> np.ndarray:
+  shape = tuple(int(v) for v in shape)
+  if len(shape) == 3:
+    shape = shape + (1,)
+  if encoding == "raw":
+    return decode_raw(data, shape, dtype)
+  if encoding == "compressed_segmentation":
+    return cseg_decompress(data, shape, dtype, block_size=block_size)
+  raise NotImplementedError(f"Encoding not supported: {encoding}")

@@ -1,0 +1,22 @@
+"""Sharded-format image reads/writes (Neuroglancer sharded Precomputed).
+
+Reference behavior: cloud-volume's sharded image support, consumed by
+ImageShardTransferTask / ImageShardDownsampleTask
+(/root/reference/igneous/tasks/image/image.py:596-847).
+
+Implemented in concert with ``igneous_tpu.sharding`` (shard codec + hash
+math). ``download_sharded`` is the Volume.download hook for scales whose
+info carries a "sharding" key.
+"""
+
+from __future__ import annotations
+
+from .lib import Bbox
+
+
+def download_sharded(vol, bbox: Bbox, mip: int):
+  """Returns [(chunk_bbox, chunk_array), ...] covering ``bbox``."""
+  raise NotImplementedError(
+    "Reading sharded scales is not implemented yet; "
+    "unshard with a TransferTask or read the unsharded scale."
+  )

@@ -1,0 +1,373 @@
+"""Object-storage abstraction: the data plane every task reads/writes through.
+
+Equivalent in capability to the reference's CloudFiles layer
+(/root/reference uses cloud-files for gs/s3/file/mem IO, e.g.
+igneous/tasks/image/image.py:17): get/put/list/delete/exists with transparent
+gzip/zstd compression, addressed by protocol URL.
+
+Protocols implemented here:
+  - ``file://`` — local filesystem (the test + single-host path).
+  - ``mem://``  — process-local in-memory store (unit tests, scratch).
+
+Cloud protocols (gs://, s3://) are accepted at the URL layer and routed to a
+single pluggable hook (`register_protocol`) so a deployment can attach
+google-cloud-storage / boto clients without touching task code. They are not
+implemented in-tree because this environment has zero egress.
+
+Compression follows the CloudFiles file-layout convention: a file compressed
+with gzip is stored under ``<key>.gz`` and listed/read under ``<key>``.
+"""
+
+from __future__ import annotations
+
+import gzip as gzip_mod
+import json
+import os
+import shutil
+import threading
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
+
+import zstandard
+
+from .lib import jsonify
+
+# brotli is deliberately absent: no brotli codec ships in this environment,
+# so .br files are left visible under their literal names rather than
+# advertised as readable and then crashing on get().
+COMPRESSION_EXTS = {
+  "gzip": ".gz",
+  "zstd": ".zstd",
+  None: "",
+  False: "",
+  "": "",
+}
+_EXT_TO_COMPRESSION = {".gz": "gzip", ".zstd": "zstd"}
+
+
+def compress_bytes(data: bytes, method) -> bytes:
+  if method in (None, False, ""):
+    return data
+  if method == "gzip":
+    return gzip_mod.compress(data, compresslevel=6)
+  if method == "zstd":
+    return zstandard.ZstdCompressor().compress(data)
+  raise ValueError(f"Unsupported compression: {method}")
+
+
+def decompress_bytes(data: bytes, method) -> bytes:
+  if method in (None, False, ""):
+    return data
+  if method == "gzip":
+    return gzip_mod.decompress(data)
+  if method == "zstd":
+    return zstandard.ZstdDecompressor().decompress(data)
+  raise ValueError(f"Unsupported compression: {method}")
+
+
+class ExtractedPath:
+  __slots__ = ("protocol", "path")
+
+  def __init__(self, protocol: str, path: str):
+    self.protocol = protocol
+    self.path = path
+
+  def __repr__(self):
+    return f"{self.protocol}://{self.path}"
+
+
+def extract_path(cloudpath: str) -> ExtractedPath:
+  if "://" in cloudpath:
+    protocol, path = cloudpath.split("://", 1)
+  else:
+    protocol, path = "file", cloudpath
+  if protocol == "precomputed":  # allow "precomputed://file://..." prefixes
+    return extract_path(path)
+  if protocol == "file":
+    path = os.path.abspath(os.path.expanduser(path))
+  return ExtractedPath(protocol, path.rstrip("/"))
+
+
+def to_https_path(cloudpath: str) -> str:
+  p = extract_path(cloudpath)
+  return f"{p.protocol}://{p.path}"
+
+
+normalize_path = to_https_path
+
+
+# ---------------------------------------------------------------------------
+# in-memory store
+
+
+class _MemBucket:
+  def __init__(self):
+    self.files: Dict[str, bytes] = {}
+    self.lock = threading.RLock()
+
+
+_MEM_BUCKETS: Dict[str, _MemBucket] = {}
+_MEM_LOCK = threading.Lock()
+
+
+def _mem_bucket(root: str) -> _MemBucket:
+  with _MEM_LOCK:
+    if root not in _MEM_BUCKETS:
+      _MEM_BUCKETS[root] = _MemBucket()
+    return _MEM_BUCKETS[root]
+
+
+def clear_memory_storage():
+  with _MEM_LOCK:
+    _MEM_BUCKETS.clear()
+
+
+# ---------------------------------------------------------------------------
+
+_PROTOCOL_HOOKS = {}
+
+
+def register_protocol(name: str, factory):
+  """Attach a storage backend factory: factory(path) -> backend object
+
+  The backend must implement the _FileBackend interface below. This is the
+  extension point for gs:// and s3:// in real deployments.
+  """
+  _PROTOCOL_HOOKS[name] = factory
+
+
+class _FileBackend:
+  """file:// backend."""
+
+  def __init__(self, root: str):
+    self.root = root
+
+  def _fullpath(self, key: str) -> str:
+    return os.path.join(self.root, key)
+
+  def put(self, key: str, data: bytes):
+    path = self._fullpath(key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as f:
+      f.write(data)
+    os.replace(tmp, path)  # atomic within a filesystem
+
+  def get(self, key: str) -> Optional[bytes]:
+    try:
+      with open(self._fullpath(key), "rb") as f:
+        return f.read()
+    except FileNotFoundError:
+      return None
+
+  def exists(self, key: str) -> bool:
+    return os.path.exists(self._fullpath(key))
+
+  def delete(self, key: str):
+    try:
+      os.remove(self._fullpath(key))
+    except FileNotFoundError:
+      pass
+
+  def list(self, prefix: str = "") -> Iterator[str]:
+    # prefix is a path prefix, not necessarily a directory
+    directory = os.path.dirname(prefix)
+    scandir = os.path.join(self.root, directory) if directory else self.root
+    if not os.path.isdir(scandir):
+      return
+    for dirpath, _dirnames, filenames in os.walk(scandir):
+      rel = os.path.relpath(dirpath, self.root)
+      rel = "" if rel == "." else rel + "/"
+      for fname in sorted(filenames):
+        key = rel + fname
+        if key.startswith(prefix):
+          yield key
+
+  def size(self, key: str) -> Optional[int]:
+    try:
+      return os.path.getsize(self._fullpath(key))
+    except FileNotFoundError:
+      return None
+
+
+class _MemBackend:
+  """mem:// backend."""
+
+  def __init__(self, root: str):
+    self.bucket = _mem_bucket(root)
+
+  def put(self, key: str, data: bytes):
+    with self.bucket.lock:
+      self.bucket.files[key] = bytes(data)
+
+  def get(self, key: str) -> Optional[bytes]:
+    with self.bucket.lock:
+      return self.bucket.files.get(key)
+
+  def exists(self, key: str) -> bool:
+    with self.bucket.lock:
+      return key in self.bucket.files
+
+  def delete(self, key: str):
+    with self.bucket.lock:
+      self.bucket.files.pop(key, None)
+
+  def list(self, prefix: str = "") -> Iterator[str]:
+    with self.bucket.lock:
+      keys = sorted(self.bucket.files.keys())
+    for k in keys:
+      if k.startswith(prefix):
+        yield k
+
+  def size(self, key: str) -> Optional[int]:
+    with self.bucket.lock:
+      data = self.bucket.files.get(key)
+    return None if data is None else len(data)
+
+
+def _make_backend(pth: ExtractedPath):
+  if pth.protocol == "file":
+    return _FileBackend(pth.path)
+  if pth.protocol == "mem":
+    return _MemBackend(pth.path)
+  if pth.protocol in _PROTOCOL_HOOKS:
+    return _PROTOCOL_HOOKS[pth.protocol](pth.path)
+  raise ValueError(
+    f"Protocol {pth.protocol}:// not available in this environment. "
+    f"Use register_protocol() to attach a backend."
+  )
+
+
+class CloudFiles:
+  """get/put/list/delete against a storage root, with compression handling."""
+
+  def __init__(self, cloudpath: str):
+    self.cloudpath = cloudpath.rstrip("/")
+    self.pth = extract_path(cloudpath)
+    self.backend = _make_backend(self.pth)
+
+  # -- write ---------------------------------------------------------------
+
+  def put(
+    self,
+    key: str,
+    content: bytes,
+    compress=None,
+    cache_control: Optional[str] = None,
+    content_type: Optional[str] = None,
+  ):
+    del cache_control, content_type  # metadata: meaningful only on cloud backends
+    if isinstance(content, str):
+      content = content.encode("utf8")
+    ext = COMPRESSION_EXTS[compress]
+    self.backend.put(key + ext, compress_bytes(bytes(content), compress))
+
+  def puts(self, files: Iterable, compress=None, **kw):
+    total = 0
+    for f in files:
+      if isinstance(f, dict):
+        self.put(
+          f["path"],
+          f["content"],
+          compress=f.get("compress", compress),
+        )
+      else:
+        key, content = f
+        self.put(key, content, compress=compress)
+      total += 1
+    return total
+
+  def put_json(self, key: str, obj, compress=None):
+    self.put(
+      key,
+      json.dumps(jsonify(obj)).encode("utf8"),
+      compress=compress,
+    )
+
+  # -- read ----------------------------------------------------------------
+
+  def _resolve(self, key: str) -> Tuple[Optional[bytes], Optional[str]]:
+    data = self.backend.get(key)
+    if data is not None:
+      return data, None
+    for ext, method in _EXT_TO_COMPRESSION.items():
+      data = self.backend.get(key + ext)
+      if data is not None:
+        return data, method
+    return None, None
+
+  def get(self, key: Union[str, Iterable[str]], raw: bool = False):
+    if not isinstance(key, str):
+      return [
+        {"path": k, "content": self.get(k, raw=raw), "error": None}
+        for k in key
+      ]
+    data, method = self._resolve(key)
+    if data is None:
+      return None
+    return data if raw else decompress_bytes(data, method)
+
+  def get_json(self, key: str):
+    data = self.get(key)
+    if data is None:
+      return None
+    return json.loads(data.decode("utf8"))
+
+  def exists(self, key: Union[str, Iterable[str]]):
+    if not isinstance(key, str):
+      return {k: self.exists(k) for k in key}
+    if self.backend.exists(key):
+      return True
+    return any(self.backend.exists(key + ext) for ext in _EXT_TO_COMPRESSION)
+
+  def size(self, key: str) -> Optional[int]:
+    sz = self.backend.size(key)
+    if sz is not None:
+      return sz
+    for ext in _EXT_TO_COMPRESSION:
+      sz = self.backend.size(key + ext)
+      if sz is not None:
+        return sz
+    return None
+
+  # -- listing / deletion --------------------------------------------------
+
+  def list(self, prefix: str = "", flat: bool = False) -> Iterator[str]:
+    seen = set()
+    for key in self.backend.list(prefix):
+      ext = os.path.splitext(key)[1]
+      if ext in _EXT_TO_COMPRESSION:
+        key = key[: -len(ext)]
+      if flat and "/" in key[len(prefix):]:
+        continue
+      if key not in seen:
+        seen.add(key)
+        yield key
+
+  def delete(self, key: Union[str, Iterable[str]]):
+    keys = [key] if isinstance(key, str) else list(key)
+    for k in keys:
+      self.backend.delete(k)
+      for ext in _EXT_TO_COMPRESSION:
+        self.backend.delete(k + ext)
+
+  def delete_prefix(self, prefix: str = ""):
+    for key in list(self.backend.list(prefix)):
+      self.backend.delete(key)
+
+  def transfer_to(self, dest_cloudpath: str, paths: Optional[Iterable[str]] = None):
+    dest = CloudFiles(dest_cloudpath)
+    if paths is None:
+      paths = self.list()
+    for key in paths:
+      data, method = self._resolve(key)
+      if data is None:
+        continue
+      dest.put(key + COMPRESSION_EXTS[method], data)
+
+  def join(self, *parts: str) -> str:
+    return "/".join(p.strip("/") for p in parts)
+
+  def isdir(self) -> bool:
+    if self.pth.protocol == "file":
+      return os.path.isdir(self.pth.path)
+    return any(True for _ in self.list())

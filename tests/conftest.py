@@ -1,0 +1,18 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+# exercised without TPU hardware. bench.py (run separately) uses the real chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+  os.environ["XLA_FLAGS"] = (
+    xla_flags + " --xla_force_host_platform_device_count=8"
+  ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+  return np.random.default_rng(seed=42)

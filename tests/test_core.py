@@ -1,0 +1,350 @@
+"""Core substrate tests: geometry, storage, codecs, volume IO."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from igneous_tpu.lib import Bbox, Vec, chunk_bboxes, ceil_div, sip, xyzrange
+from igneous_tpu.storage import CloudFiles, clear_memory_storage
+from igneous_tpu import cseg
+from igneous_tpu.volume import (
+  AlignmentError,
+  EmptyVolumeError,
+  OutOfBoundsError,
+  Volume,
+)
+
+
+# ---------------------------------------------------------------------------
+# geometry
+
+
+def test_vec_basic():
+  v = Vec(1, 2, 3)
+  assert (v.x, v.y, v.z) == (1, 2, 3)
+  assert (v + 1).tolist() == [2, 3, 4]
+  assert Vec.clamp(Vec(5, -1, 2), (0, 0, 0), (3, 3, 3)).tolist() == [3, 0, 2]
+
+
+def test_bbox_round_trip_filename():
+  b = Bbox((0, 64, 128), (64, 128, 192))
+  assert b.to_filename() == "0-64_64-128_128-192"
+  assert Bbox.from_filename("prefix/0-64_64-128_128-192.gz") == b
+
+
+def test_bbox_ops():
+  a = Bbox((0, 0, 0), (10, 10, 10))
+  b = Bbox((5, 5, 5), (15, 15, 15))
+  assert Bbox.intersection(a, b) == Bbox((5, 5, 5), (10, 10, 10))
+  assert Bbox.expand(a, b) == Bbox((0, 0, 0), (15, 15, 15))
+  assert a.volume() == 1000
+  assert a.contains((9, 9, 9)) and not a.contains((10, 9, 9))
+  assert (a / 2) == Bbox((0, 0, 0), (5, 5, 5))
+  assert (Bbox((1, 1, 1), (9, 9, 9)) / 2) == Bbox((0, 0, 0), (5, 5, 5))
+
+
+def test_bbox_chunk_alignment_with_offset():
+  b = Bbox((70, 70, 70), (130, 130, 130))
+  e = b.expand_to_chunk_size((64, 64, 64), offset=(6, 6, 6))
+  assert e == Bbox((70, 70, 70), (134, 134, 134))
+  s = b.shrink_to_chunk_size((64, 64, 64), offset=(6, 6, 6))
+  assert s == Bbox((70, 70, 70), (70, 70, 70))
+
+
+def test_chunk_bboxes_clamped():
+  bounds = Bbox((0, 0, 0), (100, 100, 50))
+  chunks = list(chunk_bboxes(bounds, (64, 64, 64)))
+  assert len(chunks) == 4
+  assert chunks[0] == Bbox((0, 0, 0), (64, 64, 50))
+  assert chunks[-1] == Bbox((64, 64, 0), (100, 100, 50))
+  total = sum(c.volume() for c in chunks)
+  assert total == bounds.volume()
+
+
+def test_xyzrange_order_x_fastest():
+  pts = list(xyzrange((2, 2, 2)))
+  assert pts[0].tolist() == [0, 0, 0]
+  assert pts[1].tolist() == [1, 0, 0]
+  assert pts[2].tolist() == [0, 1, 0]
+  assert len(pts) == 8
+
+
+def test_sip_and_ceil_div():
+  assert list(sip(range(5), 2)) == [[0, 1], [2, 3], [4]]
+  assert ceil_div(10, 3) == 4
+  assert ceil_div([10, 9], [3, 3]).tolist() == [4, 3]
+
+
+# ---------------------------------------------------------------------------
+# storage
+
+
+@pytest.mark.parametrize("proto", ["file", "mem"])
+def test_storage_roundtrip(tmp_path, proto):
+  clear_memory_storage()
+  root = f"file://{tmp_path}/store" if proto == "file" else "mem://test/store"
+  cf = CloudFiles(root)
+  cf.put("a/b.bin", b"hello", compress="gzip")
+  cf.put("a/c.bin", b"world")
+  cf.put_json("info", {"x": 1})
+
+  assert cf.get("a/b.bin") == b"hello"
+  assert cf.get("a/c.bin") == b"world"
+  assert cf.get_json("info") == {"x": 1}
+  assert cf.get("missing") is None
+  assert cf.exists("a/b.bin")
+  assert sorted(cf.list()) == ["a/b.bin", "a/c.bin", "info"]
+  assert sorted(cf.list("a/")) == ["a/b.bin", "a/c.bin"]
+
+  cf.delete("a/b.bin")
+  assert not cf.exists("a/b.bin")
+
+
+def test_storage_gzip_bytes_on_disk(tmp_path):
+  cf = CloudFiles(f"file://{tmp_path}/x")
+  cf.put("k", b"data" * 100, compress="gzip")
+  raw = open(f"{tmp_path}/x/k.gz", "rb").read()
+  assert gzip.decompress(raw) == b"data" * 100
+
+
+def test_storage_transfer(tmp_path):
+  src = CloudFiles(f"file://{tmp_path}/src")
+  src.put("x/1", b"one", compress="gzip")
+  src.put("x/2", b"two")
+  src.transfer_to(f"file://{tmp_path}/dst")
+  dst = CloudFiles(f"file://{tmp_path}/dst")
+  assert dst.get("x/1") == b"one"
+  assert dst.get("x/2") == b"two"
+
+
+# ---------------------------------------------------------------------------
+# compressed_segmentation codec
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.uint64])
+def test_cseg_roundtrip_random(rng, dtype):
+  labels = rng.integers(0, 50, size=(32, 32, 17, 1)).astype(dtype)
+  comp = cseg.compress(labels)
+  out = cseg.decompress(comp, labels.shape, dtype)
+  assert np.array_equal(out, labels)
+
+
+def test_cseg_roundtrip_uniform():
+  labels = np.full((16, 16, 16, 1), 7, dtype=np.uint64)
+  comp = cseg.compress(labels)
+  out = cseg.decompress(comp, labels.shape, np.uint64)
+  assert np.array_equal(out, labels)
+  # uniform data should compress massively (shared tables, 0-bit blocks)
+  assert len(comp) < labels.nbytes // 20
+
+
+def test_cseg_large_values():
+  labels = np.array(
+    [[[2**40 + 5, 2**63 - 1], [0, 2**40 + 5]]], dtype=np.uint64
+  ).reshape((1, 2, 2, 1))
+  comp = cseg.compress(labels, block_size=(8, 8, 8))
+  out = cseg.decompress(comp, labels.shape, np.uint64)
+  assert np.array_equal(out, labels)
+
+
+def test_cseg_multichannel(rng):
+  labels = rng.integers(0, 9, size=(9, 10, 11, 3)).astype(np.uint32)
+  comp = cseg.compress(labels)
+  out = cseg.decompress(comp, labels.shape, np.uint32)
+  assert np.array_equal(out, labels)
+
+
+# ---------------------------------------------------------------------------
+# volume IO
+
+
+def make_vol(tmp_path, shape=(128, 128, 64), dtype=np.uint8, offset=(0, 0, 0),
+             encoding="raw", chunk_size=(64, 64, 64), rng=None):
+  rng = rng or np.random.default_rng(0)
+  if np.dtype(dtype).kind == "u" and np.dtype(dtype).itemsize >= 4:
+    data = rng.integers(0, 1000, size=shape).astype(dtype)
+    layer_type = "segmentation"
+  else:
+    data = rng.integers(0, 255, size=shape).astype(dtype)
+    layer_type = "image"
+  vol = Volume.from_numpy(
+    data,
+    f"file://{tmp_path}/vol",
+    resolution=(4, 4, 40),
+    voxel_offset=offset,
+    chunk_size=chunk_size,
+    layer_type=layer_type,
+    encoding=encoding,
+  )
+  return vol, data
+
+
+def test_volume_write_read_roundtrip(tmp_path, rng):
+  vol, data = make_vol(tmp_path, rng=rng)
+  out = vol[vol.bounds]
+  assert np.array_equal(out[..., 0], data)
+
+
+def test_volume_partial_read(tmp_path, rng):
+  vol, data = make_vol(tmp_path, rng=rng)
+  cutout = vol.download(Bbox((10, 20, 30), (50, 60, 40)))
+  assert np.array_equal(cutout[..., 0], data[10:50, 20:60, 30:40])
+
+
+def test_volume_voxel_offset(tmp_path, rng):
+  vol, data = make_vol(tmp_path, offset=(100, 200, 300), rng=rng)
+  bounds = vol.bounds
+  assert bounds.minpt.tolist() == [100, 200, 300]
+  cutout = vol.download(Bbox((110, 210, 310), (120, 220, 320)))
+  assert np.array_equal(cutout[..., 0], data[10:20, 10:20, 10:20])
+
+
+def test_volume_cseg_encoding(tmp_path, rng):
+  vol, data = make_vol(
+    tmp_path, dtype=np.uint64, encoding="compressed_segmentation",
+    shape=(80, 64, 50), rng=rng,
+  )
+  out = vol[vol.bounds]
+  assert np.array_equal(out[..., 0], data)
+
+
+def test_volume_fill_missing(tmp_path, rng):
+  vol, data = make_vol(tmp_path, rng=rng)
+  vol.cf.delete(vol.meta.chunk_name(0, Bbox((0, 0, 0), (64, 64, 64))))
+  with pytest.raises(EmptyVolumeError):
+    vol.download(vol.bounds)
+  vol.fill_missing = True
+  out = vol.download(vol.bounds)
+  assert np.all(out[:64, :64, :64] == 0)
+  assert np.array_equal(out[64:, :, :, 0], data[64:])
+
+
+def test_volume_bounds_checking(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, rng=rng)
+  with pytest.raises(OutOfBoundsError):
+    vol.download(Bbox((0, 0, 0), (256, 256, 256)))
+  vol.bounded = False
+  out = vol.download(Bbox((-10, 0, 0), (10, 10, 10)))
+  assert out.shape == (20, 10, 10, 1)
+  assert np.all(out[:10] == 0)
+
+
+def test_volume_unaligned_write_rejected(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, rng=rng)
+  with pytest.raises(AlignmentError):
+    vol[Bbox((1, 0, 0), (65, 64, 64))] = np.zeros((64, 64, 64), dtype=np.uint8)
+
+
+def test_volume_edge_write_allowed(tmp_path, rng):
+  # writes clipped at the volume boundary are legal even though unaligned
+  vol, data = make_vol(tmp_path, shape=(100, 100, 50), rng=rng)
+  patch = np.ones((36, 100, 50), dtype=np.uint8)
+  vol[Bbox((64, 0, 0), (100, 100, 50))] = patch
+  out = vol[vol.bounds]
+  assert np.all(out[64:, :, :, 0] == 1)
+  assert np.array_equal(out[:64, :, :, 0], data[:64])
+
+
+def test_volume_renumber_download(tmp_path):
+  data = np.zeros((64, 64, 64), dtype=np.uint64)
+  data[:10] = 10**12
+  data[10:20] = 5
+  vol = Volume.from_numpy(
+    data, f"file://{tmp_path}/seg", layer_type="segmentation"
+  )
+  out, mapping = vol.download(vol.bounds, renumber=True)
+  assert out.dtype == np.uint16
+  restored = np.zeros_like(data)
+  for new, old in mapping.items():
+    restored[out[..., 0] == new] = old
+  assert np.array_equal(restored, data)
+
+
+def test_volume_delete(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, rng=rng)
+  bbx = Bbox((0, 0, 0), (64, 64, 64))
+  vol.delete(bbx)
+  assert not any(vol.exists(bbx).values())
+  vol.fill_missing = True
+  assert np.all(vol.download(bbx) == 0)
+
+
+def test_volume_add_scale(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, shape=(100, 100, 50), rng=rng)
+  scale = vol.meta.add_scale((2, 2, 1))
+  assert scale["size"] == [50, 50, 50]
+  assert scale["resolution"] == [8, 8, 40]
+  assert scale["key"] == "8_8_40"
+  vol.commit_info()
+  vol2 = Volume(vol.cloudpath, mip=1)
+  assert vol2.mip_volume_size(1).tolist() == [50, 50, 50]
+
+
+def test_provenance(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, rng=rng)
+  vol.provenance  # loads default
+  vol.meta.add_provenance_entry({"task": "TestTask", "p": 1}, operator="tester")
+  vol.commit_provenance()
+  vol2 = Volume(vol.cloudpath)
+  prov = vol2.provenance
+  assert prov["processing"][0]["method"]["task"] == "TestTask"
+  assert prov["processing"][0]["by"] == "tester"
+
+
+def test_vec_as_dict_key():
+  d = {Vec(1, 2, 3): "a"}
+  assert d[Vec(1, 2, 3)] == "a"
+  assert Vec(1, 2, 3) == Vec(1, 2, 3)
+  assert Vec(1, 2, 3) != Vec(1, 2, 4)
+
+
+def test_volume_non_aligned_write_rmw(tmp_path, rng):
+  vol, data = make_vol(tmp_path, rng=rng)
+  vol.non_aligned_writes = True
+  patch = np.full((64, 64, 50), 7, dtype=np.uint8)
+  vol[Bbox((1, 0, 0), (65, 64, 50))] = patch
+  out = vol[vol.bounds]
+  assert np.all(out[1:65, :64, :50, 0] == 7)
+  assert np.array_equal(out[0, :64, :50, 0], data[0, :64, :50])
+  assert np.array_equal(out[65:, :, :, 0], data[65:])
+  # chunk files keep canonical grid-aligned names
+  names = set(vol.cf.list("4_4_40/"))
+  assert "4_4_40/0-64_0-64_0-64" in names
+  assert not any("1-65" in n for n in names)
+
+
+def test_volume_exists_partial_query(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, shape=(100, 100, 50), rng=rng)
+  res = vol.exists(Bbox((10, 10, 10), (20, 20, 20)))
+  assert res == {"4_4_40/0-64_0-64_0-50": True}
+  res = vol.exists(Bbox((64, 0, 0), (100, 100, 50)))
+  assert all(res.values()) and len(res) == 2
+
+
+def test_volume_unbounded_read_outside_volume(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, shape=(100, 100, 50), rng=rng)
+  vol.bounded = False
+  out = vol.download(Bbox((200, 0, 0), (300, 10, 10)))
+  assert out.shape == (100, 10, 10, 1)
+  assert np.all(out == 0)
+
+
+def test_volume_upload_dtype_validation(tmp_path, rng):
+  from igneous_tpu.volume import VolumeException
+  vol, _ = make_vol(tmp_path, rng=rng)
+  bbx = Bbox((0, 0, 0), (64, 64, 64))
+  with pytest.raises(VolumeException):
+    vol.upload(bbx, np.zeros((64, 64, 64), dtype=np.float32))
+  with pytest.raises(VolumeException):
+    vol.upload(bbx, np.zeros((64, 64, 64, 2), dtype=np.uint8))
+  # same-kind widening-compatible uploads are cast, then read back intact
+  vol.upload(bbx, np.full((64, 64, 64), 3, dtype=np.uint8))
+  assert np.all(vol.download(bbx) == 3)
+
+
+def test_point_to_mip_both_directions(tmp_path, rng):
+  vol, _ = make_vol(tmp_path, shape=(100, 100, 50), rng=rng)
+  vol.meta.add_scale((2, 2, 1))
+  assert vol.meta.point_to_mip(Vec(10, 11, 12), 0, 1).tolist() == [5, 5, 12]
+  assert vol.meta.point_to_mip(Vec(5, 5, 12), 1, 0).tolist() == [10, 10, 12]
