@@ -1,0 +1,168 @@
+"""Downsample planning math: factors, mip counts, memory-budget task shapes.
+
+Fresh implementations of the planning capabilities in
+/root/reference/igneous/downsample_scales.py:135-358 (compute_factors,
+axis_to_factor, scale creation, downsample_shape_from_memory_target) —
+the host-side math that decides task shapes and how many mips one task
+produces in a single device pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .lib import Vec
+from .meta import PrecomputedMetadata
+
+DEFAULT_FACTOR = (2, 2, 1)
+
+
+def axis_to_factor(axis: str) -> Tuple[int, int, int]:
+  """The 2x downsample factor that PRESERVES ``axis``
+  (reference: downsample_scales.py:174)."""
+  return {
+    "x": (1, 2, 2),
+    "y": (2, 1, 2),
+    "z": (2, 2, 1),
+  }[axis]
+
+
+def compute_factors(
+  task_shape: Sequence[int],
+  factor: Sequence[int],
+  num_mips: int,
+  chunk_size: Optional[Sequence[int]] = None,
+) -> List[Tuple[int, int, int]]:
+  """Per-mip factors achievable inside one task of ``task_shape``.
+
+  A mip is achievable while the running shape divides evenly by ``factor``
+  and (when given) the result stays chunk-writable. Mirrors the role of
+  reference downsample_scales.py:135-172.
+  """
+  shape = np.asarray(task_shape, dtype=np.int64)
+  f = np.asarray(factor, dtype=np.int64)
+  factors: List[Tuple[int, int, int]] = []
+  for _ in range(num_mips):
+    if np.any(shape % f != 0):
+      break
+    nxt = shape // f
+    if chunk_size is not None and np.any(
+      (nxt % np.asarray(chunk_size, dtype=np.int64) != 0) & (nxt != 1)
+    ):
+      break
+    factors.append(tuple(int(v) for v in f))
+    shape = nxt
+  return factors
+
+
+def scale_series(factor: Sequence[int], num_mips: int) -> List[Vec]:
+  """Cumulative factors relative to mip 0: [f, f², …]."""
+  f = np.asarray(factor, dtype=np.int64)
+  return [Vec(*(f**i)) for i in range(1, num_mips + 1)]
+
+
+def pyramid_memory_bytes(
+  shape: Sequence[int],
+  data_width: int,
+  factor: Sequence[int],
+  num_mips: int,
+  num_channels: int = 1,
+) -> int:
+  """Bytes to hold a task cutout plus all its downsampled mips."""
+  shape = np.asarray(shape, dtype=np.float64)
+  f = np.prod(np.asarray(factor, dtype=np.float64))
+  vox = float(np.prod(shape))
+  total = vox * sum((1.0 / f) ** i for i in range(num_mips + 1))
+  return int(np.ceil(total * data_width * num_channels))
+
+
+def num_mips_from_memory_target(
+  memory_target: int,
+  data_width: int,
+  chunk_size: Sequence[int],
+  factor: Sequence[int],
+  num_channels: int = 1,
+  max_mips: int = 30,
+) -> int:
+  """Max mips m such that a (chunk_size * factor^m) task pyramid fits the
+  byte budget (reference: task_creation/image.py:170-193)."""
+  cs = np.asarray(chunk_size, dtype=np.int64)
+  f = np.asarray(factor, dtype=np.int64)
+  best = 1
+  for m in range(1, max_mips + 1):
+    shape = cs * f**m
+    if np.any(shape <= 0) or np.any(shape > 2**31):
+      break
+    if pyramid_memory_bytes(shape, data_width, factor, m, num_channels) > memory_target:
+      break
+    best = m
+  return best
+
+
+def downsample_shape_from_memory_target(
+  data_width: int,
+  cx: int,
+  cy: int,
+  cz: int,
+  factor: Sequence[int],
+  byte_target: int,
+  max_mips: Optional[int] = None,
+  num_channels: int = 1,
+) -> Vec:
+  """Chunk-aligned task shape maximizing mips within ``byte_target``
+  (reference: downsample_scales.py:280-358).
+
+  The returned shape is chunk_size * factor^m: every produced mip down to m
+  lands exactly on the chunk grid, and mip m emits one chunk per task.
+  """
+  if byte_target <= 0:
+    raise ValueError("byte_target must be positive")
+  m = num_mips_from_memory_target(
+    byte_target, data_width, (cx, cy, cz), factor, num_channels
+  )
+  if max_mips is not None:
+    m = min(m, max_mips)
+  f = np.asarray(factor, dtype=np.int64)
+  return Vec(*(np.asarray((cx, cy, cz), dtype=np.int64) * f**m))
+
+
+def create_downsample_scales(
+  meta: PrecomputedMetadata,
+  mip: int,
+  task_shape: Sequence[int],
+  factor: Sequence[int] = DEFAULT_FACTOR,
+  num_mips: Optional[int] = None,
+  chunk_size: Optional[Sequence[int]] = None,
+  encoding: Optional[str] = None,
+  sharded: bool = False,
+) -> List[int]:
+  """Add the scales a downsample pass over source ``mip`` will produce.
+
+  Returns the list of destination mip indices. Scale geometry follows the
+  reference convention (floor offset, ceil size) via meta.add_scale.
+  """
+  shape = np.asarray(task_shape, dtype=np.int64)
+  cs = chunk_size if chunk_size is not None else meta.chunk_size(mip)
+  factors = compute_factors(
+    shape, factor, 30 if num_mips is None else num_mips, chunk_size=None
+  )
+  base_ratio = np.asarray(meta.downsample_ratio(mip), dtype=np.int64)
+
+  new_mips = []
+  cumulative = np.ones(3, dtype=np.int64)
+  for f in factors:
+    cumulative *= np.asarray(f, dtype=np.int64)
+    meta.add_scale(
+      base_ratio * cumulative,
+      chunk_size=cs,
+      encoding=encoding,
+    )
+    new_mips.append(meta.mip_from_key(
+      "_".join(str(int(r)) for r in
+               np.asarray(meta.scale(0)["resolution"], dtype=np.int64)
+               * base_ratio * cumulative)
+    ))
+  del sharded  # sharding specs are attached by the sharded factories
+  return new_mips

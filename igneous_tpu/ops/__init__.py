@@ -1,0 +1,15 @@
+"""Device compute kernels (JAX/XLA/Pallas).
+
+Every per-voxel hot loop the reference delegates to native C++ libraries
+(tinybrain, cc3d, zmesh, kimimaro EDT — see SURVEY.md §2.3) lives here as a
+jittable device program. Host-side numpy oracles for each kernel live in
+``igneous_tpu.ops.oracle`` and define the exact semantics tests assert.
+"""
+
+from .pooling import (
+  downsample,
+  downsample_with_averaging,
+  downsample_segmentation,
+  method_for_layer,
+  pyramid_batched,
+)

@@ -1,0 +1,101 @@
+"""Numpy reference implementations (oracles) for device kernels.
+
+Every Pallas/XLA kernel in ops/ has a numpy twin here defining its exact
+semantics; tests assert device == oracle (the pattern the reference uses
+with its kernel libraries, e.g. /root/reference/test/test_tasks.py:57-71
+asserting task output == tinybrain recomputation).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _np_windows(img: np.ndarray, f) -> np.ndarray:
+  """(x,y,z,c) → (X,Y,Z,c,n) with window order z-major, then y, then x —
+  matching ops.downsample's device flattening order."""
+  fx, fy, fz = int(f[0]), int(f[1]), int(f[2])
+  sx, sy, sz, c = img.shape
+  px, py, pz = (-sx) % fx, (-sy) % fy, (-sz) % fz
+  if px or py or pz:
+    img = np.pad(img, ((0, px), (0, py), (0, pz), (0, 0)), mode="edge")
+  sx, sy, sz, c = img.shape
+  v = img.reshape(sx // fx, fx, sy // fy, fy, sz // fz, fz, c)
+  # window axis order (fz, fy, fx): z-major
+  v = v.transpose(0, 2, 4, 6, 5, 3, 1)
+  return v.reshape(sx // fx, sy // fy, sz // fz, c, fz * fy * fx)
+
+
+def np_downsample_with_averaging(
+  img: np.ndarray, factor, num_mips: int = 1
+) -> List[np.ndarray]:
+  squeeze = img.ndim == 3
+  if squeeze:
+    img = img[..., np.newaxis]
+  outs = []
+  cur = img
+  for _ in range(num_mips):
+    w = _np_windows(cur, factor)
+    n = w.shape[-1]
+    if np.issubdtype(img.dtype, np.floating):
+      cur = np.mean(w.astype(np.float32), axis=-1).astype(img.dtype)
+    else:
+      # exact int64 accumulation; the device matches this exactly for
+      # <=16-bit dtypes and for 32-bit dtypes with power-of-two windows
+      # (its documented float32 fallback covers the remaining cases)
+      acc = np.sum(w.astype(np.int64), axis=-1)
+      cur = ((acc + n // 2) // n).astype(img.dtype)
+    outs.append(cur[..., 0] if squeeze else cur)
+  return outs
+
+
+def np_downsample_segmentation(
+  img: np.ndarray, factor, num_mips: int = 1, sparse: bool = False
+) -> List[np.ndarray]:
+  squeeze = img.ndim == 3
+  if squeeze:
+    img = img[..., np.newaxis]
+  outs = []
+  cur = img
+  for _ in range(num_mips):
+    w = _np_windows(cur, factor)  # (..., n)
+    n = w.shape[-1]
+    counts = np.zeros(w.shape, dtype=np.int32)
+    for j in range(n):
+      counts += (w == w[..., j : j + 1]).astype(np.int32)
+    pos = np.arange(n, dtype=np.int32)
+    score = counts * n - pos
+    if sparse:
+      score = np.where(w == 0, -1, score)
+    winner = np.argmax(score, axis=-1)
+    cur = np.take_along_axis(w, winner[..., None], axis=-1)[..., 0]
+    outs.append(cur[..., 0] if squeeze else cur)
+  return outs
+
+
+def np_downsample_minmax(img, factor, op: str, num_mips: int = 1):
+  squeeze = img.ndim == 3
+  if squeeze:
+    img = img[..., np.newaxis]
+  outs = []
+  cur = img
+  for _ in range(num_mips):
+    w = _np_windows(cur, factor)
+    cur = np.min(w, axis=-1) if op == "min" else np.max(w, axis=-1)
+    outs.append(cur[..., 0] if squeeze else cur)
+  return outs
+
+
+def np_downsample_striding(img, factor, num_mips: int = 1):
+  squeeze = img.ndim == 3
+  if squeeze:
+    img = img[..., np.newaxis]
+  fx, fy, fz = [int(v) for v in factor]
+  outs = []
+  cur = img
+  for _ in range(num_mips):
+    cur = cur[::fx, ::fy, ::fz]
+    outs.append(cur[..., 0] if squeeze else cur)
+  return outs

@@ -1,0 +1,11 @@
+"""Device-mesh parallel execution of chunk batches.
+
+The reference scales one host by running N worker *processes*
+(LocalTaskQueue(parallel=N), /root/reference/igneous_cli/cli.py:915-933).
+The TPU-native equivalent (SURVEY.md §5.8): one host leases many tasks,
+batches their cutouts, and runs ONE device program shard_map-ed across the
+chip mesh over ICI — spatial-grid data parallelism mapped onto the "data"
+axis of a jax.sharding.Mesh.
+"""
+
+from .executor import ChunkExecutor, make_mesh

@@ -1,0 +1,24 @@
+"""Task-queue orchestration layer (control plane).
+
+Capability parity with the reference's external ``python-task-queue``
+dependency (/root/reference/igneous_cli/cli.py:69-78,935-964 and
+igneous/__init__.py:2): JSON-serializable tasks, ``LocalTaskQueue`` for
+in-process/multi-process execution, a lease-based filesystem queue
+(``fq://``) for cluster horizontal scaling, and a pluggable protocol hook
+where an SQS-style backend can be attached.
+"""
+
+from .registry import (
+  FN_REGISTRY,
+  TASK_REGISTRY,
+  FunctionTask,
+  PrintTask,
+  RegisteredTask,
+  deserialize,
+  queueable,
+  serialize,
+  totask,
+)
+from .local import LocalTaskQueue, MockTaskQueue
+from .filequeue import FileQueue
+from .queue import TaskQueue, register_queue_protocol

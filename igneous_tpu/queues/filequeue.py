@@ -1,0 +1,218 @@
+"""Lease-based filesystem task queue (``fq://``).
+
+Behavioral parity with the reference's FileQueue (python-task-queue,
+described at /root/reference/README.md:69-81): at-least-once delivery with a
+visibility timeout — a leased task that is not deleted within its lease
+returns to the pool; workers pick a random task among the first 100 to
+avoid lease contention; completions are tallied 1 byte per task.
+
+All state is plain files, so any shared POSIX filesystem (NFS, /mnt
+volumes) works as the control plane across machines.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+import uuid
+from typing import Iterable, List, Optional, Tuple
+
+from .registry import RegisteredTask, deserialize, serialize
+
+LEASE_SEP = "--"
+CONTENTION_WINDOW = 100
+
+
+class FileQueue:
+  def __init__(self, path: str):
+    if path.startswith("fq://"):
+      path = path[len("fq://"):]
+    self.path = os.path.abspath(os.path.expanduser(path))
+    self.queue_dir = os.path.join(self.path, "queue")
+    self.lease_dir = os.path.join(self.path, "leased")
+    os.makedirs(self.queue_dir, exist_ok=True)
+    os.makedirs(self.lease_dir, exist_ok=True)
+
+  # -- counters -------------------------------------------------------------
+
+  def _tally(self, counter: str, n: int = 1):
+    with open(os.path.join(self.path, counter), "ab") as f:
+      f.write(b"\x01" * n)
+
+  def _count(self, counter: str) -> int:
+    try:
+      return os.path.getsize(os.path.join(self.path, counter))
+    except FileNotFoundError:
+      return 0
+
+  @property
+  def inserted(self) -> int:
+    return self._count("insertions")
+
+  @property
+  def completed(self) -> int:
+    return self._count("completions")
+
+  @property
+  def enqueued(self) -> int:
+    return len(os.listdir(self.queue_dir)) + len(os.listdir(self.lease_dir))
+
+  @property
+  def leased(self) -> int:
+    return len(os.listdir(self.lease_dir))
+
+  def is_empty(self) -> bool:
+    return self.enqueued == 0
+
+  def rezero(self):
+    for counter in ("insertions", "completions"):
+      try:
+        os.remove(os.path.join(self.path, counter))
+      except FileNotFoundError:
+        pass
+
+  # -- producer -------------------------------------------------------------
+
+  def insert(self, tasks: Iterable, total: Optional[int] = None):
+    del total
+    n = 0
+    for task in self._iter(tasks):
+      payload = serialize(task)
+      name = f"{uuid.uuid4().hex}.json"
+      tmp = os.path.join(self.path, f".tmp-{name}")
+      with open(tmp, "w") as f:
+        f.write(payload)
+      os.replace(tmp, os.path.join(self.queue_dir, name))
+      n += 1
+    self._tally("insertions", n)
+    return n
+
+  insert_all = insert
+
+  @staticmethod
+  def _iter(tasks):
+    if hasattr(tasks, "__iter__") and not isinstance(tasks, (str, bytes, dict)):
+      return iter(tasks)
+    return iter([tasks])
+
+  # -- consumer -------------------------------------------------------------
+
+  def _recycle_expired(self):
+    now = time.time()
+    for name in os.listdir(self.lease_dir):
+      try:
+        deadline = float(name.split(LEASE_SEP, 1)[0])
+      except ValueError:
+        continue
+      if deadline < now:
+        orig = name.split(LEASE_SEP, 1)[1]
+        try:
+          os.rename(
+            os.path.join(self.lease_dir, name),
+            os.path.join(self.queue_dir, orig),
+          )
+        except FileNotFoundError:
+          pass  # another worker recycled it first
+
+  def lease(self, seconds: float = 600) -> Optional[Tuple[RegisteredTask, str]]:
+    """Returns (task, lease_id) or None if the queue is drained."""
+    self._recycle_expired()
+    for _ in range(10):  # bounded retries under contention
+      names = sorted(os.listdir(self.queue_dir))
+      if not names:
+        return None
+      name = random.choice(names[:CONTENTION_WINDOW])
+      deadline = time.time() + seconds
+      lease_name = f"{deadline:.3f}{LEASE_SEP}{name}"
+      src = os.path.join(self.queue_dir, name)
+      dst = os.path.join(self.lease_dir, lease_name)
+      try:
+        os.rename(src, dst)
+      except FileNotFoundError:
+        continue  # lost the race; try another
+      with open(dst) as f:
+        return deserialize(f.read()), lease_name
+    return None
+
+  def delete(self, lease_id: str):
+    try:
+      os.remove(os.path.join(self.lease_dir, lease_id))
+    except FileNotFoundError:
+      pass
+    self._tally("completions")
+
+  def release(self, lease_id: str):
+    orig = lease_id.split(LEASE_SEP, 1)[1]
+    try:
+      os.rename(
+        os.path.join(self.lease_dir, lease_id),
+        os.path.join(self.queue_dir, orig),
+      )
+    except FileNotFoundError:
+      pass
+
+  def release_all(self):
+    for name in list(os.listdir(self.lease_dir)):
+      if LEASE_SEP in name:
+        self.release(name)
+
+  def purge(self):
+    for d in (self.queue_dir, self.lease_dir):
+      for name in list(os.listdir(d)):
+        try:
+          os.remove(os.path.join(d, name))
+        except FileNotFoundError:
+          pass
+    self.rezero()
+
+  # -- worker loop ----------------------------------------------------------
+
+  def poll(
+    self,
+    lease_seconds: float = 600,
+    verbose: bool = False,
+    tally: bool = True,
+    stop_fn=None,
+    max_backoff_window: float = 30.0,
+    before_fn=None,
+    after_fn=None,
+  ):
+    """Lease→execute→delete until stop_fn says stop or the queue drains
+    (stop_fn=None polls forever, sleeping with bounded backoff when empty)."""
+    del tally  # completions are always tallied; kept for API familiarity
+    backoff = 1.0
+    executed = 0
+    while True:
+      if stop_fn is not None and stop_fn(executed=executed, empty=False):
+        return executed
+      leased = self.lease(lease_seconds)
+      if leased is None:
+        if stop_fn is not None and stop_fn(executed=executed, empty=True):
+          return executed
+        time.sleep(backoff + random.random())
+        backoff = min(backoff * 2, max_backoff_window)
+        continue
+      backoff = 1.0
+      task, lease_id = leased
+      if verbose:
+        print(f"Executing {task!r}")
+      try:
+        if before_fn:
+          before_fn(task)
+        task.execute()
+        if after_fn:
+          after_fn(task)
+      except Exception:
+        # leave the lease in place: the task recycles after the timeout
+        # (at-least-once semantics; matches reference behavior on failure)
+        if verbose:
+          import traceback
+
+          traceback.print_exc()
+        continue
+      self.delete(lease_id)
+      executed += 1
+
+  def __len__(self):
+    return self.enqueued

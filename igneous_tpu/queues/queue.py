@@ -1,0 +1,32 @@
+"""TaskQueue facade: protocol-addressed queues.
+
+Mirrors the reference's queue URL convention
+(/root/reference/igneous_cli/cli.py:935-964): ``fq://<dir>`` filesystem
+queue, ``sqs://`` cloud queue (attachable via register_queue_protocol —
+no egress in this environment, same policy as storage backends).
+"""
+
+from __future__ import annotations
+
+from .filequeue import FileQueue
+
+_QUEUE_PROTOCOLS = {}
+
+
+def register_queue_protocol(name: str, factory):
+  _QUEUE_PROTOCOLS[name] = factory
+
+
+def TaskQueue(spec, **kw):
+  """Create a queue from a URL spec (or pass through a queue object)."""
+  if not isinstance(spec, str):
+    return spec
+  if spec.startswith("fq://") or "://" not in spec:
+    return FileQueue(spec, **kw)
+  protocol = spec.split("://", 1)[0]
+  if protocol in _QUEUE_PROTOCOLS:
+    return _QUEUE_PROTOCOLS[protocol](spec, **kw)
+  raise ValueError(
+    f"Queue protocol {protocol}:// not available. "
+    f"Use fq:// or register_queue_protocol()."
+  )

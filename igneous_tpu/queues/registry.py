@@ -1,0 +1,165 @@
+"""Task registry + JSON wire format.
+
+A task crosses process/machine boundaries as JSON. Two kinds exist, matching
+the reference's RegisteredTask-subclass and @queueable-function styles
+(/root/reference/igneous/tasks/__init__.py:1-25 registers both kinds):
+
+  {"class": "DownsampleTask", "params": {...}}     RegisteredTask subclass
+  {"fn": "delete_mesh_files", "args": [...], "kwargs": {...}}  @queueable
+
+RegisteredTask subclasses get automatic serialization: the constructor's
+bound arguments are recorded at instantiation time, so ``__init__``
+signatures ARE the wire schema.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import json
+from typing import Callable, Dict, Optional, Union
+
+from ..lib import jsonify
+
+TASK_REGISTRY: Dict[str, type] = {}
+FN_REGISTRY: Dict[str, Callable] = {}
+
+
+class RegisteredTask:
+  """Base for serializable work units. Subclass and implement execute()."""
+
+  def __init_subclass__(cls, **kw):
+    super().__init_subclass__(**kw)
+    TASK_REGISTRY[cls.__name__] = cls
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def wrapped_init(self, *args, **kwargs):
+      # only the outermost constructor (the instantiated class) records
+      # params; super().__init__ chains must not overwrite them
+      if not hasattr(self, "_params"):
+        sig = inspect.signature(orig_init)
+        bound = sig.bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        params = dict(bound.arguments)
+        params.pop("self", None)
+        for pname, p in sig.parameters.items():
+          if p.kind is inspect.Parameter.VAR_KEYWORD:
+            params.update(params.pop(pname, {}))
+        self._params = jsonify(params)
+      orig_init(self, *args, **kwargs)
+
+    cls.__init__ = wrapped_init
+
+  def __init__(self):
+    if not hasattr(self, "_params"):
+      self._params = {}
+
+  def execute(self):
+    raise NotImplementedError
+
+  def payload(self) -> dict:
+    return {
+      "class": type(self).__name__,
+      "module": type(self).__module__,
+      "params": self._params,
+    }
+
+  def to_json(self) -> str:
+    return json.dumps(self.payload())
+
+  def __repr__(self):
+    args = ", ".join(f"{k}={v!r}" for k, v in self._params.items())
+    return f"{type(self).__name__}({args})"
+
+  def __eq__(self, other):
+    return (
+      type(self) is type(other)
+      and self._params == getattr(other, "_params", None)
+    )
+
+  def __hash__(self):
+    return hash(self.to_json())
+
+
+def queueable(fn: Callable) -> Callable:
+  """Register a function as a queueable task target.
+
+  Insert ``functools.partial(fn, *args, **kwargs)`` into a queue; it
+  serializes by function name + arguments.
+  """
+  FN_REGISTRY[fn.__name__] = fn
+  fn._queueable = True
+  return fn
+
+
+class FunctionTask(RegisteredTask):
+  """Adapter that executes a @queueable function payload."""
+
+  def __init__(self, fn_name: str, args: list, kwargs: dict):
+    self.fn_name = fn_name
+    self.args = args or []
+    self.kwargs = kwargs or {}
+
+  def payload(self) -> dict:
+    return {
+      "fn": self.fn_name,
+      "args": jsonify(list(self.args)),
+      "kwargs": jsonify(dict(self.kwargs)),
+    }
+
+  def execute(self):
+    if self.fn_name not in FN_REGISTRY:
+      raise KeyError(
+        f"Function {self.fn_name!r} is not @queueable-registered. "
+        f"Known: {sorted(FN_REGISTRY)}"
+      )
+    return FN_REGISTRY[self.fn_name](*self.args, **self.kwargs)
+
+
+class PrintTask(RegisteredTask):
+  """Debug/smoke-test task."""
+
+  def __init__(self, txt: str = ""):
+    self.txt = txt
+
+  def execute(self):
+    print(self.txt or "PrintTask")
+    return self.txt
+
+
+def serialize(task) -> str:
+  """Task object | partial | payload-dict → JSON string."""
+  if isinstance(task, RegisteredTask):
+    return task.to_json()
+  if isinstance(task, functools.partial):
+    fn = task.func
+    if not getattr(fn, "_queueable", False):
+      raise ValueError(f"{fn} is not @queueable")
+    return FunctionTask(fn.__name__, list(task.args), dict(task.keywords)).to_json()
+  if isinstance(task, dict):
+    return json.dumps(jsonify(task))
+  if isinstance(task, str):
+    return task
+  raise TypeError(f"Cannot serialize task: {task!r}")
+
+
+def deserialize(payload: Union[str, bytes, dict]) -> RegisteredTask:
+  if isinstance(payload, (str, bytes)):
+    payload = json.loads(payload)
+  if "fn" in payload:
+    return FunctionTask(payload["fn"], payload.get("args"), payload.get("kwargs"))
+  name = payload["class"]
+  if name not in TASK_REGISTRY and payload.get("module"):
+    # cross-process case: the defining module wasn't imported yet
+    import importlib
+
+    importlib.import_module(payload["module"])
+  if name not in TASK_REGISTRY:
+    raise KeyError(
+      f"Task class {name!r} is not registered. Import the module defining it."
+    )
+  return TASK_REGISTRY[name](**payload.get("params", {}))
+
+
+totask = deserialize

@@ -1,0 +1,23 @@
+"""Task factories: grid decomposition + destination metadata management.
+
+Mirrors /root/reference/igneous/task_creation/__init__.py's role: the
+public ``create_*_tasks`` generators the CLI and library users call.
+"""
+
+from .common import (
+  FinelyDividedTaskIterator,
+  GridTaskIterator,
+  get_bounds,
+  num_tasks,
+  operator_contact,
+)
+from .image import (
+  MEMORY_TARGET,
+  create_blackout_tasks,
+  create_deletion_tasks,
+  create_downsampling_tasks,
+  create_quantized_affinity_info,
+  create_quantize_tasks,
+  create_touch_tasks,
+  create_transfer_tasks,
+)

@@ -1,0 +1,118 @@
+"""Factory commons: grid decomposition, bounds resolution, provenance ops.
+
+Reference parity: /root/reference/igneous/task_creation/common.py
+(FinelyDividedTaskIterator :60-104, get_bounds :29-55, num_tasks :57,
+operator_contact :11-24).
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec, ceil_div
+from ..volume import Volume
+
+
+def operator_contact() -> str:
+  """git email for provenance records (best effort)."""
+  try:
+    return (
+      subprocess.check_output(
+        ["git", "config", "user.email"], stderr=subprocess.DEVNULL
+      )
+      .decode("utf8")
+      .strip()
+    )
+  except Exception:
+    return ""
+
+
+def get_bounds(
+  vol: Volume,
+  bounds: Optional[Bbox],
+  mip: int,
+  bounds_mip: int = 0,
+  chunk_size: Optional[Sequence[int]] = None,
+) -> Bbox:
+  """Resolve a user bbox (given at bounds_mip) to task bounds at mip,
+  expanded to the chunk grid and clamped to the volume."""
+  if bounds is None:
+    return vol.meta.bounds(mip)
+  bounds = vol.meta.bbox_to_mip(bounds, bounds_mip, mip)
+  if chunk_size is not None:
+    bounds = bounds.expand_to_chunk_size(chunk_size, vol.meta.voxel_offset(mip))
+  return Bbox.intersection(bounds, vol.meta.bounds(mip))
+
+
+def num_tasks(bounds: Bbox, shape: Sequence[int]) -> int:
+  return int(np.prod(ceil_div(np.asarray(bounds.size3()), np.asarray(shape))))
+
+
+class FinelyDividedTaskIterator:
+  """Splits ``bounds`` into a shape-sized grid; index → task.
+
+  Sliceable (``it[a:b]``) so interrupted insertions can resume mid-range,
+  like the reference iterator (common.py:77-81). Subclass and override
+  ``task(shape, offset)``; ``on_finish()`` runs after full iteration.
+  """
+
+  def __init__(self, bounds: Bbox, shape: Sequence[int]):
+    self.bounds = bounds
+    self.shape = Vec(*shape)
+    self.grid = Vec(*ceil_div(np.asarray(bounds.size3()), np.asarray(self.shape)))
+    self.start = 0
+    self.end = len(self)
+
+  def __len__(self) -> int:
+    return int(np.prod(np.asarray(self.grid)))
+
+  def to_coord(self, index: int) -> Vec:
+    gx, gy, _gz = (int(v) for v in self.grid)
+    return Vec(index % gx, (index // gx) % gy, index // (gx * gy))
+
+  def task(self, shape: Vec, offset: Vec):
+    raise NotImplementedError
+
+  def on_finish(self):
+    pass
+
+  def __getitem__(self, sl: slice) -> "FinelyDividedTaskIterator":
+    import copy
+
+    if not isinstance(sl, slice):
+      raise TypeError("index must be a slice")
+    clone = copy.copy(self)
+    clone.start, clone.end, _ = sl.indices(len(self))
+    return clone
+
+  def __iter__(self) -> Iterator:
+    for index in range(self.start, self.end):
+      coord = self.to_coord(index)
+      offset = self.bounds.minpt + coord * self.shape
+      yield self.task(self.shape.clone(), Vec(*offset))
+    self.on_finish()
+
+
+class GridTaskIterator(FinelyDividedTaskIterator):
+  """FinelyDividedTaskIterator driven by a callback instead of subclassing."""
+
+  def __init__(
+    self,
+    bounds: Bbox,
+    shape: Sequence[int],
+    task_fn: Callable[[Vec, Vec], object],
+    finish_fn: Optional[Callable[[], None]] = None,
+  ):
+    super().__init__(bounds, shape)
+    self._task_fn = task_fn
+    self._finish_fn = finish_fn
+
+  def task(self, shape: Vec, offset: Vec):
+    return self._task_fn(shape, offset)
+
+  def on_finish(self):
+    if self._finish_fn is not None:
+      self._finish_fn()

@@ -1,0 +1,393 @@
+"""Image task factories.
+
+Reference parity: /root/reference/igneous/task_creation/image.py
+(create_downsampling_tasks :195-345, create_transfer_tasks :921-1170,
+create_deletion_tasks :809-850, quantize :1599; MEMORY_TARGET :74).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec, jsonify
+from ..volume import Volume
+from ..downsample_scales import (
+  DEFAULT_FACTOR,
+  axis_to_factor,
+  compute_factors,
+  create_downsample_scales,
+  downsample_shape_from_memory_target,
+)
+from ..tasks.image import (
+  BlackoutTask,
+  DeleteTask,
+  DownsampleTask,
+  QuantizeTask,
+  TouchTask,
+  TransferTask,
+)
+from .common import GridTaskIterator, get_bounds, operator_contact
+
+MEMORY_TARGET = int(3.5e9)  # bytes per task, reference default (image.py:74)
+
+
+def _provenance(vol: Volume, method: dict):
+  vol.meta.refresh_provenance()
+  vol.meta.add_provenance_entry(jsonify(method), operator_contact())
+  vol.commit_provenance()
+
+
+def _pick_task_shape(
+  vol: Volume,
+  mip: int,
+  factor: Sequence[int],
+  memory_target: int,
+  num_mips: int,
+  chunk_size: Optional[Sequence[int]] = None,
+) -> Vec:
+  cs = Vec(*(chunk_size if chunk_size is not None else vol.meta.chunk_size(mip)))
+  shape = downsample_shape_from_memory_target(
+    vol.dtype.itemsize,
+    int(cs.x), int(cs.y), int(cs.z),
+    factor,
+    memory_target,
+    max_mips=num_mips,
+    num_channels=vol.num_channels,
+  )
+  return Vec(*np.minimum(
+    np.asarray(shape),
+    np.asarray(vol.meta.bounds(mip).expand_to_chunk_size(
+      cs, vol.meta.voxel_offset(mip)
+    ).size3()),
+  ))
+
+
+def create_downsampling_tasks(
+  layer_path: str,
+  mip: int = 0,
+  fill_missing: bool = False,
+  num_mips: int = 5,
+  sparse: bool = False,
+  chunk_size: Optional[Sequence[int]] = None,
+  encoding: Optional[str] = None,
+  delete_black_uploads: bool = False,
+  background_color: int = 0,
+  compress="gzip",
+  factor: Optional[Sequence[int]] = None,
+  axis: str = "z",
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+  memory_target: int = MEMORY_TARGET,
+  downsample_method: str = "auto",
+):
+  """Grid of DownsampleTasks; creates the destination scales first
+  (reference: task_creation/image.py:195-345)."""
+  vol = Volume(layer_path, mip=mip)
+  if factor is None:
+    factor = axis_to_factor(axis) if axis != "z" else DEFAULT_FACTOR
+
+  shape = _pick_task_shape(vol, mip, factor, memory_target, num_mips, chunk_size)
+  factors = compute_factors(shape, factor, num_mips)
+  create_downsample_scales(
+    vol.meta, mip, shape, factor,
+    num_mips=len(factors),
+    chunk_size=chunk_size,
+    encoding=encoding,
+  )
+  vol.commit_info()
+
+  task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return DownsampleTask(
+      layer_path=layer_path,
+      mip=mip,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      fill_missing=fill_missing,
+      sparse=sparse,
+      delete_black_uploads=delete_black_uploads,
+      background_color=background_color,
+      compress=compress,
+      downsample_method=downsample_method,
+      num_mips=len(factors),
+      factor=tuple(factor),
+    )
+
+  def finish():
+    _provenance(vol, {
+      "task": "DownsampleTask",
+      "mip": mip,
+      "num_mips": len(factors),
+      "shape": shape.tolist(),
+      "factor": list(factor),
+      "sparse": sparse,
+      "bounds": task_bounds.to_list(),
+      "method": downsample_method,
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_transfer_tasks(
+  src_layer_path: str,
+  dest_layer_path: str,
+  chunk_size: Optional[Sequence[int]] = None,
+  shape: Optional[Sequence[int]] = None,
+  mip: int = 0,
+  dest_voxel_offset: Optional[Sequence[int]] = None,
+  translate: Sequence[int] = (0, 0, 0),
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+  fill_missing: bool = False,
+  skip_first: bool = False,
+  skip_downsamples: bool = False,
+  delete_black_uploads: bool = False,
+  background_color: int = 0,
+  sparse: bool = False,
+  compress="gzip",
+  encoding: Optional[str] = None,
+  num_mips: int = 0,
+  factor: Optional[Sequence[int]] = None,
+  memory_target: int = MEMORY_TARGET,
+  downsample_method: str = "auto",
+):
+  """Grid of TransferTasks; creates/extends the destination info
+  (reference: task_creation/image.py:921-1170)."""
+  src = Volume(src_layer_path, mip=mip)
+  if factor is None:
+    factor = DEFAULT_FACTOR
+
+  # destination metadata mirrors the source scale structure through `mip`
+  # (so dest mip indices line up with the task's mip), fresh chunking
+  src_scale = src.meta.scale(mip)
+  dest_chunk = list(chunk_size) if chunk_size else src_scale["chunk_sizes"][0]
+  base_scale = src.meta.scale(0)
+  dest_offset0 = (
+    None
+    if dest_voxel_offset is None
+    else list(dest_voxel_offset)
+  )
+  dest_info = Volume.create_new_info(
+    num_channels=src.num_channels,
+    layer_type=src.layer_type,
+    data_type=src.meta.data_type,
+    encoding=encoding or src_scale["encoding"],
+    resolution=base_scale["resolution"],
+    voxel_offset=(
+      dest_offset0
+      if dest_offset0 is not None
+      else (np.asarray(base_scale.get("voxel_offset", [0, 0, 0]))
+            + np.asarray(translate)).tolist()
+    ),
+    volume_size=base_scale["size"],
+    chunk_size=dest_chunk,
+  )
+  try:
+    dest = Volume(dest_layer_path)  # existing destination info wins
+  except FileNotFoundError:
+    dest = Volume.create(dest_layer_path, dest_info)
+    for m in range(1, mip + 1):
+      dest.meta.add_scale(
+        np.asarray(src.meta.downsample_ratio(m)),
+        chunk_size=dest_chunk,
+        encoding=encoding or src.meta.encoding(m),
+      )
+
+  if shape is None:
+    shape = downsample_shape_from_memory_target(
+      src.dtype.itemsize,
+      dest_chunk[0], dest_chunk[1], dest_chunk[2],
+      factor, memory_target,
+      max_mips=max(num_mips, 1),
+      num_channels=src.num_channels,
+    )
+  shape = Vec(*shape)
+
+  if num_mips > 0:
+    factors = compute_factors(shape, factor, num_mips)
+    create_downsample_scales(
+      dest.meta, mip, shape, factor, num_mips=len(factors),
+      chunk_size=dest_chunk, encoding=encoding,
+    )
+  dest.commit_info()
+
+  task_bounds = get_bounds(src, bounds, mip, bounds_mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return TransferTask(
+      src_path=src_layer_path,
+      dest_path=dest_layer_path,
+      mip=mip,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      fill_missing=fill_missing,
+      translate=tuple(translate),
+      skip_first=skip_first,
+      skip_downsamples=skip_downsamples,
+      delete_black_uploads=delete_black_uploads,
+      background_color=background_color,
+      sparse=sparse,
+      compress=compress,
+      downsample_method=downsample_method,
+      num_mips=num_mips,
+      factor=tuple(factor),
+    )
+
+  def finish():
+    _provenance(dest, {
+      "task": "TransferTask",
+      "src": src_layer_path,
+      "dest": dest_layer_path,
+      "mip": mip,
+      "shape": shape.tolist(),
+      "translate": list(translate),
+      "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_deletion_tasks(
+  layer_path: str,
+  mip: int = 0,
+  num_mips: int = 0,
+  shape: Optional[Sequence[int]] = None,
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+):
+  vol = Volume(layer_path, mip=mip)
+  if shape is None:
+    shape = vol.meta.chunk_size(mip) * 4
+  shape = Vec(*shape)
+  task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return DeleteTask(
+      layer_path=layer_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      num_mips=num_mips,
+    )
+
+  def finish():
+    _provenance(vol, {
+      "task": "DeleteTask", "mip": mip, "num_mips": num_mips,
+      "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_blackout_tasks(
+  cloudpath: str,
+  bounds: Bbox,
+  mip: int = 0,
+  shape: Sequence[int] = (2048, 2048, 64),
+  value: int = 0,
+  non_aligned_writes: bool = False,
+):
+  vol = Volume(cloudpath, mip=mip)
+  shape = Vec(*shape)
+  if not non_aligned_writes:
+    bounds = bounds.expand_to_chunk_size(
+      vol.meta.chunk_size(mip), vol.meta.voxel_offset(mip)
+    )
+  bounds = Bbox.intersection(bounds, vol.meta.bounds(mip))
+
+  def make_task(shape_: Vec, offset: Vec):
+    return BlackoutTask(
+      cloudpath=cloudpath,
+      mip=mip,
+      shape=np.minimum(
+        np.asarray(shape_), np.asarray(bounds.maxpt) - np.asarray(offset)
+      ).tolist(),
+      offset=offset.tolist(),
+      value=value,
+      non_aligned_writes=non_aligned_writes,
+    )
+
+  return GridTaskIterator(bounds, shape, make_task)
+
+
+def create_touch_tasks(
+  cloudpath: str,
+  mip: int = 0,
+  shape: Sequence[int] = (2048, 2048, 64),
+  bounds: Optional[Bbox] = None,
+):
+  vol = Volume(cloudpath, mip=mip)
+  shape = Vec(*shape)
+  task_bounds = get_bounds(vol, bounds, mip, mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return TouchTask(
+      cloudpath=cloudpath, mip=mip,
+      shape=shape_.tolist(), offset=offset.tolist(),
+    )
+
+  def finish():
+    _provenance(vol, {
+      "task": "TouchTask", "mip": mip, "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_quantized_affinity_info(
+  src_layer: str,
+  dest_layer: str,
+  shape: Sequence[int],
+  mip: int,
+  chunk_size: Sequence[int],
+  encoding: str = "raw",
+) -> dict:
+  src = Volume(src_layer, mip=mip)
+  scale = src.meta.scale(mip)
+  return Volume.create_new_info(
+    num_channels=1,
+    layer_type="image",
+    data_type="uint8",
+    encoding=encoding,
+    resolution=scale["resolution"],
+    voxel_offset=scale.get("voxel_offset", [0, 0, 0]),
+    volume_size=scale["size"],
+    chunk_size=chunk_size,
+  )
+
+
+def create_quantize_tasks(
+  src_layer: str,
+  dest_layer: str,
+  shape: Sequence[int],
+  mip: int = 0,
+  fill_missing: bool = False,
+  chunk_size: Sequence[int] = (128, 128, 64),
+):
+  shape = Vec(*shape)
+  info = create_quantized_affinity_info(
+    src_layer, dest_layer, shape, mip, chunk_size
+  )
+  dest = Volume.create(dest_layer, info)
+  src = Volume(src_layer, mip=mip)
+  task_bounds = src.meta.bounds(mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return QuantizeTask(
+      source_layer_path=src_layer,
+      dest_layer_path=dest_layer,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      fill_missing=fill_missing,
+    )
+
+  def finish():
+    _provenance(dest, {
+      "task": "QuantizeTask", "mip": mip, "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)

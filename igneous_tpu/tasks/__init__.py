@@ -1,0 +1,41 @@
+"""Task definitions: serializable download→compute→upload work units.
+
+This package is the task registry: importing it registers every task class
+(the reference's equivalent is /root/reference/igneous/tasks/__init__.py).
+Worker processes import this module before deserializing payloads.
+"""
+
+from ..queues.registry import PrintTask, RegisteredTask
+from .image import (
+  BlackoutTask,
+  DeleteTask,
+  DownsampleTask,
+  QuantizeTask,
+  TouchTask,
+  TransferTask,
+  downsample_and_upload,
+)
+
+
+class TouchFileTask(RegisteredTask):
+  """Creates an empty file; used for queue smoke tests and liveness probes."""
+
+  def __init__(self, path: str):
+    self.path = path
+
+  def execute(self):
+    import os
+
+    os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+    with open(self.path, "a"):
+      pass
+
+
+class FailTask(RegisteredTask):
+  """Always raises; exercises lease-recycling / at-least-once delivery."""
+
+  def __init__(self, message: str = "intentional failure"):
+    self.message = message
+
+  def execute(self):
+    raise RuntimeError(self.message)

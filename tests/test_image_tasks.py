@@ -1,0 +1,276 @@
+"""End-to-end image pipeline tests on file:// volumes with kernel oracles.
+
+Mirrors the reference test strategy (SURVEY.md §4): real stack against
+file:// volumes, outputs asserted against ops.oracle recomputation.
+"""
+
+import numpy as np
+import pytest
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox, Vec
+from igneous_tpu.ops import oracle
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.tasks import DeleteTask, DownsampleTask
+from igneous_tpu.volume import EmptyVolumeError, Volume
+
+
+def make_image_vol(path, shape=(256, 256, 96), offset=(0, 0, 0), rng=None):
+  rng = rng or np.random.default_rng(7)
+  data = rng.integers(0, 255, size=shape).astype(np.uint8)
+  vol = Volume.from_numpy(
+    data, path, resolution=(4, 4, 40), voxel_offset=offset,
+    chunk_size=(64, 64, 64), layer_type="image",
+  )
+  return vol, data
+
+
+def make_seg_vol(path, shape=(128, 128, 64), offset=(0, 0, 0), rng=None,
+                 dtype=np.uint64):
+  rng = rng or np.random.default_rng(11)
+  # blocky segmentation: realistic label statistics for mode pooling
+  blocks = rng.integers(1, 2**40, size=(8, 8, 8)).astype(dtype)
+  reps = [int(np.ceil(s / 8)) for s in shape]
+  data = np.kron(blocks, np.ones((reps[0], reps[1], reps[2]), dtype=dtype))
+  data = data[: shape[0], : shape[1], : shape[2]]
+  data[rng.random(shape) < 0.05] = 0
+  vol = Volume.from_numpy(
+    data, path, resolution=(8, 8, 40), voxel_offset=offset,
+    chunk_size=(64, 64, 64), layer_type="segmentation",
+  )
+  return vol, data
+
+
+def run(tasks):
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+
+
+def test_downsample_image_pyramid(tmp_path):
+  path = f"file://{tmp_path}/img"
+  vol, data = make_image_vol(path)
+  tasks = tc.create_downsampling_tasks(
+    path, mip=0, num_mips=3, memory_target=64 * 1024 * 1024
+  )
+  run(tasks)
+
+  vol = Volume(path)
+  assert vol.meta.num_mips == 4
+  expected = oracle.np_downsample_with_averaging(data, (2, 2, 1), num_mips=3)
+  for m in range(1, 4):
+    out = vol.download(vol.meta.bounds(m), mip=m)
+    assert np.array_equal(out[..., 0], expected[m - 1]), f"mip {m} mismatch"
+
+
+def test_downsample_with_offset_and_odd_size(tmp_path):
+  path = f"file://{tmp_path}/img"
+  vol, data = make_image_vol(path, shape=(200, 164, 50), offset=(64, 128, 32))
+  tasks = tc.create_downsampling_tasks(
+    path, mip=0, num_mips=2, memory_target=16 * 1024 * 1024
+  )
+  run(tasks)
+  vol = Volume(path)
+  assert vol.meta.num_mips >= 2
+  expected = oracle.np_downsample_with_averaging(data, (2, 2, 1), num_mips=1)[0]
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  assert vol.meta.voxel_offset(1).tolist() == [32, 64, 32]
+  assert np.array_equal(out[..., 0], expected)
+
+
+def test_downsample_segmentation_mode(tmp_path):
+  path = f"file://{tmp_path}/seg"
+  vol, data = make_seg_vol(path)
+  tasks = tc.create_downsampling_tasks(
+    path, mip=0, num_mips=2, memory_target=16 * 1024 * 1024
+  )
+  run(tasks)
+  vol = Volume(path)
+  expected = oracle.np_downsample_segmentation(data, (2, 2, 1), num_mips=2)
+  for m in (1, 2):
+    out = vol.download(vol.meta.bounds(m), mip=m)
+    assert np.array_equal(out[..., 0], expected[m - 1]), f"mip {m}"
+
+
+def test_downsample_2x2x2_sparse(tmp_path):
+  path = f"file://{tmp_path}/seg"
+  vol, data = make_seg_vol(path, shape=(64, 64, 64))
+  tasks = tc.create_downsampling_tasks(
+    path, mip=0, num_mips=1, factor=(2, 2, 2), sparse=True,
+    memory_target=16 * 1024 * 1024,
+  )
+  run(tasks)
+  vol = Volume(path)
+  expected = oracle.np_downsample_segmentation(
+    data, (2, 2, 2), num_mips=1, sparse=True
+  )[0]
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  assert np.array_equal(out[..., 0], expected)
+
+
+def test_downsample_missing_chunks_fill(tmp_path):
+  path = f"file://{tmp_path}/img"
+  vol, data = make_image_vol(path, shape=(128, 128, 64))
+  vol.cf.delete(vol.meta.chunk_name(0, Bbox((0, 0, 0), (64, 64, 64))))
+  with pytest.raises(EmptyVolumeError):
+    run(tc.create_downsampling_tasks(
+      path, num_mips=1, memory_target=16 * 1024 * 1024))
+  run(tc.create_downsampling_tasks(
+    path, num_mips=1, fill_missing=True, memory_target=16 * 1024 * 1024))
+  vol = Volume(path)
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  data0 = data.copy()
+  data0[:64, :64, :64] = 0
+  expected = oracle.np_downsample_with_averaging(data0, (2, 2, 1))[0]
+  assert np.array_equal(out[..., 0], expected)
+
+
+def test_transfer_rechunk_and_mips(tmp_path):
+  src_path = f"file://{tmp_path}/src"
+  dest_path = f"file://{tmp_path}/dest"
+  vol, data = make_image_vol(src_path, shape=(256, 256, 64))
+  tasks = tc.create_transfer_tasks(
+    src_path, dest_path, chunk_size=(32, 32, 32),
+    shape=(128, 128, 64), num_mips=2,
+  )
+  run(tasks)
+  dest = Volume(dest_path)
+  assert dest.meta.chunk_size(0).tolist() == [32, 32, 32]
+  assert np.array_equal(dest[dest.bounds][..., 0], data)
+  expected = oracle.np_downsample_with_averaging(data, (2, 2, 1), 2)
+  for m in (1, 2):
+    out = dest.download(dest.meta.bounds(m), mip=m)
+    assert np.array_equal(out[..., 0], expected[m - 1])
+  prov = dest.provenance
+  assert prov["processing"][-1]["method"]["task"] == "TransferTask"
+
+
+def test_transfer_translate_and_encoding(tmp_path):
+  src_path = f"file://{tmp_path}/src"
+  dest_path = f"file://{tmp_path}/dest"
+  vol, data = make_seg_vol(src_path, shape=(64, 64, 32))
+  tasks = tc.create_transfer_tasks(
+    src_path, dest_path,
+    shape=(64, 64, 32),
+    translate=(64, 0, 0),
+    encoding="compressed_segmentation",
+    skip_downsamples=True,
+  )
+  run(tasks)
+  dest = Volume(dest_path)
+  assert dest.meta.encoding(0) == "compressed_segmentation"
+  assert dest.meta.voxel_offset(0).tolist() == [64, 0, 0]
+  assert np.array_equal(dest[dest.bounds][..., 0], data)
+
+
+def test_delete_task(tmp_path):
+  path = f"file://{tmp_path}/img"
+  vol, _ = make_image_vol(path, shape=(128, 128, 64))
+  run(tc.create_downsampling_tasks(
+    path, num_mips=1, memory_target=16 * 1024 * 1024))
+  run(tc.create_deletion_tasks(path, mip=0, num_mips=1))
+  vol = Volume(path)
+  assert list(vol.cf.list("4_4_40/")) == []
+  assert list(vol.cf.list("8_8_40/")) == []
+
+
+def test_blackout_and_touch(tmp_path):
+  path = f"file://{tmp_path}/img"
+  vol, data = make_image_vol(path, shape=(128, 128, 64))
+  run(tc.create_blackout_tasks(
+    path, Bbox((0, 0, 0), (64, 64, 64)), shape=(64, 64, 64), value=9))
+  vol = Volume(path)
+  out = vol[vol.bounds]
+  assert np.all(out[:64, :64, :64] == 9)
+  assert np.array_equal(out[64:, :, :, 0], data[64:])
+  run(tc.create_touch_tasks(path, shape=(128, 128, 64)))  # no exception
+
+
+def test_quantize_task(tmp_path):
+  src_path = f"file://{tmp_path}/aff"
+  rng = np.random.default_rng(3)
+  data = rng.random((64, 64, 32, 3)).astype(np.float32)
+  Volume.from_numpy(
+    data, src_path, layer_type="image", chunk_size=(64, 64, 32))
+  dest_path = f"file://{tmp_path}/qaff"
+  run(tc.create_quantize_tasks(
+    src_path, dest_path, shape=(64, 64, 32), chunk_size=(64, 64, 32)))
+  dest = Volume(dest_path)
+  out = dest[dest.bounds]
+  expected = np.clip(data[..., :1] * 255.0, 0, 255).astype(np.uint8)
+  assert np.array_equal(out, expected)
+
+
+def test_downsample_task_serialization_roundtrip(tmp_path):
+  from igneous_tpu.queues import deserialize, serialize
+
+  path = f"file://{tmp_path}/img"
+  make_image_vol(path, shape=(128, 128, 64))
+  tasks = list(tc.create_downsampling_tasks(
+    path, num_mips=1, memory_target=16 * 1024 * 1024))
+  t2 = deserialize(serialize(tasks[0]))
+  assert isinstance(t2, DownsampleTask)
+  t2.execute()
+  vol = Volume(path)
+  assert vol.meta.num_mips >= 2
+
+
+def test_task_iterator_slicing(tmp_path):
+  path = f"file://{tmp_path}/img"
+  make_image_vol(path, shape=(256, 256, 64))
+  it = tc.create_downsampling_tasks(
+    path, num_mips=1, memory_target=8 * 1024 * 1024)
+  n = len(it)
+  assert n > 1
+  first = list(it[: n // 2])
+  rest = list(it[n // 2:])
+  assert len(first) + len(rest) == n
+
+
+def test_transfer_at_higher_mip(tmp_path):
+  src_path = f"file://{tmp_path}/src"
+  dest_path = f"file://{tmp_path}/dest"
+  vol, data = make_image_vol(src_path, shape=(256, 256, 64))
+  run(tc.create_downsampling_tasks(
+    src_path, num_mips=1, memory_target=16 * 1024 * 1024))
+  src = Volume(src_path, mip=1)
+  mip1 = src.download(src.meta.bounds(1), mip=1)
+
+  tasks = tc.create_transfer_tasks(
+    src_path, dest_path, mip=1, shape=(128, 128, 64), num_mips=1)
+  run(tasks)
+  dest = Volume(dest_path, mip=1)
+  assert dest.meta.num_mips == 3  # mips 0 (empty), 1 (copied), 2 (downsampled)
+  out = dest.download(dest.meta.bounds(1), mip=1)
+  assert np.array_equal(out, mip1)
+  exp = oracle.np_downsample_with_averaging(mip1[..., 0], (2, 2, 1))[0]
+  out2 = dest.download(dest.meta.bounds(2), mip=2)
+  assert np.array_equal(out2[..., 0], exp)
+
+
+def test_uint32_average_exact(tmp_path):
+  from igneous_tpu.ops import pooling
+  rng = np.random.default_rng(21)
+  img = rng.integers(0, 2**32, size=(32, 32, 8)).astype(np.uint32)
+  dev = pooling.downsample(img, (2, 2, 2), 2, method="average")
+  exp = oracle.np_downsample_with_averaging(img, (2, 2, 2), 2)
+  for d, e in zip(dev, exp):
+    assert np.array_equal(d, e)
+
+
+def test_int64_mode_pooling(tmp_path):
+  from igneous_tpu.ops import pooling
+  rng = np.random.default_rng(22)
+  img = rng.integers(-2**62, 2**62, size=(16, 16, 4)).astype(np.int64)
+  img[0::2] = img[1::2]  # force majorities
+  dev = pooling.downsample(img, (2, 2, 1), 1, method="mode")
+  exp = oracle.np_downsample_segmentation(img, (2, 2, 1), 1)
+  assert dev[0].dtype == np.int64
+  assert np.array_equal(dev[0], exp[0])
+
+
+def test_num_mips_zero_creates_no_scales(tmp_path):
+  path = f"file://{tmp_path}/img"
+  make_image_vol(path, shape=(128, 128, 64))
+  list(tc.create_downsampling_tasks(
+    path, num_mips=0, memory_target=16 * 1024 * 1024))
+  vol = Volume(path)
+  assert vol.meta.num_mips == 1

@@ -1,0 +1,164 @@
+"""Queue substrate tests: registry serialization, LocalTaskQueue, FileQueue."""
+
+import functools
+import json
+import os
+import time
+
+import pytest
+
+from igneous_tpu.queues import (
+  FileQueue,
+  FunctionTask,
+  LocalTaskQueue,
+  MockTaskQueue,
+  PrintTask,
+  RegisteredTask,
+  TaskQueue,
+  deserialize,
+  queueable,
+  serialize,
+)
+from igneous_tpu.tasks import FailTask, TouchFileTask
+
+
+class BaseTask(RegisteredTask):
+  def __init__(self, shape, offset=(0, 0, 0)):
+    self.shape = shape
+    self.offset = offset
+
+  def execute(self):
+    return ("base", self.shape, self.offset)
+
+
+class ChildTask(BaseTask):
+  def __init__(self, shape, extra=5, offset=(0, 0, 0)):
+    super().__init__(shape, offset=offset)
+    self.extra = extra
+
+  def execute(self):
+    return ("child", self.shape, self.extra)
+
+
+@queueable
+def sample_fn(a, b=2):
+  return a + b
+
+
+def test_registered_task_roundtrip():
+  t = ChildTask([64, 64, 64], extra=9, offset=[1, 2, 3])
+  payload = t.to_json()
+  data = json.loads(payload)
+  # subclass params recorded, not the parent chain's
+  assert data["class"] == "ChildTask"
+  assert data["params"] == {"shape": [64, 64, 64], "extra": 9, "offset": [1, 2, 3]}
+  t2 = deserialize(payload)
+  assert isinstance(t2, ChildTask)
+  assert t2.execute() == ("child", [64, 64, 64], 9)
+  assert t2 == t
+
+
+def test_queueable_partial_roundtrip():
+  p = functools.partial(sample_fn, 10, b=7)
+  payload = serialize(p)
+  t = deserialize(payload)
+  assert isinstance(t, FunctionTask)
+  assert t.execute() == 17
+
+
+def test_serialize_rejects_unregistered_fn():
+  def nope(x):
+    return x
+
+  with pytest.raises(ValueError):
+    serialize(functools.partial(nope, 1))
+
+
+def test_local_queue_serial(tmp_path):
+  tq = LocalTaskQueue(parallel=1, progress=False)
+  tasks = [TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(5)]
+  tq.insert(tasks)
+  assert tq.completed == 5
+  assert all(os.path.exists(tmp_path / f"t{i}") for i in range(5))
+
+
+def test_local_queue_parallel_spawn(tmp_path):
+  tq = LocalTaskQueue(parallel=2, progress=False)
+  tasks = [TouchFileTask(path=str(tmp_path / f"p{i}")) for i in range(6)]
+  tq.insert(tasks)
+  assert all(os.path.exists(tmp_path / f"p{i}") for i in range(6))
+
+
+def test_mock_queue():
+  MockTaskQueue().insert(PrintTask("hi"))
+
+
+def test_filequeue_basic_lifecycle(tmp_path):
+  q = TaskQueue(f"fq://{tmp_path}/q")
+  assert isinstance(q, FileQueue)
+  q.insert([TouchFileTask(path=str(tmp_path / f"f{i}")) for i in range(3)])
+  assert q.enqueued == 3 and q.inserted == 3 and q.is_empty() is False
+
+  task, lease_id = q.lease(seconds=600)
+  assert isinstance(task, TouchFileTask)
+  assert q.leased == 1 and q.enqueued == 3
+  task.execute()
+  q.delete(lease_id)
+  assert q.enqueued == 2 and q.completed == 1
+
+
+def test_filequeue_lease_expiry_recycles(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(TouchFileTask(path=str(tmp_path / "x")))
+  leased = q.lease(seconds=0.05)
+  assert leased is not None
+  assert q.lease(seconds=600) is None  # nothing available while leased
+  time.sleep(0.1)
+  again = q.lease(seconds=600)  # expired lease recycled
+  assert again is not None
+  assert isinstance(again[0], TouchFileTask)
+
+
+def test_filequeue_release_all(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([PrintTask(str(i)) for i in range(4)])
+  q.lease(3600)
+  q.lease(3600)
+  assert q.leased == 2
+  q.release_all()
+  assert q.leased == 0 and len(os.listdir(q.queue_dir)) == 4
+
+
+def test_filequeue_poll_executes_all(tmp_path, capsys):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([TouchFileTask(path=str(tmp_path / f"w{i}")) for i in range(7)])
+
+  executed = q.poll(
+    lease_seconds=600,
+    stop_fn=lambda executed, empty: empty,
+  )
+  assert executed == 7
+  assert q.is_empty()
+  assert q.completed == 7
+  assert all(os.path.exists(tmp_path / f"w{i}") for i in range(7))
+
+
+def test_filequeue_failure_leaves_lease(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(FailTask())
+  executed = q.poll(lease_seconds=600, stop_fn=lambda executed, empty: empty)
+  assert executed == 0
+  assert q.leased == 1  # failed task stays leased, will recycle on expiry
+  assert q.completed == 0
+
+
+def test_filequeue_purge_and_rezero(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([PrintTask(str(i)) for i in range(3)])
+  q.purge()
+  assert q.is_empty() and q.inserted == 0
+
+
+def test_taskqueue_rejects_unknown_protocol():
+  with pytest.raises(ValueError):
+    TaskQueue("sqs://nope")
