@@ -149,6 +149,9 @@ class PrecomputedMetadata:
         return i
     raise KeyError(key)
 
+  def mip_from_resolution(self, resolution) -> int:
+    return self.mip_from_key("_".join(str(int(r)) for r in resolution))
+
   def resolution(self, mip: int) -> Vec:
     return Vec(*self.scale(mip)["resolution"])
 

@@ -2,21 +2,95 @@
 
 Reference behavior: cloud-volume's sharded image support, consumed by
 ImageShardTransferTask / ImageShardDownsampleTask
-(/root/reference/igneous/tasks/image/image.py:596-847).
-
-Implemented in concert with ``igneous_tpu.sharding`` (shard codec + hash
-math). ``download_sharded`` is the Volume.download hook for scales whose
-info carries a "sharding" key.
+(/root/reference/igneous/tasks/image/image.py:596-847). Chunk ids are
+compressed morton codes of grid coordinates; shard placement follows the
+scale's "sharding" spec (usually identity hash + preshift for locality).
 """
 
 from __future__ import annotations
 
-from .lib import Bbox
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import codecs
+from .lib import Bbox, Vec, chunk_bboxes
+from .sharding import ShardReader, ShardingSpecification, compressed_morton_code
 
 
-def download_sharded(vol, bbox: Bbox, mip: int):
-  """Returns [(chunk_bbox, chunk_array), ...] covering ``bbox``."""
-  raise NotImplementedError(
-    "Reading sharded scales is not implemented yet; "
-    "unshard with a TransferTask or read the unsharded scale."
-  )
+def _grid_geometry(vol, mip: int):
+  meta = vol.meta
+  cs = np.asarray(meta.chunk_size(mip), dtype=np.int64)
+  offset = np.asarray(meta.voxel_offset(mip), dtype=np.int64)
+  grid_size = np.ceil(
+    np.asarray(meta.volume_size(mip), dtype=np.int64) / cs
+  ).astype(np.int64)
+  return cs, offset, grid_size
+
+
+def chunk_morton_id(vol, chunk_bbx: Bbox, mip: int) -> int:
+  cs, offset, grid_size = _grid_geometry(vol, mip)
+  gridpt = (np.asarray(chunk_bbx.minpt) - offset) // cs
+  return int(compressed_morton_code(gridpt, grid_size))
+
+
+def download_sharded(vol, bbox: Bbox, mip: int) -> List[Tuple[Bbox, np.ndarray]]:
+  """Volume.download hook: [(stored_chunk_bbox, array), ...] covering bbox."""
+  meta = vol.meta
+  spec = ShardingSpecification.from_dict(meta.sharding(mip))
+  reader = ShardReader(vol.cf, spec, prefix=meta.key(mip))
+  bounds = meta.bounds(mip)
+
+  renders = []
+  for gchunk in chunk_bboxes(
+    bbox, meta.chunk_size(mip), offset=meta.voxel_offset(mip), clamp=False
+  ):
+    chunk_bbx = Bbox.intersection(gchunk, bounds)
+    if chunk_bbx.empty():
+      continue
+    cid = chunk_morton_id(vol, gchunk, mip)
+    data = reader.get_chunk(cid)
+    renders.append((chunk_bbx, vol._decode_chunk(data, chunk_bbx, mip)))
+  return renders
+
+
+def upload_shard(vol, bbox: Bbox, img: np.ndarray, mip: int):
+  """Write one task's worth of chunks as shard file(s).
+
+  ``bbox`` must be shard-aligned (or clipped at the dataset boundary) so
+  every chunk id belonging to each produced shard file is present —
+  sharded files are immutable and written exactly once.
+  """
+  meta = vol.meta
+  spec = ShardingSpecification.from_dict(meta.sharding(mip))
+  if img.ndim == 3:
+    img = img[..., np.newaxis]
+
+  encoding = meta.encoding(mip)
+  block_size = meta.cseg_block_size(mip)
+  bounds = meta.bounds(mip)
+
+  chunks: Dict[int, bytes] = {}
+  for gchunk in chunk_bboxes(
+    bbox, meta.chunk_size(mip), offset=meta.voxel_offset(mip), clamp=False
+  ):
+    chunk_bbx = Bbox.intersection(gchunk, bounds)
+    if chunk_bbx.empty():
+      continue
+    isect = Bbox.intersection(chunk_bbx, bbox)
+    if isect != chunk_bbx:
+      raise ValueError(
+        f"shard upload bbox {bbox} does not fully cover chunk {chunk_bbx}"
+      )
+    sl = tuple(
+      slice(int(a), int(b))
+      for a, b in zip(chunk_bbx.minpt - bbox.minpt, chunk_bbx.maxpt - bbox.minpt)
+    )
+    cid = chunk_morton_id(vol, gchunk, mip)
+    chunks[cid] = codecs.encode(img[sl], encoding, block_size=block_size)
+
+  files = spec.synthesize_shard_files(chunks)
+  prefix = meta.key(mip)
+  for filename, data in files.items():
+    # shard files carry their own internal compression; never gzip the file
+    vol.cf.put(f"{prefix}/{filename}", data, compress=None)

@@ -159,6 +159,14 @@ class _FileBackend:
     except FileNotFoundError:
       return None
 
+  def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
+    try:
+      with open(self._fullpath(key), "rb") as f:
+        f.seek(start)
+        return f.read(length)
+    except FileNotFoundError:
+      return None
+
   def exists(self, key: str) -> bool:
     return os.path.exists(self._fullpath(key))
 
@@ -202,6 +210,11 @@ class _MemBackend:
   def get(self, key: str) -> Optional[bytes]:
     with self.bucket.lock:
       return self.bucket.files.get(key)
+
+  def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
+    with self.bucket.lock:
+      data = self.bucket.files.get(key)
+    return None if data is None else data[start : start + length]
 
   def exists(self, key: str) -> bool:
     with self.bucket.lock:
@@ -305,6 +318,14 @@ class CloudFiles:
     if data is None:
       return None
     return data if raw else decompress_bytes(data, method)
+
+  def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
+    """Ranged read of an UNCOMPRESSED object (sharded-format reads).
+
+    Only the exact key is consulted: ranged reads into a gzip-compressed
+    object are meaningless, so no compression-extension fallback applies.
+    """
+    return self.backend.get_range(key, start, length)
 
   def get_json(self, key: str):
     data = self.get(key)

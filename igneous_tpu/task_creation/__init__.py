@@ -16,6 +16,8 @@ from .image import (
   create_blackout_tasks,
   create_deletion_tasks,
   create_downsampling_tasks,
+  create_image_shard_downsample_tasks,
+  create_image_shard_transfer_tasks,
   create_quantized_affinity_info,
   create_quantize_tasks,
   create_touch_tasks,

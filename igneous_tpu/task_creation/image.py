@@ -250,6 +250,185 @@ def create_transfer_tasks(
   return GridTaskIterator(task_bounds, shape, make_task, finish)
 
 
+def create_image_shard_transfer_tasks(
+  src_layer_path: str,
+  dest_layer_path: str,
+  mip: int = 0,
+  chunk_size: Optional[Sequence[int]] = None,
+  encoding: Optional[str] = None,
+  translate: Sequence[int] = (0, 0, 0),
+  dest_voxel_offset: Optional[Sequence[int]] = None,
+  fill_missing: bool = False,
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+  uncompressed_shard_bytesize: int = MEMORY_TARGET,
+):
+  """Transfer into a SHARDED destination scale
+  (reference: task_creation/image.py:507-637)."""
+  from ..sharding import create_sharded_image_info, image_shard_shape_from_spec
+  from ..tasks.image_sharded import ImageShardTransferTask
+
+  src = Volume(src_layer_path, mip=mip)
+  src_scale = src.meta.scale(mip)
+  dest_chunk = list(chunk_size) if chunk_size else src_scale["chunk_sizes"][0]
+  dest_offset = (
+    list(dest_voxel_offset)
+    if dest_voxel_offset is not None
+    else (np.asarray(src_scale.get("voxel_offset", [0, 0, 0]))
+          + np.asarray(translate)).tolist()
+  )
+  spec = create_sharded_image_info(
+    dataset_size=src_scale["size"],
+    chunk_size=dest_chunk,
+    encoding=encoding or src_scale["encoding"],
+    dtype=src.meta.data_type,
+    uncompressed_shard_bytesize=uncompressed_shard_bytesize,
+  )
+  # dest scale structure mirrors the source through `mip` so mip indices
+  # line up; dest_voxel_offset applies at mip 0 geometry
+  base_scale = src.meta.scale(0)
+  dest_info = Volume.create_new_info(
+    num_channels=src.num_channels,
+    layer_type=src.layer_type,
+    data_type=src.meta.data_type,
+    encoding=encoding or base_scale["encoding"],
+    resolution=base_scale["resolution"],
+    voxel_offset=(
+      dest_offset if mip == 0
+      else base_scale.get("voxel_offset", [0, 0, 0])
+    ),
+    volume_size=base_scale["size"],
+    chunk_size=dest_chunk,
+  )
+  try:
+    dest = Volume(dest_layer_path)
+  except FileNotFoundError:
+    dest = Volume.create(dest_layer_path, dest_info)
+    for m in range(1, mip + 1):
+      dest.meta.add_scale(
+        np.asarray(src.meta.downsample_ratio(m)),
+        chunk_size=dest_chunk,
+        encoding=encoding or src.meta.encoding(m),
+      )
+    if mip > 0 and dest_voxel_offset is not None:
+      dest.meta.scale(mip)["voxel_offset"] = list(dest_voxel_offset)
+  # the computed sharding spec always lands on the scale tasks write to —
+  # including when the destination layer already existed
+  dest.meta.scale(mip)["sharding"] = spec
+  dest.commit_info()
+
+  shape = Vec(*image_shard_shape_from_spec(
+    spec, src_scale["size"], dest_chunk
+  ))
+  # shard files are immutable: the task grid must be shard-aligned so no
+  # two tasks emit the same shard file
+  task_bounds = get_bounds(src, bounds, mip, bounds_mip)
+  task_bounds = task_bounds.expand_to_chunk_size(
+    shape, src.meta.voxel_offset(mip)
+  ).clamp(src.meta.bounds(mip))
+
+  def make_task(shape_: Vec, offset: Vec):
+    return ImageShardTransferTask(
+      src_path=src_layer_path,
+      dest_path=dest_layer_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      fill_missing=fill_missing,
+      translate=tuple(translate),
+    )
+
+  def finish():
+    _provenance(dest, {
+      "task": "ImageShardTransferTask",
+      "src": src_layer_path, "dest": dest_layer_path,
+      "mip": mip, "shape": shape.tolist(),
+      "sharding": spec,
+      "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_image_shard_downsample_tasks(
+  layer_path: str,
+  mip: int = 0,
+  fill_missing: bool = False,
+  sparse: bool = False,
+  chunk_size: Optional[Sequence[int]] = None,
+  encoding: Optional[str] = None,
+  factor: Sequence[int] = (2, 2, 1),
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+  memory_target: int = MEMORY_TARGET,
+  downsample_method: str = "auto",
+):
+  """One downsampled SHARDED mip per pass
+  (reference: task_creation/image.py:639-807; the reference likewise emits
+  one mip per sharded pass because a shard must be written complete)."""
+  from ..sharding import create_sharded_image_info, image_shard_shape_from_spec
+  from ..tasks.image_sharded import ImageShardDownsampleTask
+
+  vol = Volume(layer_path, mip=mip)
+  factor = tuple(int(v) for v in factor)
+  cs = list(chunk_size) if chunk_size else [int(v) for v in vol.meta.chunk_size(mip)]
+
+  dest_size = [
+    int(v) for v in -(-np.asarray(vol.meta.volume_size(mip)) //
+                      np.asarray(factor))
+  ]
+  spec = create_sharded_image_info(
+    dataset_size=dest_size,
+    chunk_size=cs,
+    encoding=encoding or vol.meta.encoding(mip),
+    dtype=vol.meta.data_type,
+    # shard task must hold source region = shard * prod(factor) voxels
+    uncompressed_shard_bytesize=int(
+      memory_target // (int(np.prod(factor)) + 1)
+    ),
+  )
+  base_ratio = np.asarray(vol.meta.downsample_ratio(mip), dtype=np.int64)
+  vol.meta.add_scale(
+    base_ratio * np.asarray(factor), chunk_size=cs,
+    encoding=encoding, sharding=spec,
+  )
+  vol.commit_info()
+  dest_mip = vol.meta.mip_from_key("_".join(
+    str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
+    * base_ratio * np.asarray(factor)
+  ))
+
+  shard_shape = image_shard_shape_from_spec(spec, dest_size, cs)
+  shape = Vec(*(np.asarray(shard_shape) * np.asarray(factor)))
+  # shard-align the task grid: shard files are write-once
+  task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
+  task_bounds = task_bounds.expand_to_chunk_size(
+    shape, vol.meta.voxel_offset(mip)
+  ).clamp(vol.meta.bounds(mip))
+
+  def make_task(shape_: Vec, offset: Vec):
+    return ImageShardDownsampleTask(
+      src_path=layer_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      fill_missing=fill_missing,
+      sparse=sparse,
+      factor=list(factor),
+      downsample_method=downsample_method,
+    )
+
+  def finish():
+    _provenance(vol, {
+      "task": "ImageShardDownsampleTask",
+      "mip": mip, "dest_mip": dest_mip,
+      "factor": list(factor), "sharding": spec,
+      "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
 def create_deletion_tasks(
   layer_path: str,
   mip: int = 0,

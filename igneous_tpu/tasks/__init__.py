@@ -15,6 +15,7 @@ from .image import (
   TransferTask,
   downsample_and_upload,
 )
+from .image_sharded import ImageShardDownsampleTask, ImageShardTransferTask
 
 
 class TouchFileTask(RegisteredTask):

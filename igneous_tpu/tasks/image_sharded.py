@@ -1,0 +1,108 @@
+"""Sharded image tasks.
+
+Reference parity: ImageShardTransferTask
+(/root/reference/igneous/tasks/image/image.py:596-679) and
+ImageShardDownsampleTask (:681-847). One task produces complete shard
+file(s): shard files are immutable, so the task grid is shard-aligned.
+
+TPU-first difference: the reference's z-stripe renumber loop exists to fit
+64-bit labels in RAM; here the cutout goes to the device whole (uint64 as
+hi/lo planes) and one program emits the downsampled shard content.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec
+from ..queues.registry import RegisteredTask
+from ..volume import Volume
+from ..ops import pooling
+from ..sharded_image import upload_shard
+
+
+class ImageShardTransferTask(RegisteredTask):
+  """Copy a shard-aligned cutout into a sharded destination scale."""
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    fill_missing: bool = False,
+    translate: Sequence[int] = (0, 0, 0),
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.fill_missing = fill_missing
+    self.translate = Vec(*translate)
+
+  def execute(self):
+    src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
+    dest = Volume(self.dest_path, mip=self.mip)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), src.bounds
+    )
+    if bounds.empty():
+      return
+    img = src.download(bounds)
+    upload_shard(dest, bounds.translate(self.translate), img, self.mip)
+
+
+class ImageShardDownsampleTask(RegisteredTask):
+  """Downsample a shard-aligned region of mip into sharded mip+1.
+
+  The task bbox (shape/offset, in source-mip coords) covers exactly one
+  destination shard (or its dataset-edge remainder)."""
+
+  def __init__(
+    self,
+    src_path: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    fill_missing: bool = False,
+    sparse: bool = False,
+    factor: Sequence[int] = (2, 2, 1),
+    downsample_method: str = "auto",
+  ):
+    self.src_path = src_path
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.fill_missing = fill_missing
+    self.sparse = sparse
+    self.factor = Vec(*factor)
+    self.downsample_method = downsample_method
+
+  def execute(self):
+    vol = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), vol.bounds
+    )
+    if bounds.empty():
+      return
+    img = vol.download(bounds)
+    method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
+    mipped = pooling.downsample(
+      img, tuple(int(v) for v in self.factor), 1, method=method,
+      sparse=self.sparse,
+    )[0]
+    # resolve the destination scale by resolution, not positional index:
+    # add_scale keeps scales sorted, so mip+1 is not guaranteed to be ours
+    dest_res = np.asarray(vol.meta.resolution(self.mip)) * np.asarray(
+      [int(v) for v in self.factor]
+    )
+    dest_mip = vol.meta.mip_from_resolution(dest_res)
+    dest_min = bounds.minpt // self.factor
+    dest_bounds = Bbox(dest_min, dest_min + Vec(*mipped.shape[:3]))
+    dest_bounds = Bbox.intersection(dest_bounds, vol.meta.bounds(dest_mip))
+    sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
+    upload_shard(vol, dest_bounds, mipped[sl], dest_mip)
