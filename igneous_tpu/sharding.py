@@ -452,7 +452,12 @@ def create_sharded_image_info(
     "minishard_bits": minishard_bits,
     "shard_bits": shard_bits,
     "minishard_index_encoding": "gzip",
-    "data_encoding": "gzip" if encoding in ("raw",) else "raw",
+    # gzip everything except codecs that are already entropy-coded
+    # (reference rule: task_creation/image.py:494-495)
+    "data_encoding": (
+      "raw" if encoding in ("jpeg", "png", "jpegxl", "fpzip", "zfpc", "jxl")
+      else "gzip"
+    ),
   }
 
 

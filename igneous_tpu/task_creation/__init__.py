@@ -11,6 +11,14 @@ from .common import (
   num_tasks,
   operator_contact,
 )
+from .ccl import (
+  ccl_auto,
+  clean_ccl_files,
+  create_ccl_equivalence_tasks,
+  create_ccl_face_tasks,
+  create_ccl_relabel_tasks,
+  create_relabeling,
+)
 from .image import (
   MEMORY_TARGET,
   create_blackout_tasks,

@@ -16,6 +16,7 @@ from .image import (
   downsample_and_upload,
 )
 from .image_sharded import ImageShardDownsampleTask, ImageShardTransferTask
+from .ccl import CCLEquivalancesTask, CCLFacesTask, RelabelCCLTask
 
 
 class TouchFileTask(RegisteredTask):

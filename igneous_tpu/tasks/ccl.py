@@ -1,0 +1,282 @@
+"""Whole-image connected components labeling — the 4-pass protocol.
+
+Reference parity: /root/reference/igneous/tasks/image/ccl.py
+  pass 1 CCLFacesTask        (:126-194)  local CCL → store 3 back faces
+  pass 2 CCLEquivalancesTask (:196-294)  link faces of adjacent tasks
+  pass 3 create_relabeling   (:358-420)  single-machine global union-find
+  pass 4 RelabelCCLTask      (:296-356)  recompute + remap + write dest
+
+Key invariants kept from the reference design:
+  - every pass recomputes the identical deterministic local CCL
+    (ops.ccl.connected_components is deterministic);
+  - label offsets are task_num * voxels_per_cutout so local ids never
+    collide globally (reference ccl.py:75-87);
+  - cross-task data flows through the object store only (faces,
+    equivalence JSONs, relabel maps) — no network collectives;
+  - the +1 overlap cutout is blacked out on its "rails" (voxels extended
+    in ≥2 axes) so 6-connectivity merges are exactly the ones face planes
+    witness (reference ccl.py:103-124).
+
+The local CCL itself runs on device (pointer-doubling label propagation).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..lib import Bbox, Vec, jsonify
+from ..queues.registry import RegisteredTask
+from ..storage import CloudFiles
+from ..volume import Volume
+from ..ops.ccl import DisjointSet, connected_components, threshold_image
+from ..ops import remap as fastremap
+
+
+def _npy_bytes(arr: np.ndarray) -> bytes:
+  buf = io.BytesIO()
+  np.save(buf, arr)
+  return gzip.compress(buf.getvalue(), compresslevel=4)
+
+
+def _npy_load(data: bytes) -> np.ndarray:
+  return np.load(io.BytesIO(gzip.decompress(data)))
+
+
+def ccl_scratch_path(dest_path: str, mip: int) -> str:
+  return f"ccl/{mip}"
+
+
+def label_offset(task_num: int, shape: Sequence[int]) -> int:
+  """Task-local → global label offset: task_num × cutout voxels
+  (cutout = shape + 1 overlap; reference ccl.py:75-87)."""
+  vox = int(np.prod(np.asarray(shape, dtype=np.int64) + 1))
+  return task_num * vox
+
+
+def _download_and_ccl(
+  src_path: str,
+  mip: int,
+  shape: Vec,
+  offset: Vec,
+  task_num: int,
+  fill_missing: bool,
+  threshold_gte: Optional[float],
+  threshold_lte: Optional[float],
+) -> Tuple[np.ndarray, Bbox, Bbox]:
+  """The deterministic shared pass: cutout+1 → threshold → rails blackout
+  → device CCL → +offset. Returns (labels_u64, cutout_bbox, core_bbox)."""
+  vol = Volume(src_path, mip=mip, fill_missing=fill_missing, bounded=False)
+  bounds = vol.meta.bounds(mip)
+  core = Bbox.intersection(Bbox(offset, offset + shape), bounds)
+  cutout = Bbox.intersection(Bbox(offset, offset + shape + 1), bounds)
+
+  img = vol.download(cutout)[..., 0]
+  img = threshold_image(img, threshold_gte, threshold_lte)
+
+  # rails blackout: voxels extended past the core in ≥2 axes
+  ext_counts = np.zeros(img.shape, dtype=np.uint8)
+  for axis in range(3):
+    if cutout.maxpt[axis] > core.maxpt[axis]:
+      sl = [slice(None)] * 3
+      sl[axis] = slice(int(core.maxpt[axis] - cutout.minpt[axis]), None)
+      ext = np.zeros(img.shape, dtype=np.uint8)
+      ext[tuple(sl)] = 1
+      ext_counts += ext
+  img[ext_counts >= 2] = 0
+
+  cc = connected_components(img).astype(np.uint64)
+  cc[cc != 0] += np.uint64(label_offset(task_num, shape))
+  return cc, cutout, core
+
+
+class CCLFacesTask(RegisteredTask):
+  """Pass 1: per-task CCL; store the 3 overlap ('back') face planes."""
+
+  def __init__(
+    self,
+    src_path: str,
+    mip: int,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    task_num: int,
+    fill_missing: bool = False,
+    threshold_gte: Optional[float] = None,
+    threshold_lte: Optional[float] = None,
+  ):
+    self.src_path = src_path
+    self.mip = int(mip)
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.task_num = int(task_num)
+    self.fill_missing = fill_missing
+    self.threshold_gte = threshold_gte
+    self.threshold_lte = threshold_lte
+
+  def execute(self):
+    cc, cutout, core = _download_and_ccl(
+      self.src_path, self.mip, self.shape, self.offset, self.task_num,
+      self.fill_missing, self.threshold_gte, self.threshold_lte,
+    )
+    cf = CloudFiles(self.src_path)
+    scratch = ccl_scratch_path(self.src_path, self.mip)
+    for axis, name in enumerate("xyz"):
+      if cutout.maxpt[axis] > core.maxpt[axis]:
+        sl = [slice(None)] * 3
+        sl[axis] = int(cutout.size3()[axis]) - 1
+        face = cc[tuple(sl)]
+        cf.put(
+          f"{scratch}/faces/{self.task_num}-{name}.npy.gz",
+          _npy_bytes(face),
+        )
+
+
+class CCLEquivalancesTask(RegisteredTask):
+  """Pass 2: recompute local CCL; link first planes against the previous
+  task's stored back faces; emit (all local labels, equivalence pairs)."""
+
+  def __init__(
+    self,
+    src_path: str,
+    mip: int,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    task_num: int,
+    grid_size: Sequence[int],
+    fill_missing: bool = False,
+    threshold_gte: Optional[float] = None,
+    threshold_lte: Optional[float] = None,
+  ):
+    self.src_path = src_path
+    self.mip = int(mip)
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.task_num = int(task_num)
+    self.grid_size = Vec(*grid_size)
+    self.fill_missing = fill_missing
+    self.threshold_gte = threshold_gte
+    self.threshold_lte = threshold_lte
+
+  def execute(self):
+    cc, cutout, core = _download_and_ccl(
+      self.src_path, self.mip, self.shape, self.offset, self.task_num,
+      self.fill_missing, self.threshold_gte, self.threshold_lte,
+    )
+    cf = CloudFiles(self.src_path)
+    scratch = ccl_scratch_path(self.src_path, self.mip)
+    gx, gy, gz = (int(v) for v in self.grid_size)
+    coord = (
+      self.task_num % gx,
+      (self.task_num // gx) % gy,
+      self.task_num // (gx * gy),
+    )
+    strides = (1, gx, gx * gy)
+
+    pairs = set()
+    for axis, name in enumerate("xyz"):
+      if coord[axis] == 0:
+        continue
+      neighbor = self.task_num - strides[axis]
+      data = cf.get(f"{scratch}/faces/{neighbor}-{name}.npy.gz")
+      if data is None:
+        continue
+      their_face = _npy_load(data)
+      sl = [slice(None)] * 3
+      sl[axis] = 0  # our first plane == their stored overlap plane
+      my_face = cc[tuple(sl)]
+      if their_face.shape != my_face.shape:
+        # dataset-edge clamping can shave a row; compare the intersection
+        mins = tuple(min(a, b) for a, b in zip(their_face.shape, my_face.shape))
+        their_face = their_face[: mins[0], : mins[1]]
+        my_face = my_face[: mins[0], : mins[1]]
+      icm = fastremap.inverse_component_map(my_face, their_face)
+      for mine, theirs in icm.items():
+        for t in theirs.tolist():
+          pairs.add((int(mine), int(t)))
+
+    labels = [int(v) for v in np.unique(cc) if v != 0]
+    cf.put_json(
+      f"{scratch}/equivalences/{self.task_num}.json",
+      {"labels": labels, "pairs": sorted(pairs)},
+    )
+
+
+def create_relabeling(src_path: str, mip: int = 0) -> int:
+  """Pass 3 (single machine, reference ccl.py:358-420): global union-find
+  over all equivalence files → per-task relabel maps + max_label.json.
+  Returns the final component count."""
+  cf = CloudFiles(src_path)
+  scratch = ccl_scratch_path(src_path, mip)
+  ds = DisjointSet()
+  task_labels = {}  # task_num -> [labels]
+  for key in cf.list(f"{scratch}/equivalences/"):
+    doc = cf.get_json(key)
+    task_num = int(key.split("/")[-1].split(".")[0])
+    task_labels[task_num] = doc["labels"]
+    for lbl in doc["labels"]:
+      ds.makeset(lbl)
+    for a, b in doc["pairs"]:
+      ds.union(a, b)
+
+  mapping, max_label = ds.renumber(start=1)
+  for task_num, labels in task_labels.items():
+    cf.put_json(
+      f"{scratch}/relabel/{task_num}.json",
+      {str(lbl): mapping[lbl] for lbl in labels},
+    )
+  cf.put_json(f"{scratch}/max_label.json", {"max_label": max_label})
+  return max_label
+
+
+class RelabelCCLTask(RegisteredTask):
+  """Pass 4: recompute local CCL, apply the global relabel map, crop the
+  overlap, and write the destination segmentation."""
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    mip: int,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    task_num: int,
+    fill_missing: bool = False,
+    threshold_gte: Optional[float] = None,
+    threshold_lte: Optional[float] = None,
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.mip = int(mip)
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.task_num = int(task_num)
+    self.fill_missing = fill_missing
+    self.threshold_gte = threshold_gte
+    self.threshold_lte = threshold_lte
+
+  def execute(self):
+    cc, cutout, core = _download_and_ccl(
+      self.src_path, self.mip, self.shape, self.offset, self.task_num,
+      self.fill_missing, self.threshold_gte, self.threshold_lte,
+    )
+    cf = CloudFiles(self.src_path)
+    scratch = ccl_scratch_path(self.src_path, self.mip)
+    table = cf.get_json(f"{scratch}/relabel/{self.task_num}.json")
+    if table is None:
+      raise FileNotFoundError(
+        f"No relabel map for task {self.task_num}; run create_relabeling"
+      )
+    table = {np.uint64(k): np.uint64(v) for k, v in table.items()}
+    table[np.uint64(0)] = np.uint64(0)
+    out = fastremap.remap(cc, table)
+
+    sl = tuple(
+      slice(int(a), int(b))
+      for a, b in zip(core.minpt - cutout.minpt, core.maxpt - cutout.minpt)
+    )
+    dest = Volume(self.dest_path, mip=self.mip)
+    dest.upload(core, out[sl].astype(dest.dtype))

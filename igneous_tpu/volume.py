@@ -44,25 +44,10 @@ class EmptyVolumeError(VolumeException):
 
 
 def _renumber(img: np.ndarray, preserve_zero: bool = True):
-  """Relabel to a dense range starting at 1 (0 preserved). Returns
-  (renumbered, mapping) where mapping[new] = old. fastremap.renumber parity."""
-  uniq = np.unique(img)
-  if preserve_zero:
-    uniq = uniq[uniq != 0]
-  n = len(uniq)
-  if n < np.iinfo(np.uint16).max:
-    dtype = np.uint16
-  elif n < np.iinfo(np.uint32).max:
-    dtype = np.uint32
-  else:
-    dtype = np.uint64
-  out = np.searchsorted(uniq, img).astype(dtype) + 1
-  if preserve_zero:
-    out[img == 0] = 0
-  mapping = {int(i + 1): int(v) for i, v in enumerate(uniq)}
-  if preserve_zero:
-    mapping[0] = 0
-  return out, mapping
+  """fastremap.renumber parity; see ops.remap (single implementation)."""
+  from .ops.remap import renumber
+
+  return renumber(img, start=1, preserve_zero=preserve_zero)
 
 
 class Volume:
