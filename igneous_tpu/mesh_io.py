@@ -1,0 +1,250 @@
+"""Mesh container, Precomputed mesh codec, simplification, .frags container.
+
+Reference equivalents: zmesh's Mesh type + cloud-volume's mesh IO
+(/root/reference/igneous/tasks/mesh/mesh.py:385-450) and the mapbuffer
+``.frags`` container (SURVEY.md §2.3 mapbuffer). Draco encoding is a
+pluggable hook (register_draco_codec): no draco codec ships in this
+environment, and the default interchange format is Precomputed legacy
+(raw little-endian), which Neuroglancer reads natively.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+def drop_degenerate_faces(faces: np.ndarray) -> np.ndarray:
+  """Remove faces that reference the same vertex index twice."""
+  ok = (
+    (faces[:, 0] != faces[:, 1])
+    & (faces[:, 1] != faces[:, 2])
+    & (faces[:, 0] != faces[:, 2])
+  )
+  return faces[ok]
+
+
+class Mesh:
+  """Triangle mesh: vertices (V,3) float32 physical units, faces (F,3) uint32."""
+
+  def __init__(self, vertices: np.ndarray, faces: np.ndarray):
+    self.vertices = np.asarray(vertices, dtype=np.float32).reshape(-1, 3)
+    self.faces = np.asarray(faces, dtype=np.uint32).reshape(-1, 3)
+
+  def __len__(self) -> int:
+    return len(self.vertices)
+
+  def __eq__(self, other) -> bool:
+    return (
+      isinstance(other, Mesh)
+      and np.array_equal(self.vertices, other.vertices)
+      and np.array_equal(self.faces, other.faces)
+    )
+
+  def clone(self) -> "Mesh":
+    return Mesh(self.vertices.copy(), self.faces.copy())
+
+  @classmethod
+  def concatenate(cls, *meshes: "Mesh") -> "Mesh":
+    if not meshes:
+      return cls(np.zeros((0, 3), np.float32), np.zeros((0, 3), np.uint32))
+    verts = []
+    faces = []
+    voff = 0
+    for m in meshes:
+      verts.append(m.vertices)
+      faces.append(m.faces + np.uint32(voff))
+      voff += len(m.vertices)
+    return cls(np.concatenate(verts), np.concatenate(faces))
+
+  def consolidate(self) -> "Mesh":
+    """Weld duplicate vertices and drop degenerate faces."""
+    if len(self.vertices) == 0:
+      return self.clone()
+    uniq, inverse = np.unique(self.vertices, axis=0, return_inverse=True)
+    faces = inverse[self.faces.astype(np.int64)].astype(np.uint32)
+    return Mesh(uniq, drop_degenerate_faces(faces))
+
+  # -- codecs ---------------------------------------------------------------
+
+  def to_precomputed(self) -> bytes:
+    """Neuroglancer legacy mesh: uint32le V, float32le xyz*V, uint32le faces."""
+    return (
+      struct.pack("<I", len(self.vertices))
+      + self.vertices.astype("<f4").tobytes()
+      + self.faces.astype("<u4").tobytes()
+    )
+
+  @classmethod
+  def from_precomputed(cls, data: bytes) -> "Mesh":
+    (nverts,) = struct.unpack("<I", data[:4])
+    vend = 4 + nverts * 12
+    vertices = np.frombuffer(data[4:vend], dtype="<f4").reshape(-1, 3)
+    faces = np.frombuffer(data[vend:], dtype="<u4").reshape(-1, 3)
+    return cls(vertices.copy(), faces.copy())
+
+
+# draco hook: a deployment with a draco codec registers (encode, decode)
+_DRACO_CODEC = None
+
+
+def register_draco_codec(encode_fn, decode_fn):
+  global _DRACO_CODEC
+  _DRACO_CODEC = (encode_fn, decode_fn)
+
+
+def encode_mesh(mesh: Mesh, encoding: str = "precomputed", **kw) -> bytes:
+  if encoding == "precomputed":
+    return mesh.to_precomputed()
+  if encoding == "draco":
+    if _DRACO_CODEC is None:
+      raise NotImplementedError(
+        "No draco codec in this environment; register one with "
+        "mesh_io.register_draco_codec or use encoding='precomputed'."
+      )
+    return _DRACO_CODEC[0](mesh, **kw)
+  raise ValueError(f"Unknown mesh encoding: {encoding}")
+
+
+def decode_mesh(data: bytes, encoding: str = "precomputed") -> Mesh:
+  if encoding == "precomputed":
+    return Mesh.from_precomputed(data)
+  if encoding == "draco":
+    if _DRACO_CODEC is None:
+      raise NotImplementedError("No draco codec registered")
+    return _DRACO_CODEC[1](data)
+  raise ValueError(f"Unknown mesh encoding: {encoding}")
+
+
+# ---------------------------------------------------------------------------
+# simplification
+
+
+def simplify(
+  mesh: Mesh,
+  reduction_factor: float = 100.0,
+  max_error: float = 40.0,
+  max_iters: int = 8,
+) -> Mesh:
+  """Vertex-clustering simplification (grid collapse to cluster centroids).
+
+  Capability stand-in for zmesh's quadratic edge collapse
+  (reference mesh.py:371-383): target ≈ faces/reduction_factor faces with
+  cluster size capped at max_error physical units. Clustering is fully
+  vectorized (sort + segment mean) so it keeps up with device meshing
+  throughput; a QEM simplifier can replace it behind the same signature.
+  """
+  if len(mesh.faces) == 0 or reduction_factor <= 1:
+    return mesh.clone()
+
+  target_faces = max(int(len(mesh.faces) / reduction_factor), 4)
+  lo_cell = 1e-3
+  extent = mesh.vertices.max(axis=0) - mesh.vertices.min(axis=0)
+  hi_cell = float(max(extent.max(), 1.0))
+  if max_error is not None and max_error > 0:
+    hi_cell = min(hi_cell, float(max_error))
+
+  best = mesh
+  cell = hi_cell
+  for _ in range(max_iters):
+    m = _cluster_collapse(mesh, cell)
+    if len(m.faces) >= target_faces or cell >= hi_cell:
+      best = m
+    if len(m.faces) < target_faces:
+      cell *= 0.5
+    else:
+      break
+  return best if len(best.faces) > 0 else mesh.clone()
+
+
+def _cluster_collapse(mesh: Mesh, cell: float) -> Mesh:
+  keys = np.floor(mesh.vertices / max(cell, 1e-6)).astype(np.int64)
+  uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+  # centroid per cluster
+  sums = np.zeros((len(uniq), 3), dtype=np.float64)
+  np.add.at(sums, inverse, mesh.vertices)
+  counts = np.bincount(inverse, minlength=len(uniq)).astype(np.float64)
+  centroids = (sums / counts[:, None]).astype(np.float32)
+  faces = inverse[mesh.faces.astype(np.int64)].astype(np.uint32)
+  return Mesh(centroids, drop_degenerate_faces(faces))
+
+
+# ---------------------------------------------------------------------------
+# .frags container (mapbuffer equivalent)
+
+
+class FragMap:
+  """Zero-parse random-access uint64 → bytes container.
+
+  Capability parity with mapbuffer's MapBuffer (the ``.frags`` files of
+  sharded mesh/skeleton stage 1, reference tasks/mesh/mesh.py:385-397).
+  Layout (little endian):
+    magic b'IGFM' | uint32 version | uint64 N
+    uint64 keys[N] (sorted) | uint64 offsets[N+1] (into blob section)
+    blobs
+  Lookups binary-search the key table; nothing else is parsed.
+  """
+
+  MAGIC = b"IGFM"
+
+  def __init__(self, data: bytes):
+    if data[:4] != self.MAGIC:
+      raise ValueError("not a FragMap")
+    self._data = data
+    (self._n,) = struct.unpack_from("<Q", data, 8)
+    ko = 16
+    self._keys = np.frombuffer(data, dtype="<u8", count=self._n, offset=ko)
+    self._offsets = np.frombuffer(
+      data, dtype="<u8", count=self._n + 1, offset=ko + 8 * self._n
+    )
+    self._blob0 = ko + 8 * self._n + 8 * (self._n + 1)
+
+  @classmethod
+  def frombytes(cls, data: bytes) -> "FragMap":
+    return cls(data)
+
+  @classmethod
+  def tobytes(cls, mapping: Dict[int, bytes]) -> bytes:
+    keys = sorted(mapping.keys())
+    blobs = [mapping[k] for k in keys]
+    offsets = np.zeros(len(keys) + 1, dtype="<u8")
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return b"".join([
+      cls.MAGIC,
+      struct.pack("<I", 1),
+      struct.pack("<Q", len(keys)),
+      np.asarray(keys, dtype="<u8").tobytes(),
+      offsets.tobytes(),
+      *blobs,
+    ])
+
+  def __len__(self) -> int:
+    return int(self._n)
+
+  def __contains__(self, key: int) -> bool:
+    return self.get(key) is not None
+
+  def keys(self) -> np.ndarray:
+    return self._keys
+
+  def get(self, key: int) -> Optional[bytes]:
+    i = int(np.searchsorted(self._keys, np.uint64(key)))
+    if i >= self._n or self._keys[i] != np.uint64(key):
+      return None
+    a = self._blob0 + int(self._offsets[i])
+    b = self._blob0 + int(self._offsets[i + 1])
+    return self._data[a:b]
+
+  def __getitem__(self, key: int) -> bytes:
+    out = self.get(key)
+    if out is None:
+      raise KeyError(key)
+    return out
+
+  def items(self) -> Iterator[Tuple[int, bytes]]:
+    for i in range(self._n):
+      a = self._blob0 + int(self._offsets[i])
+      b = self._blob0 + int(self._offsets[i + 1])
+      yield int(self._keys[i]), self._data[a:b]

@@ -1,0 +1,240 @@
+"""Device isosurface extraction — the zmesh (marching cubes) equivalent.
+
+Replaces the reference's zmesh C++ mesher for MeshTask
+(/root/reference/igneous/tasks/mesh/mesh.py:245 ``Mesher.mesh(data)``).
+
+TPU-first design: marching TETRAHEDRA instead of marching cubes. Each cell
+splits into 6 tetrahedra sharing the main diagonal; a tet has only 16
+sign cases, so the full case tables are generated programmatically at
+import (no hand-copied 256-entry MC tables), and per-cell work is a pure
+table-gather + arithmetic — exactly what vectorizes on the VPU. The
+surface is watertight and sits at the 0.5 iso-level of the binary mask
+(vertices at edge midpoints, like zmesh on binary masks).
+
+Variable-size output uses the two-pass count/emit pattern (SURVEY.md §7
+"hard parts"): kernel 1 computes the per-slot validity mask and total
+count; host sizes a static capacity; kernel 2 gathers only the valid
+slots and emits vertex coordinates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# cube corner i sits at offset (i&1, i>>1&1, i>>2&1)
+CORNER_OFFSETS = np.array(
+  [[(i >> d) & 1 for d in range(3)] for i in range(8)], dtype=np.float32
+)
+# 6-tet decomposition sharing the 0-7 diagonal
+TETS = np.array(
+  [
+    (0, 1, 3, 7),
+    (0, 3, 2, 7),
+    (0, 2, 6, 7),
+    (0, 6, 4, 7),
+    (0, 4, 5, 7),
+    (0, 5, 1, 7),
+  ],
+  dtype=np.int32,
+)
+
+
+def _build_tables():
+  """NTRIS[tet, case] and EDGES[tet, case, tri, vtx, 2] (cube corner pairs).
+
+  Triangles are oriented so normals point from inside (mask=1) to outside.
+  """
+  ntris = np.zeros((6, 16), dtype=np.int32)
+  edges = np.zeros((6, 16, 2, 3, 2), dtype=np.int32)
+
+  for t, tet in enumerate(TETS):
+    pts = CORNER_OFFSETS[tet]  # (4, 3) canonical coords
+    for case in range(16):
+      inside = [j for j in range(4) if (case >> j) & 1]
+      outside = [j for j in range(4) if not (case >> j) & 1]
+      tris = []  # list of [(a_local, b_local) x3]
+      if len(inside) == 1:
+        v = inside[0]
+        tris.append([(v, outside[0]), (v, outside[1]), (v, outside[2])])
+      elif len(inside) == 3:
+        v = outside[0]
+        tris.append([(inside[0], v), (inside[1], v), (inside[2], v)])
+      elif len(inside) == 2:
+        i0, i1 = inside
+        o0, o1 = outside
+        # cut quad in cyclic order
+        quad = [(i0, o0), (i1, o0), (i1, o1), (i0, o1)]
+        tris.append([quad[0], quad[1], quad[2]])
+        tris.append([quad[0], quad[2], quad[3]])
+
+      if not tris:
+        continue
+      in_centroid = pts[inside].mean(axis=0) if inside else pts.mean(axis=0)
+      for k, tri in enumerate(tris):
+        mids = np.array([(pts[a] + pts[b]) / 2.0 for a, b in tri])
+        n = np.cross(mids[1] - mids[0], mids[2] - mids[0])
+        outward = mids.mean(axis=0) - in_centroid
+        if np.dot(n, outward) < 0:
+          tri = [tri[0], tri[2], tri[1]]
+        for v, (a, b) in enumerate(tri):
+          edges[t, case, k, v, 0] = tet[a]
+          edges[t, case, k, v, 1] = tet[b]
+      ntris[t, case] = len(tris)
+  return ntris, edges
+
+
+NTRIS_TABLE, EDGES_TABLE = _build_tables()
+MAX_SLOTS_PER_CELL = 12  # 6 tets x 2 triangles
+
+
+def _case_list(mask: jnp.ndarray):
+  """mask: (z, y, x) uint8 → list of 6 per-cell case-id arrays (cz, cy, cx).
+
+  Kept as separate per-tet arrays: stacking shifted slices into one big
+  array and reshaping it compiles pathologically slowly on XLA CPU, and
+  per-tet arrays fuse fine on TPU anyway.
+  """
+  sz, sy, sx = mask.shape
+  cz, cy, cx = sz - 1, sy - 1, sx - 1
+  corners = []
+  for i in range(8):
+    ox, oy, oz = i & 1, (i >> 1) & 1, (i >> 2) & 1
+    corners.append(mask[oz : oz + cz, oy : oy + cy, ox : ox + cx].astype(jnp.int32))
+  cases = []
+  for tet in TETS:
+    c = (
+      corners[tet[0]]
+      + corners[tet[1]] * 2
+      + corners[tet[2]] * 4
+      + corners[tet[3]] * 8
+    )
+    cases.append(c)
+  return cases
+
+
+@jax.jit
+def _count_kernel(mask: jnp.ndarray):
+  """→ (6 per-tet case arrays, 6 per-tet triangle counts, total).
+
+  Triangle count per tet case derives arithmetically from the popcount:
+  min(bits, 4 - bits) — no table gather needed on device."""
+  cases = _case_list(mask)
+  per_tet = []
+  total = jnp.int32(0)
+  for c in cases:
+    b = (c & 1) + ((c >> 1) & 1) + ((c >> 2) & 1) + ((c >> 3) & 1)
+    n = jnp.minimum(b, 4 - b)
+    per_tet.append(n)
+    total = total + jnp.sum(n, dtype=jnp.int32)
+  return tuple(cases), tuple(per_tet), total
+
+
+def _emit_host(cases_np, per_np, shape, real_cells=None) -> np.ndarray:
+  """Host-side triangle emission: O(triangles) table lookups in numpy.
+
+  The device pass is O(voxels) (case + count); everything below touches
+  only the ~surface-sized slot set, where numpy fancy indexing is faster
+  than compiling a device gather program per capacity.
+
+  ``real_cells``: (cx, cy, cz) cell counts of the un-padded mask — cells in
+  the shape-bucketing pad ring are dropped (their triangles are artifacts
+  of the replicate padding).
+  Returns (n, 3, 3) vertex coords in (x, y, z) voxel units.
+  """
+  sz, sy, sx = shape
+  cz, cy, cx = sz - 1, sy - 1, sx - 1
+  per = np.stack([p.reshape(-1) for p in per_np], axis=-1)  # (ncells, 6)
+  ncells = per.shape[0]
+  cell_grid = np.arange(ncells, dtype=np.int64)[:, None]
+  tet_grid = np.arange(6, dtype=np.int64)[None, :]
+
+  sel1 = per >= 1
+  sel2 = per >= 2
+  if real_cells is not None:
+    rx, ry, rz = real_cells
+    flat = np.arange(ncells, dtype=np.int64)
+    in_real = (
+      (flat % cx < rx) & ((flat // cx) % cy < ry) & (flat // (cy * cx) < rz)
+    )
+    sel1 &= in_real[:, None]
+    sel2 &= in_real[:, None]
+  cell = np.concatenate([cell_grid.repeat(6, 1)[sel1], cell_grid.repeat(6, 1)[sel2]])
+  tet = np.concatenate([tet_grid.repeat(ncells, 0)[sel1], tet_grid.repeat(ncells, 0)[sel2]])
+  tri = np.concatenate([
+    np.zeros(int(sel1.sum()), dtype=np.int64),
+    np.ones(int(sel2.sum()), dtype=np.int64),
+  ])
+
+  cases_flat = np.stack([c.reshape(-1) for c in cases_np], axis=-1)  # (ncells, 6)
+  case = cases_flat[cell, tet]
+  pair = EDGES_TABLE[tet, case, tri]  # (n, 3, 2)
+  mid = (CORNER_OFFSETS[pair[..., 0]] + CORNER_OFFSETS[pair[..., 1]]) / 2.0
+
+  base = np.stack(
+    [cell % cx, (cell // cx) % cy, cell // (cy * cx)], axis=-1
+  ).astype(np.float32)  # xyz
+  return base[:, None, :] + mid
+
+
+def marching_tetrahedra(
+  mask: np.ndarray, anisotropy=(1.0, 1.0, 1.0), offset=(0.0, 0.0, 0.0)
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Binary mask (x, y, z) → (vertices (V,3) float32, faces (F,3) uint32).
+
+  Vertices are in physical units: (voxel_coord + offset) * anisotropy.
+  The surface is watertight over the mask's interior; to close a surface
+  at the array boundary, pad the mask with a zero shell first (MeshTask
+  handles dataset-edge policy).
+  """
+  if mask.ndim != 3:
+    raise ValueError("mask must be 3d")
+  # bucket shapes to powers of two so the count kernel compiles a bounded
+  # set of variants. Replicate padding adds no surface inside the real
+  # region; artifact triangles in the pad ring are filtered by cell coord.
+  orig = mask.shape
+  bucket = tuple(max(8, 1 << int(np.ceil(np.log2(s)))) for s in orig)
+  if bucket != orig:
+    mask = np.pad(
+      mask, tuple((0, b - s) for b, s in zip(bucket, orig)), mode="edge"
+    )
+  dev = jnp.asarray(
+    np.ascontiguousarray(mask.astype(np.uint8).transpose(2, 1, 0))
+  )  # (z, y, x)
+  cases, per_tet, total = _count_kernel(dev)
+  if int(total) == 0:
+    return (
+      np.zeros((0, 3), dtype=np.float32),
+      np.zeros((0, 3), dtype=np.uint32),
+    )
+  cases_np = [np.asarray(c) for c in cases]
+  per_np = [np.asarray(p) for p in per_tet]
+  tris = _emit_host(
+    cases_np, per_np, dev.shape,
+    real_cells=(orig[0] - 1, orig[1] - 1, orig[2] - 1),
+  )  # (n, 3, 3) xyz
+  if len(tris) == 0:
+    return (
+      np.zeros((0, 3), dtype=np.float32),
+      np.zeros((0, 3), dtype=np.uint32),
+    )
+
+  # weld vertices: all coords are multiples of 0.5 → exact integer lattice
+  lattice = np.round(tris.reshape(-1, 3) * 2.0).astype(np.int64)
+  uniq, inverse = np.unique(lattice, axis=0, return_inverse=True)
+  vertices = uniq.astype(np.float32) / 2.0
+  faces = inverse.reshape(-1, 3).astype(np.uint32)
+
+  # drop degenerate faces (can only come from table bugs; cheap guard)
+  from ..mesh_io import drop_degenerate_faces
+
+  faces = drop_degenerate_faces(faces)
+
+  vertices = (vertices + np.asarray(offset, dtype=np.float32)) * np.asarray(
+    anisotropy, dtype=np.float32
+  )
+  return vertices, faces

@@ -1,0 +1,72 @@
+"""Spatial index: label → bounding box, stored per task grid cell.
+
+Capability parity with cloud-volume's spatial index
+(``cv.mesh.spatial_index.query``, consumed at
+/root/reference/igneous/task_creation/mesh.py:735 and
+tasks/mesh/multires.py:471). File format: one gzip JSON per grid cell at
+``<prefix>/<bbox>.spatial`` mapping label → [minpt, maxpt] (physical
+units), written by forge tasks and queried by merge tasks / shard planners.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set
+
+import numpy as np
+
+from .lib import Bbox
+from .storage import CloudFiles
+
+
+class SpatialIndex:
+  def __init__(self, cf: CloudFiles, prefix: str):
+    self.cf = cf
+    self.prefix = prefix.rstrip("/")
+
+  def _key(self, bbox: Bbox) -> str:
+    return f"{self.prefix}/{bbox.to_filename()}.spatial"
+
+  def put(self, bbox: Bbox, label_bounds: Dict[int, Bbox]):
+    doc = {
+      str(label): [list(map(float, b.minpt)), list(map(float, b.maxpt))]
+      for label, b in label_bounds.items()
+    }
+    self.cf.put_json(self._key(bbox), doc, compress="gzip")
+
+  def index_files(self) -> List[str]:
+    return [
+      k for k in self.cf.list(self.prefix + "/") if k.endswith(".spatial")
+    ]
+
+  def query(self, bbox: Optional[Bbox] = None) -> Set[int]:
+    """Labels whose stored bounds intersect ``bbox`` (all labels if None)."""
+    out: Set[int] = set()
+    for key in self.index_files():
+      if bbox is not None:
+        cell = Bbox.from_filename(key)
+        if not Bbox.intersects(cell, bbox):
+          continue
+      doc = self.cf.get_json(key)
+      if not doc:
+        continue
+      for label, (mn, mx) in doc.items():
+        if bbox is None or Bbox.intersects(bbox, Bbox(mn, mx)):
+          out.add(int(label))
+    return out
+
+  def file_locations_per_label(
+    self, labels: Optional[Iterable[int]] = None
+  ) -> Dict[int, List[str]]:
+    """label → the .spatial cell files that saw it (→ which .frags files
+    hold its fragments)."""
+    wanted = None if labels is None else set(int(l) for l in labels)
+    out: Dict[int, List[str]] = {}
+    for key in self.index_files():
+      doc = self.cf.get_json(key)
+      if not doc:
+        continue
+      for label in doc:
+        label = int(label)
+        if wanted is None or label in wanted:
+          out.setdefault(label, []).append(key)
+    return out

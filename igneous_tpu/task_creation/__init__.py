@@ -19,6 +19,12 @@ from .ccl import (
   create_ccl_relabel_tasks,
   create_relabeling,
 )
+from .mesh import (
+  create_mesh_deletion_tasks,
+  create_mesh_manifest_tasks,
+  create_mesh_transfer_tasks,
+  create_meshing_tasks,
+)
 from .image import (
   MEMORY_TARGET,
   create_blackout_tasks,

@@ -1,0 +1,148 @@
+"""Mesh task factories.
+
+Reference parity: /root/reference/igneous/task_creation/mesh.py
+(create_meshing_tasks :158-267, create_mesh_manifest_tasks :54-89,
+mesh xfer :548-588). The multires/sharded merge factories land with the
+multires module (draco codec is a pluggable hook in this environment).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec
+from ..volume import Volume
+from ..tasks.mesh import (
+  DeleteMeshFilesTask,
+  MeshManifestFilesystemTask,
+  MeshManifestPrefixTask,
+  MeshTask,
+  TransferMeshFilesTask,
+)
+from .common import GridTaskIterator, get_bounds, operator_contact
+
+
+def create_meshing_tasks(
+  layer_path: str,
+  mip: int = 0,
+  shape: Sequence[int] = (448, 448, 448),
+  simplification: bool = True,
+  simplification_factor: int = 100,
+  max_simplification_error: int = 40,
+  mesh_dir: Optional[str] = None,
+  dust_threshold: Optional[int] = None,
+  object_ids: Optional[Sequence[int]] = None,
+  fill_missing: bool = False,
+  encoding: str = "precomputed",
+  spatial_index: bool = True,
+  sharded: bool = False,
+  bounds: Optional[Bbox] = None,
+  closed_dataset_edges: bool = True,
+):
+  """Stage-1 mesh forge grid; creates the mesh info
+  (reference task_creation/mesh.py:158-267)."""
+  vol = Volume(layer_path, mip=mip)
+  if vol.layer_type != "segmentation":
+    raise ValueError("Meshing requires a segmentation layer")
+
+  if mesh_dir is None:
+    mesh_dir = vol.info.get("mesh") or f"mesh_mip_{mip}_err_{max_simplification_error}"
+  vol.info["mesh"] = mesh_dir
+  mesh_info = {"@type": "neuroglancer_legacy_mesh", "mip": int(mip)}
+  if spatial_index:
+    res = [int(v) for v in vol.resolution]
+    mesh_info["spatial_index"] = {
+      "resolution": res,
+      "chunk_size": [int(s * r) for s, r in zip(shape, res)],
+    }
+  vol.cf.put_json(f"{mesh_dir}/info", mesh_info)
+  vol.commit_info()
+
+  shape = Vec(*shape)
+  task_bounds = get_bounds(
+    vol, bounds, mip, mip, chunk_size=vol.meta.chunk_size(mip)
+  )
+
+  if not simplification:
+    simplification_factor = 1
+
+  def make_task(shape_: Vec, offset: Vec):
+    return MeshTask(
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      layer_path=layer_path,
+      mip=mip,
+      simplification_factor=simplification_factor,
+      max_simplification_error=max_simplification_error,
+      mesh_dir=mesh_dir,
+      dust_threshold=dust_threshold,
+      object_ids=list(object_ids) if object_ids else None,
+      fill_missing=fill_missing,
+      encoding=encoding,
+      spatial_index=spatial_index,
+      sharded=sharded,
+      closed_dataset_edges=closed_dataset_edges,
+    )
+
+  def finish():
+    vol.meta.refresh_provenance()
+    vol.meta.add_provenance_entry({
+      "task": "MeshTask", "mip": mip, "shape": shape.tolist(),
+      "mesh_dir": mesh_dir, "sharded": sharded,
+      "simplification_factor": simplification_factor,
+      "bounds": task_bounds.to_list(),
+    }, operator_contact())
+    vol.commit_provenance()
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_mesh_manifest_tasks(
+  layer_path: str,
+  magnitude: int = 2,
+  mesh_dir: Optional[str] = None,
+) -> Iterator:
+  """Stage-2 manifest tasks split by decimal label prefix
+  (reference task_creation/mesh.py:54-89 prefix strategy): full-length
+  prefixes have no leading zeros, and shorter labels are covered exactly
+  by their terminated ``N:`` prefixes — no dead tasks."""
+  for prefix in range(10 ** (magnitude - 1), 10**magnitude):
+    yield MeshManifestPrefixTask(
+      layer_path=layer_path, prefix=str(prefix), mesh_dir=mesh_dir
+    )
+  for ndigits in range(1, magnitude):
+    lo = 10 ** (ndigits - 1) if ndigits > 1 else 1
+    for prefix in range(lo, 10**ndigits):
+      yield MeshManifestPrefixTask(
+        layer_path=layer_path, prefix=f"{prefix}:", mesh_dir=mesh_dir
+      )
+
+
+def create_mesh_deletion_tasks(
+  layer_path: str, magnitude: int = 1, mesh_dir: Optional[str] = None
+):
+  from ..tasks.mesh import mesh_dir_for
+
+  mdir = mesh_dir_for(Volume(layer_path), mesh_dir)
+  for prefix in range(10**magnitude):
+    yield partial(DeleteMeshFilesTask, layer_path, mdir, str(prefix))
+
+
+def create_mesh_transfer_tasks(
+  src_layer: str, dest_layer: str, mesh_dir: Optional[str] = None,
+  magnitude: int = 1,
+):
+  from ..tasks.mesh import mesh_dir_for
+
+  mdir = mesh_dir_for(Volume(src_layer), mesh_dir)
+  try:
+    dest = Volume(dest_layer)
+    dest.info["mesh"] = mdir
+    dest.commit_info()
+  except FileNotFoundError:
+    pass  # mesh-only bucket: no info to update
+  for prefix in range(10**magnitude):
+    yield partial(TransferMeshFilesTask, src_layer, dest_layer, mdir, str(prefix))

@@ -17,6 +17,13 @@ from .image import (
 )
 from .image_sharded import ImageShardDownsampleTask, ImageShardTransferTask
 from .ccl import CCLEquivalancesTask, CCLFacesTask, RelabelCCLTask
+from .mesh import (
+  DeleteMeshFilesTask,
+  MeshManifestFilesystemTask,
+  MeshManifestPrefixTask,
+  MeshTask,
+  TransferMeshFilesTask,
+)
 
 
 class TouchFileTask(RegisteredTask):

@@ -1,8 +1,10 @@
 import os
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
-# exercised without TPU hardware. bench.py (run separately) uses the real chip.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# exercised without TPU hardware. bench.py (run separately) uses the real
+# chip. Force (not setdefault): the ambient environment points JAX at the
+# tunneled TPU, which would make every kernel test pay tunnel latency.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
   os.environ["XLA_FLAGS"] = (

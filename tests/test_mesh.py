@@ -1,0 +1,236 @@
+"""Mesh tests: MT kernel geometry, codecs, simplification, FragMap, and the
+forge→manifest pipeline on file:// volumes."""
+
+import json
+
+import numpy as np
+import pytest
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox
+from igneous_tpu.mesh_io import FragMap, Mesh, encode_mesh, simplify
+from igneous_tpu.ops.mesh import marching_tetrahedra
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.spatial_index import SpatialIndex
+from igneous_tpu.volume import Volume
+
+
+def run(tasks):
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+
+
+def watertight(verts, faces) -> bool:
+  e = np.concatenate([faces[:, [0, 1]], faces[:, [1, 2]], faces[:, [2, 0]]])
+  de = e[:, 0].astype(np.int64) * (len(verts) + 1) + e[:, 1]
+  _, c = np.unique(de, return_counts=True)
+  if not (c == 1).all():
+    return False
+  ue = np.sort(e, axis=1)
+  uv = ue[:, 0].astype(np.int64) * (len(verts) + 1) + ue[:, 1]
+  _, uc = np.unique(uv, return_counts=True)
+  return bool((uc == 2).all())
+
+
+def signed_volume(verts, faces) -> float:
+  p = verts[faces]
+  return float(
+    np.sum(np.einsum("ij,ij->i", p[:, 0], np.cross(p[:, 1], p[:, 2]))) / 6.0
+  )
+
+
+# ---------------------------------------------------------------------------
+# kernel
+
+
+def test_mt_sphere_watertight_and_volume():
+  g = np.indices((36, 36, 36)).astype(np.float32) - 17.5
+  mask = (np.sqrt((g**2).sum(0)) < 13).astype(np.uint8)
+  v, f = marching_tetrahedra(mask)
+  assert watertight(v, f)
+  vol = signed_volume(v, f)
+  analytic = 4 / 3 * np.pi * 13**3
+  assert vol > 0  # outward orientation
+  assert abs(vol - analytic) / analytic < 0.05
+
+
+def test_mt_anisotropy_offset():
+  mask = np.zeros((6, 6, 6), np.uint8)
+  mask[2:4, 2:4, 2:4] = 1
+  v1, f1 = marching_tetrahedra(mask)
+  v2, f2 = marching_tetrahedra(mask, anisotropy=(4, 4, 40), offset=(64, 0, 0))
+  assert np.allclose(v2, (v1 + [64, 0, 0]) * [4, 4, 40])
+  assert np.array_equal(f1, f2)
+
+
+def test_mt_two_objects():
+  mask = np.zeros((12, 6, 6), np.uint8)
+  mask[1:4, 1:4, 1:4] = 1
+  mask[7:10, 1:4, 1:4] = 1
+  v, f = marching_tetrahedra(mask)
+  assert watertight(v, f)
+
+
+# ---------------------------------------------------------------------------
+# mesh container / codecs
+
+
+def test_precomputed_roundtrip():
+  rng = np.random.default_rng(0)
+  m = Mesh(rng.random((20, 3)).astype(np.float32), rng.integers(0, 20, (30, 3)))
+  m2 = Mesh.from_precomputed(m.to_precomputed())
+  assert m == m2
+
+
+def test_concatenate_consolidate():
+  a = Mesh([[0, 0, 0], [1, 0, 0], [0, 1, 0]], [[0, 1, 2]])
+  b = Mesh([[0, 0, 0], [1, 0, 0], [0, 0, 1]], [[0, 1, 2]])
+  c = Mesh.concatenate(a, b).consolidate()
+  assert len(c.vertices) == 4  # shared edge verts welded
+  assert len(c.faces) == 2
+
+
+def test_draco_gated():
+  m = Mesh([[0, 0, 0], [1, 0, 0], [0, 1, 0]], [[0, 1, 2]])
+  with pytest.raises(NotImplementedError):
+    encode_mesh(m, "draco")
+
+
+def test_simplify_reduces():
+  g = np.indices((40, 40, 40)).astype(np.float32) - 19.5
+  mask = (np.sqrt((g**2).sum(0)) < 16).astype(np.uint8)
+  v, f = marching_tetrahedra(mask)
+  m = simplify(Mesh(v, f), reduction_factor=10, max_error=4)
+  assert 0 < len(m.faces) < len(f) / 2
+  # shape roughly preserved
+  assert abs(abs(signed_volume(m.vertices, m.faces)) - abs(signed_volume(v, f))) \
+    / abs(signed_volume(v, f)) < 0.2
+
+
+def test_fragmap_roundtrip():
+  rng = np.random.default_rng(1)
+  data = {int(k): rng.bytes(rng.integers(1, 100))
+          for k in rng.choice(10**12, 50, replace=False)}
+  raw = FragMap.tobytes(data)
+  fm = FragMap.frombytes(raw)
+  assert len(fm) == 50
+  for k, v in data.items():
+    assert fm[k] == v
+  assert fm.get(12345678) is None
+  assert dict(fm.items()) == data
+
+
+# ---------------------------------------------------------------------------
+# forge pipeline
+
+
+def make_seg(tmp_path, shape=(128, 96, 64)):
+  data = np.zeros(shape, dtype=np.uint64)
+  # two bricks, one crossing the task boundary at x=64
+  data[20:50, 20:50, 10:40] = 77
+  data[55:80, 30:60, 20:50] = 123
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, resolution=(4, 4, 4),
+                    layer_type="segmentation")
+  return path, data
+
+
+def test_mesh_forge_unsharded(tmp_path):
+  path, data = make_seg(tmp_path)
+  run(tc.create_meshing_tasks(path, shape=(64, 64, 64), mesh_dir="mesh"))
+  vol = Volume(path)
+  assert vol.info["mesh"] == "mesh"
+  mesh_info = vol.cf.get_json("mesh/info")
+  assert mesh_info["@type"] == "neuroglancer_legacy_mesh"
+
+  run(tc.create_mesh_manifest_tasks(path, magnitude=1))
+  manifest = vol.cf.get_json("mesh/77:0")
+  assert manifest is not None
+  # label 77 spans x<64 only → 1 fragment; 123 crosses x=64 → 2 fragments
+  m123 = vol.cf.get_json("mesh/123:0")
+  assert len(m123["fragments"]) == 2
+
+  # load all fragments of 123 and verify combined volume ≈ brick volume
+  meshes = []
+  for frag in m123["fragments"]:
+    meshes.append(Mesh.from_precomputed(vol.cf.get(f"mesh/{frag}")))
+  combined = Mesh.concatenate(*meshes).consolidate()
+  vol123 = abs(signed_volume(combined.vertices, combined.faces))
+  brick = 25 * 30 * 30 * (4 * 4 * 4)  # voxels * nm^3
+  assert abs(vol123 - brick) / brick < 0.15
+
+
+def test_mesh_forge_sharded_frags(tmp_path):
+  path, data = make_seg(tmp_path)
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="mesh", sharded=True))
+  vol = Volume(path)
+  frag_files = [k for k in vol.cf.list("mesh/") if k.endswith(".frags")]
+  assert len(frag_files) >= 2
+  found = set()
+  for key in frag_files:
+    fm = FragMap.frombytes(vol.cf.get(key))
+    for label, blob in fm.items():
+      found.add(label)
+      Mesh.from_precomputed(blob)  # decodes cleanly
+  assert found == {77, 123}
+
+
+def test_mesh_spatial_index(tmp_path):
+  path, data = make_seg(tmp_path)
+  run(tc.create_meshing_tasks(path, shape=(64, 64, 64), mesh_dir="mesh"))
+  vol = Volume(path)
+  si = SpatialIndex(vol.cf, "mesh")
+  assert si.query() == {77, 123}
+  # physical-space query: label 77 lives in x < 50*4 nm
+  labels = si.query(Bbox((0, 0, 0), (100, 300, 300)))
+  assert 77 in labels
+  locs = si.file_locations_per_label([123])
+  assert len(locs[123]) == 2
+
+
+def test_mesh_dust_and_object_ids(tmp_path):
+  data = np.zeros((64, 64, 64), dtype=np.uint64)
+  data[2:30, 2:30, 2:30] = 5
+  data[40:42, 40:42, 40:42] = 9  # 8 voxels of dust
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, layer_type="segmentation")
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="mesh", dust_threshold=100))
+  vol = Volume(path)
+  frags = [k for k in vol.cf.list("mesh/") if ":0:" in k]
+  assert all(k.split("/")[-1].split(":")[0] == "5" for k in frags)
+
+
+def test_manifest_prefix_coverage():
+  # prefixes from magnitude=2 must cover every positive label exactly once
+  tasks = list(tc.create_mesh_manifest_tasks("file:///nonexistent", magnitude=2))
+  prefixes = [t.prefix for t in tasks]
+  assert len(prefixes) == len(set(prefixes))
+  for label in (1, 9, 10, 42, 99, 100, 12345):
+    name = f"{label}:0:0-1_0-1_0-1"
+    hits = [p for p in prefixes if name.startswith(p)]
+    assert len(hits) == 1, (label, hits)
+
+
+def test_frags_uncompressed_on_disk(tmp_path):
+  path, data = make_seg(tmp_path)
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="mesh", sharded=True))
+  vol = Volume(path)
+  import os
+  disk = []
+  for root, _, files in os.walk(str(tmp_path)):
+    disk.extend(f for f in files if ".frags" in f)
+  assert disk and all(f.endswith(".frags") for f in disk)  # no .gz suffix
+  # ranged read into the container works (zero-parse design)
+  key = [k for k in vol.cf.list("mesh/") if k.endswith(".frags")][0]
+  head = vol.cf.get_range(key, 0, 4)
+  assert head == b"IGFM"
+
+
+def test_mesh_deletion_requires_mesh_dir(tmp_path):
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(
+    np.zeros((8, 8, 8), np.uint64), path, layer_type="segmentation")
+  with pytest.raises(ValueError):
+    list(tc.create_mesh_deletion_tasks(path))
