@@ -1,0 +1,761 @@
+"""The ``igneous-tpu`` command line interface.
+
+Command-tree parity with the reference CLI
+(/root/reference/igneous_cli/cli.py:185-214):
+  image {downsample, xfer, create, rm, ccl {faces,links,calc-labels,
+         relabel,clean,auto}}
+  mesh {forge, merge, xfer, rm}
+  skeleton {forge, merge, merge-sharded, xfer, rm}
+  execute | queue {status,release,purge,rezero} | design {ds-memory,
+  ds-shape, bounds} | license
+
+Heavy imports (jax, task modules) happen inside commands so --help and
+queue tooling stay instant.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+
+class Tuple3(click.ParamType):
+  """'64,64,64' → (64, 64, 64) (reference cli.py:80-162 param types)."""
+
+  name = "tuple3"
+
+  def convert(self, value, param, ctx):
+    if isinstance(value, (tuple, list)):
+      return tuple(int(v) for v in value)
+    try:
+      parts = [int(v) for v in str(value).replace("x", ",").split(",")]
+    except ValueError:
+      self.fail(f"{value!r} is not an int triple like 64,64,64", param, ctx)
+    if len(parts) != 3:
+      self.fail(f"{value!r} must have exactly 3 components", param, ctx)
+    return tuple(parts)
+
+
+TUPLE3 = Tuple3()
+
+
+def enqueue(queue_spec: str, tasks, parallel: int = 1):
+  from .queues import LocalTaskQueue, TaskQueue
+
+  if queue_spec is None:
+    LocalTaskQueue(parallel=parallel).insert(tasks)
+  else:
+    TaskQueue(queue_spec).insert(tasks)
+
+
+@click.group()
+@click.option("-p", "--parallel", default=1, show_default=True,
+              help="Worker processes for local execution.")
+@click.pass_context
+def main(ctx, parallel):
+  """igneous-tpu: TPU-native Neuroglancer Precomputed pipelines."""
+  ctx.ensure_object(dict)
+  ctx.obj["parallel"] = parallel
+
+
+# ---------------------------------------------------------------------------
+# image
+
+
+@main.group()
+def image():
+  """Downsample, transfer, ingest, delete image/segmentation layers."""
+
+
+@image.command("downsample")
+@click.argument("path")
+@click.option("--queue", "-q", default=None, help="fq:// queue (local if omitted)")
+@click.option("--mip", default=0, show_default=True)
+@click.option("--num-mips", default=5, show_default=True)
+@click.option("--factor", type=TUPLE3, default=None, help="e.g. 2,2,1")
+@click.option("--sparse", is_flag=True)
+@click.option("--sharded", is_flag=True)
+@click.option("--fill-missing", is_flag=True)
+@click.option("--chunk-size", type=TUPLE3, default=None)
+@click.option("--encoding", default=None)
+@click.option("--memory", "memory_target", default=int(3.5e9), show_default=True)
+@click.option("--method", "downsample_method", default="auto", show_default=True)
+@click.pass_context
+def image_downsample(ctx, path, queue, mip, num_mips, factor, sparse, sharded,
+                     fill_missing, chunk_size, encoding, memory_target,
+                     downsample_method):
+  """Build the downsample pyramid of PATH."""
+  from . import task_creation as tc
+
+  if sharded:
+    tasks = tc.create_image_shard_downsample_tasks(
+      path, mip=mip, fill_missing=fill_missing, sparse=sparse,
+      chunk_size=chunk_size, encoding=encoding,
+      factor=factor or (2, 2, 1), memory_target=memory_target,
+      downsample_method=downsample_method,
+    )
+  else:
+    tasks = tc.create_downsampling_tasks(
+      path, mip=mip, num_mips=num_mips, fill_missing=fill_missing,
+      sparse=sparse, chunk_size=chunk_size, encoding=encoding,
+      factor=factor, memory_target=memory_target,
+      downsample_method=downsample_method,
+    )
+  enqueue(queue, tasks, ctx.obj["parallel"])
+
+
+@image.command("xfer")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--chunk-size", type=TUPLE3, default=None)
+@click.option("--shape", type=TUPLE3, default=None)
+@click.option("--translate", type=TUPLE3, default=(0, 0, 0))
+@click.option("--fill-missing", is_flag=True)
+@click.option("--sharded", is_flag=True)
+@click.option("--encoding", default=None)
+@click.option("--num-mips", default=0, show_default=True)
+@click.pass_context
+def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
+               fill_missing, sharded, encoding, num_mips):
+  """Transfer/rechunk/re-encode SRC into DEST."""
+  from . import task_creation as tc
+
+  if sharded:
+    tasks = tc.create_image_shard_transfer_tasks(
+      src, dest, mip=mip, chunk_size=chunk_size, encoding=encoding,
+      translate=translate, fill_missing=fill_missing,
+    )
+  else:
+    tasks = tc.create_transfer_tasks(
+      src, dest, chunk_size=chunk_size, shape=shape, mip=mip,
+      translate=translate, fill_missing=fill_missing, encoding=encoding,
+      num_mips=num_mips,
+    )
+  enqueue(queue, tasks, ctx.obj["parallel"])
+
+
+@image.command("create")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--resolution", type=TUPLE3, default=(1, 1, 1), show_default=True)
+@click.option("--offset", type=TUPLE3, default=(0, 0, 0), show_default=True)
+@click.option("--chunk-size", type=TUPLE3, default=(64, 64, 64), show_default=True)
+@click.option("--layer-type", default=None,
+              type=click.Choice(["image", "segmentation"]))
+@click.option("--encoding", default="raw", show_default=True)
+def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding):
+  """Ingest a .npy array file as a Precomputed layer
+  (reference `igneous image create`, cli.py:1852-1923)."""
+  import numpy as np
+
+  from .volume import Volume
+
+  if src.endswith(".npy"):
+    arr = np.load(src)
+  else:
+    raise click.UsageError("Only .npy ingest is supported in this build")
+  Volume.from_numpy(
+    arr, dest, resolution=resolution, voxel_offset=offset,
+    chunk_size=chunk_size, layer_type=layer_type, encoding=encoding,
+  )
+  click.echo(f"Created {dest} from {src} {arr.shape} {arr.dtype}")
+
+
+@image.command("rm")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--num-mips", default=0, show_default=True)
+@click.pass_context
+def image_rm(ctx, path, queue, mip, num_mips):
+  """Delete image chunks at mip (… mip+num-mips)."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_deletion_tasks(path, mip=mip, num_mips=num_mips),
+          ctx.obj["parallel"])
+
+
+# -- image contrast ----------------------------------------------------------
+
+
+@image.group("contrast")
+def image_contrast():
+  """Luminance histograms, contrast stretch, CLAHE."""
+
+
+@image_contrast.command("histogram")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--coverage", default=0.01, show_default=True)
+@click.pass_context
+def contrast_histogram(ctx, path, queue, mip, coverage):
+  """Phase 1: per-z luminance histograms."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_luminance_levels_tasks(
+    path, mip=mip, coverage_factor=coverage), ctx.obj["parallel"])
+
+
+@image_contrast.command("equalize")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--clip-fraction", default=0.01, show_default=True)
+@click.option("--shape", type=TUPLE3, default=None)
+@click.pass_context
+def contrast_equalize(ctx, src, dest, queue, mip, clip_fraction, shape):
+  """Phase 2: histogram stretch using phase-1 levels."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_contrast_normalization_tasks(
+    src, dest, mip=mip, clip_fraction=clip_fraction, shape=shape,
+  ), ctx.obj["parallel"])
+
+
+@image_contrast.command("clahe")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--clip-limit", default=40.0, show_default=True)
+@click.option("--tile-grid", default=8, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(2048, 2048, 64), show_default=True)
+@click.pass_context
+def contrast_clahe(ctx, src, dest, queue, mip, clip_limit, tile_grid, shape):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_clahe_tasks(
+    src, dest, mip=mip, clip_limit=clip_limit, tile_grid_size=tile_grid,
+    shape=shape,
+  ), ctx.obj["parallel"])
+
+
+# -- image voxels ------------------------------------------------------------
+
+
+@image.group("voxels")
+def image_voxels():
+  """Voxel statistics."""
+
+
+@image_voxels.command("count")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
+@click.pass_context
+def voxels_count(ctx, path, queue, mip, shape):
+  """Census phase; run `voxels sum` afterwards."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_voxel_counting_tasks(path, mip=mip, shape=shape),
+          ctx.obj["parallel"])
+
+
+@image_voxels.command("sum")
+@click.argument("path")
+@click.option("--mip", default=0, show_default=True)
+def voxels_sum(path, mip):
+  """Reduce census files into voxel_counts.im."""
+  from . import task_creation as tc
+
+  totals = tc.accumulate_voxel_counts(path, mip)
+  click.echo(f"labels: {len(totals)}")
+
+
+@image.command("roi")
+@click.argument("path")
+@click.option("--threshold", default=0.0, show_default=True)
+@click.option("--dust", default=100, show_default=True)
+def image_roi(path, threshold, dust):
+  """Detect tissue regions of interest at the coarsest mip."""
+  from . import task_creation as tc
+
+  for roi in tc.compute_rois(path, threshold=threshold, dust_threshold=dust):
+    click.echo(str(roi))
+
+
+@image.command("reorder")
+@click.argument("src")
+@click.argument("dest")
+@click.argument("mapping_json", type=click.Path(exists=True))
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.pass_context
+def image_reorder(ctx, src, dest, mapping_json, queue, mip):
+  """Shuffle z-slices per a {dest_z: src_z} JSON mapping."""
+  import json as json_mod
+
+  from . import task_creation as tc
+
+  with open(mapping_json) as f:
+    mapping = json_mod.load(f)
+  enqueue(queue, tc.create_reordering_tasks(src, dest, mapping, mip=mip),
+          ctx.obj["parallel"])
+
+
+# -- image ccl ---------------------------------------------------------------
+
+
+@image.group("ccl")
+def image_ccl():
+  """Whole-image connected components labeling (4-pass)."""
+
+
+_CCL_OPTS = [
+  click.option("--mip", default=0, show_default=True),
+  click.option("--shape", type=TUPLE3, default=(448, 448, 448), show_default=True),
+  click.option("--threshold-gte", type=float, default=None),
+  click.option("--threshold-lte", type=float, default=None),
+  click.option("--fill-missing", is_flag=True),
+]
+
+
+def ccl_opts(fn):
+  for opt in reversed(_CCL_OPTS):
+    fn = opt(fn)
+  return fn
+
+
+@image_ccl.command("faces")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@ccl_opts
+@click.pass_context
+def ccl_faces(ctx, path, queue, mip, shape, threshold_gte, threshold_lte,
+              fill_missing):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_ccl_face_tasks(
+    path, mip, shape, fill_missing, threshold_gte, threshold_lte,
+  ), ctx.obj["parallel"])
+
+
+@image_ccl.command("links")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@ccl_opts
+@click.pass_context
+def ccl_links(ctx, path, queue, mip, shape, threshold_gte, threshold_lte,
+              fill_missing):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_ccl_equivalence_tasks(
+    path, mip, shape, fill_missing, threshold_gte, threshold_lte,
+  ), ctx.obj["parallel"])
+
+
+@image_ccl.command("calc-labels")
+@click.argument("path")
+@click.option("--mip", default=0, show_default=True)
+def ccl_calc_labels(path, mip):
+  """Single-machine global union-find (pass 3)."""
+  from . import task_creation as tc
+
+  max_label = tc.create_relabeling(path, mip)
+  click.echo(f"max_label: {max_label}")
+
+
+@image_ccl.command("relabel")
+@click.argument("path")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@ccl_opts
+@click.option("--encoding", default="compressed_segmentation", show_default=True)
+@click.pass_context
+def ccl_relabel(ctx, path, dest, queue, mip, shape, threshold_gte,
+                threshold_lte, fill_missing, encoding):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_ccl_relabel_tasks(
+    path, dest, mip, shape, fill_missing, threshold_gte, threshold_lte,
+    encoding=encoding,
+  ), ctx.obj["parallel"])
+
+
+@image_ccl.command("clean")
+@click.argument("path")
+@click.option("--mip", default=0, show_default=True)
+def ccl_clean(path, mip):
+  from . import task_creation as tc
+
+  tc.clean_ccl_files(path, mip)
+
+
+@image_ccl.command("auto")
+@click.argument("path")
+@click.argument("dest")
+@ccl_opts
+@click.option("--encoding", default="compressed_segmentation", show_default=True)
+@click.pass_context
+def ccl_auto_cmd(ctx, path, dest, mip, shape, threshold_gte, threshold_lte,
+                 fill_missing, encoding):
+  """All four passes locally (reference cli.py:799-852)."""
+  from . import task_creation as tc
+  from .queues import LocalTaskQueue
+
+  max_label = tc.ccl_auto(
+    path, dest, mip=mip, shape=shape,
+    queue=LocalTaskQueue(parallel=ctx.obj["parallel"], progress=False),
+    threshold_gte=threshold_gte, threshold_lte=threshold_lte,
+    fill_missing=fill_missing, encoding=encoding,
+  )
+  click.echo(f"components: {max_label}")
+
+
+# ---------------------------------------------------------------------------
+# mesh
+
+
+@main.group()
+def mesh():
+  """Mesh forging and management."""
+
+
+@mesh.command("forge")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(448, 448, 448), show_default=True)
+@click.option("--simplify-factor", default=100, show_default=True)
+@click.option("--max-error", default=40, show_default=True)
+@click.option("--mesh-dir", default=None)
+@click.option("--dust-threshold", type=int, default=None)
+@click.option("--fill-missing", is_flag=True)
+@click.option("--sharded", is_flag=True)
+@click.option("--spatial-index/--no-spatial-index", default=True, show_default=True)
+@click.pass_context
+def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
+               mesh_dir, dust_threshold, fill_missing, sharded, spatial_index):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_meshing_tasks(
+    path, mip=mip, shape=shape,
+    simplification_factor=simplify_factor,
+    max_simplification_error=max_error,
+    mesh_dir=mesh_dir, dust_threshold=dust_threshold,
+    fill_missing=fill_missing, sharded=sharded,
+    spatial_index=spatial_index,
+  ), ctx.obj["parallel"])
+
+
+@mesh.command("merge")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--magnitude", default=2, show_default=True)
+@click.option("--mesh-dir", default=None)
+@click.pass_context
+def mesh_merge(ctx, path, queue, magnitude, mesh_dir):
+  """Write legacy manifests (stage 2)."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_mesh_manifest_tasks(
+    path, magnitude=magnitude, mesh_dir=mesh_dir), ctx.obj["parallel"])
+
+
+@mesh.command("xfer")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@click.option("--mesh-dir", default=None)
+@click.option("--magnitude", default=1, show_default=True)
+@click.pass_context
+def mesh_xfer(ctx, src, dest, queue, mesh_dir, magnitude):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_mesh_transfer_tasks(
+    src, dest, mesh_dir=mesh_dir, magnitude=magnitude), ctx.obj["parallel"])
+
+
+@mesh.command("rm")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mesh-dir", default=None)
+@click.option("--magnitude", default=1, show_default=True)
+@click.pass_context
+def mesh_rm(ctx, path, queue, mesh_dir, magnitude):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_mesh_deletion_tasks(
+    path, magnitude=magnitude, mesh_dir=mesh_dir), ctx.obj["parallel"])
+
+
+# ---------------------------------------------------------------------------
+# skeleton
+
+
+@main.group()
+def skeleton():
+  """Skeleton forging and management."""
+
+
+@skeleton.command("forge")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
+@click.option("--scale", default=4.0, show_default=True, help="TEASAR scale")
+@click.option("--const", default=500.0, show_default=True, help="TEASAR const (nm)")
+@click.option("--dust-threshold", default=1000, show_default=True)
+@click.option("--fill-missing", is_flag=True)
+@click.option("--sharded", is_flag=True)
+@click.option("--skel-dir", default=None)
+@click.option("--fix-borders/--no-fix-borders", default=True, show_default=True)
+@click.pass_context
+def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
+                   fill_missing, sharded, skel_dir, fix_borders):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_skeletonizing_tasks(
+    path, mip=mip, shape=shape,
+    teasar_params={"scale": scale, "const": const},
+    dust_threshold=dust_threshold, fill_missing=fill_missing,
+    sharded=sharded, skel_dir=skel_dir, fix_borders=fix_borders,
+  ), ctx.obj["parallel"])
+
+
+@skeleton.command("merge")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--magnitude", default=1, show_default=True)
+@click.option("--skel-dir", default=None)
+@click.option("--dust-threshold", default=4000.0, show_default=True)
+@click.option("--tick-threshold", default=6000.0, show_default=True)
+@click.option("--delete-fragments", is_flag=True)
+@click.pass_context
+def skeleton_merge(ctx, path, queue, magnitude, skel_dir, dust_threshold,
+                   tick_threshold, delete_fragments):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_unsharded_skeleton_merge_tasks(
+    path, magnitude=magnitude, skel_dir=skel_dir,
+    dust_threshold=dust_threshold, tick_threshold=tick_threshold,
+    delete_fragments=delete_fragments,
+  ), ctx.obj["parallel"])
+
+
+@skeleton.command("merge-sharded")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--skel-dir", default=None)
+@click.option("--dust-threshold", default=4000.0, show_default=True)
+@click.option("--tick-threshold", default=6000.0, show_default=True)
+@click.pass_context
+def skeleton_merge_sharded(ctx, path, queue, skel_dir, dust_threshold,
+                           tick_threshold):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_sharded_skeleton_merge_tasks(
+    path, skel_dir=skel_dir, dust_threshold=dust_threshold,
+    tick_threshold=tick_threshold,
+  ), ctx.obj["parallel"])
+
+
+@skeleton.command("xfer")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--queue", "-q", default=None)
+@click.option("--skel-dir", default=None)
+@click.option("--magnitude", default=1, show_default=True)
+@click.pass_context
+def skeleton_xfer(ctx, src, dest, queue, skel_dir, magnitude):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_skeleton_transfer_tasks(
+    src, dest, skel_dir=skel_dir, magnitude=magnitude), ctx.obj["parallel"])
+
+
+@skeleton.command("rm")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--skel-dir", default=None)
+@click.option("--magnitude", default=1, show_default=True)
+@click.pass_context
+def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_skeleton_deletion_tasks(
+    path, magnitude=magnitude, skel_dir=skel_dir), ctx.obj["parallel"])
+
+
+# ---------------------------------------------------------------------------
+# execute / queue / design
+
+
+@main.command("execute")
+@click.argument("queue_spec")
+@click.option("--lease-sec", default=600, show_default=True)
+@click.option("-n", "num_tasks", default=None, type=int,
+              help="Stop after N tasks.")
+@click.option("--exit-on-empty", is_flag=True)
+@click.option("--min-sec", default=-1.0, show_default=True,
+              help="Keep polling at least this long (<0: forever).")
+@click.pass_context
+def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
+  """Worker poll loop: lease → run → delete
+  (reference cli.py:888-964 semantics)."""
+  import time
+
+  from .queues import TaskQueue
+
+  parallel = ctx.obj["parallel"]
+  if parallel > 1:
+    import multiprocessing as mp
+
+    ctx_mp = mp.get_context("spawn")
+    procs = [
+      ctx_mp.Process(
+        target=_execute_worker,
+        args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec),
+      )
+      for _ in range(parallel)
+    ]
+    for p in procs:
+      p.start()
+    for p in procs:
+      p.join()
+    return
+  _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec)
+
+
+def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
+  import time
+
+  import igneous_tpu.tasks  # noqa: F401  register all task classes
+  from .queues import TaskQueue
+
+  tq = TaskQueue(queue_spec)
+  start = time.time()
+
+  def stop_fn(executed: int, empty: bool) -> bool:
+    if num_tasks is not None and executed >= num_tasks:
+      return True
+    if empty and exit_on_empty:
+      return True
+    if empty and 0 <= min_sec <= (time.time() - start):
+      return True
+    return False
+
+  executed = tq.poll(lease_seconds=lease_sec, verbose=True, stop_fn=stop_fn)
+  click.echo(f"executed {executed} tasks")
+
+
+@main.group("queue")
+def queue_group():
+  """Queue inspection and maintenance (reference cli.py:1998-2054)."""
+
+
+@queue_group.command("status")
+@click.argument("queue_spec")
+def queue_status(queue_spec):
+  from .queues import TaskQueue
+
+  tq = TaskQueue(queue_spec)
+  click.echo(f"inserted: {tq.inserted}")
+  click.echo(f"enqueued: {tq.enqueued}")
+  click.echo(f"leased: {tq.leased}")
+  click.echo(f"completed: {tq.completed}")
+
+
+@queue_group.command("release")
+@click.argument("queue_spec")
+def queue_release(queue_spec):
+  """Drop all leases (crashed workers' tasks return immediately)."""
+  from .queues import TaskQueue
+
+  TaskQueue(queue_spec).release_all()
+
+
+@queue_group.command("purge")
+@click.argument("queue_spec")
+def queue_purge(queue_spec):
+  from .queues import TaskQueue
+
+  TaskQueue(queue_spec).purge()
+
+
+@queue_group.command("rezero")
+@click.argument("queue_spec")
+def queue_rezero(queue_spec):
+  from .queues import TaskQueue
+
+  TaskQueue(queue_spec).rezero()
+
+
+@main.group()
+def design():
+  """Capacity planning math (reference cli.py `design`)."""
+
+
+@design.command("ds-memory")
+@click.argument("path")
+@click.option("--memory", default=int(3.5e9), show_default=True)
+@click.option("--factor", type=TUPLE3, default=(2, 2, 1), show_default=True)
+def design_ds_memory(path, memory, factor):
+  """How many mips fit in a byte budget for PATH's chunk size."""
+  from .downsample_scales import num_mips_from_memory_target
+  from .volume import Volume
+
+  vol = Volume(path)
+  mips = num_mips_from_memory_target(
+    memory, vol.dtype.itemsize, vol.chunk_size, factor, vol.num_channels
+  )
+  click.echo(f"mips achievable in {memory:.2e} bytes: {mips}")
+
+
+@design.command("ds-shape")
+@click.argument("path")
+@click.option("--memory", default=int(3.5e9), show_default=True)
+@click.option("--factor", type=TUPLE3, default=(2, 2, 1), show_default=True)
+@click.option("--num-mips", default=None, type=int)
+def design_ds_shape(path, memory, factor, num_mips):
+  """Optimal task shape for a byte budget."""
+  from .downsample_scales import downsample_shape_from_memory_target
+  from .volume import Volume
+
+  vol = Volume(path)
+  cs = vol.chunk_size
+  shape = downsample_shape_from_memory_target(
+    vol.dtype.itemsize, int(cs.x), int(cs.y), int(cs.z), factor, memory,
+    max_mips=num_mips, num_channels=vol.num_channels,
+  )
+  click.echo(",".join(str(int(v)) for v in shape))
+
+
+@design.command("bounds")
+@click.argument("path")
+@click.option("--mip", default=0, show_default=True)
+def design_bounds(path, mip):
+  """Reverse-engineer bounds from stored chunk filenames
+  (reference cli.py:1628-1649 repair tool)."""
+  from .lib import Bbox
+  from .volume import Volume
+
+  vol = Volume(path)
+  boxes = []
+  for key in vol.cf.list(f"{vol.meta.key(mip)}/"):
+    try:
+      boxes.append(Bbox.from_filename(key))
+    except ValueError:
+      continue
+  if not boxes:
+    click.echo("no chunks found")
+    return
+  total = Bbox.expand(*boxes)
+  click.echo(f"chunks: {len(boxes)}")
+  click.echo(f"bounds: {total}")
+  click.echo(f"info bounds: {vol.meta.bounds(mip)}")
+
+
+@main.command("license")
+def license_cmd():
+  click.echo("igneous-tpu is licensed under the BSD 3-Clause license.")
+
+
+if __name__ == "__main__":
+  main()

@@ -150,8 +150,6 @@ def _emit_host(cases_np, per_np, shape, real_cells=None) -> np.ndarray:
   cz, cy, cx = sz - 1, sy - 1, sx - 1
   per = np.stack([p.reshape(-1) for p in per_np], axis=-1)  # (ncells, 6)
   ncells = per.shape[0]
-  cell_grid = np.arange(ncells, dtype=np.int64)[:, None]
-  tet_grid = np.arange(6, dtype=np.int64)[None, :]
 
   sel1 = per >= 1
   sel2 = per >= 2
@@ -163,11 +161,14 @@ def _emit_host(cases_np, per_np, shape, real_cells=None) -> np.ndarray:
     )
     sel1 &= in_real[:, None]
     sel2 &= in_real[:, None]
-  cell = np.concatenate([cell_grid.repeat(6, 1)[sel1], cell_grid.repeat(6, 1)[sel2]])
-  tet = np.concatenate([tet_grid.repeat(ncells, 0)[sel1], tet_grid.repeat(ncells, 0)[sel2]])
+  # nonzero keeps allocation proportional to the surface, not the volume
+  cell1, tet1 = np.nonzero(sel1)
+  cell2, tet2 = np.nonzero(sel2)
+  cell = np.concatenate([cell1, cell2])
+  tet = np.concatenate([tet1, tet2])
   tri = np.concatenate([
-    np.zeros(int(sel1.sum()), dtype=np.int64),
-    np.ones(int(sel2.sum()), dtype=np.int64),
+    np.zeros(len(cell1), dtype=np.int64),
+    np.ones(len(cell2), dtype=np.int64),
   ])
 
   cases_flat = np.stack([c.reshape(-1) for c in cases_np], axis=-1)  # (ncells, 6)

@@ -19,6 +19,13 @@ from .ccl import (
   create_ccl_relabel_tasks,
   create_relabeling,
 )
+from .skeleton import (
+  create_sharded_skeleton_merge_tasks,
+  create_skeleton_deletion_tasks,
+  create_skeleton_transfer_tasks,
+  create_skeletonizing_tasks,
+  create_unsharded_skeleton_merge_tasks,
+)
 from .mesh import (
   create_mesh_deletion_tasks,
   create_mesh_manifest_tasks,
@@ -27,13 +34,22 @@ from .mesh import (
 )
 from .image import (
   MEMORY_TARGET,
+  compute_rois,
   create_blackout_tasks,
+  create_clahe_tasks,
+  create_contrast_normalization_tasks,
   create_deletion_tasks,
   create_downsampling_tasks,
+  create_fixup_downsample_tasks,
   create_image_shard_downsample_tasks,
   create_image_shard_transfer_tasks,
+  create_luminance_levels_tasks,
   create_quantized_affinity_info,
   create_quantize_tasks,
+  create_reordering_tasks,
+  create_spatial_index_tasks,
   create_touch_tasks,
   create_transfer_tasks,
+  create_voxel_counting_tasks,
 )
+from ..tasks.stats import accumulate_voxel_counts, load_voxel_counts

@@ -51,6 +51,20 @@ def num_tasks(bounds: Bbox, shape: Sequence[int]) -> int:
   return int(np.prod(ceil_div(np.asarray(bounds.size3()), np.asarray(shape))))
 
 
+def label_prefixes(magnitude: int) -> Iterator[str]:
+  """Decimal prefixes covering every positive integer label exactly once:
+  full-length prefixes (no leading zeros) plus terminated ``N:`` prefixes
+  for labels shorter than ``magnitude`` digits. Shared by mesh-manifest
+  and skeleton-merge fan-out (reference prefix strategy,
+  task_creation/mesh.py:54-89)."""
+  for prefix in range(10 ** (magnitude - 1), 10**magnitude):
+    yield str(prefix)
+  for ndigits in range(1, magnitude):
+    lo = 10 ** (ndigits - 1) if ndigits > 1 else 1
+    for prefix in range(lo, 10**ndigits):
+      yield f"{prefix}:"
+
+
 class FinelyDividedTaskIterator:
   """Splits ``bounds`` into a shape-sized grid; index → task.
 

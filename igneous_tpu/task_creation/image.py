@@ -516,6 +516,318 @@ def create_touch_tasks(
   return GridTaskIterator(task_bounds, shape, make_task, finish)
 
 
+def create_luminance_levels_tasks(
+  src_path: str,
+  mip: int = 0,
+  coverage_factor: float = 0.01,
+  shape: Optional[Sequence[int]] = None,
+  bounds: Optional[Bbox] = None,
+  fill_missing: bool = False,
+):
+  """Phase 1 of contrast correction: per-z histograms
+  (reference task_creation/image.py:1284-1545)."""
+  from ..tasks.contrast import LuminanceLevelsTask
+
+  vol = Volume(src_path, mip=mip)
+  task_bounds = get_bounds(vol, bounds, mip, mip)
+  if shape is None:
+    sz3 = task_bounds.size3()
+    shape = (int(sz3.x), int(sz3.y), 1)
+  shape = Vec(*shape)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return LuminanceLevelsTask(
+      src_path=src_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      coverage_factor=coverage_factor,
+      fill_missing=fill_missing,
+    )
+
+  return GridTaskIterator(task_bounds, shape, make_task)
+
+
+def create_contrast_normalization_tasks(
+  src_path: str,
+  dest_path: str,
+  mip: int = 0,
+  clip_fraction: float = 0.01,
+  shape: Optional[Sequence[int]] = None,
+  translate: Sequence[int] = (0, 0, 0),
+  bounds: Optional[Bbox] = None,
+  fill_missing: bool = False,
+  minval: int = 0,
+  maxval: int = 255,
+  chunk_size: Optional[Sequence[int]] = None,
+):
+  """Phase 2: histogram stretch into a new layer."""
+  from ..tasks.contrast import ContrastNormalizationTask
+
+  src = Volume(src_path, mip=mip)
+  scale = src.meta.scale(mip)
+  info = Volume.create_new_info(
+    num_channels=src.num_channels,
+    layer_type=src.layer_type,
+    data_type=src.meta.data_type,
+    encoding=scale["encoding"],
+    resolution=scale["resolution"],
+    voxel_offset=(np.asarray(scale.get("voxel_offset", [0, 0, 0]))
+                  + np.asarray(translate)).tolist(),
+    volume_size=scale["size"],
+    chunk_size=chunk_size or scale["chunk_sizes"][0],
+  )
+  try:
+    dest = Volume(dest_path)
+  except FileNotFoundError:
+    dest = Volume.create(dest_path, info)
+
+  task_bounds = get_bounds(src, bounds, mip, mip)
+  if shape is None:
+    cs = dest.meta.chunk_size(0)
+    shape = (int(cs.x) * 8, int(cs.y) * 8, int(cs.z))
+  shape = Vec(*shape)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return ContrastNormalizationTask(
+      src_path=src_path,
+      dest_path=dest_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      clip_fraction=clip_fraction,
+      fill_missing=fill_missing,
+      translate=tuple(translate),
+      minval=minval,
+      maxval=maxval,
+    )
+
+  def finish():
+    _provenance(dest, {
+      "task": "ContrastNormalizationTask", "src": src_path,
+      "mip": mip, "clip_fraction": clip_fraction,
+      "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_clahe_tasks(
+  src_path: str,
+  dest_path: str,
+  mip: int = 0,
+  clip_limit: float = 40.0,
+  tile_grid_size: int = 8,
+  shape: Sequence[int] = (2048, 2048, 64),
+  bounds: Optional[Bbox] = None,
+  fill_missing: bool = False,
+  chunk_size: Optional[Sequence[int]] = None,
+):
+  from ..tasks.contrast import CLAHETask
+
+  src = Volume(src_path, mip=mip)
+  scale = src.meta.scale(mip)
+  info = Volume.create_new_info(
+    num_channels=src.num_channels,
+    layer_type="image",
+    data_type=src.meta.data_type,
+    encoding=scale["encoding"],
+    resolution=scale["resolution"],
+    voxel_offset=scale.get("voxel_offset", [0, 0, 0]),
+    volume_size=scale["size"],
+    chunk_size=chunk_size or scale["chunk_sizes"][0],
+  )
+  try:
+    dest = Volume(dest_path)
+  except FileNotFoundError:
+    dest = Volume.create(dest_path, info)
+
+  task_bounds = get_bounds(src, bounds, mip, mip)
+  shape = Vec(*shape)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return CLAHETask(
+      src_path=src_path,
+      dest_path=dest_path,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      clip_limit=clip_limit,
+      tile_grid_size=tile_grid_size,
+      fill_missing=fill_missing,
+    )
+
+  def finish():
+    _provenance(dest, {
+      "task": "CLAHETask", "src": src_path, "mip": mip,
+      "clip_limit": clip_limit, "bounds": task_bounds.to_list(),
+    })
+
+  return GridTaskIterator(task_bounds, shape, make_task, finish)
+
+
+def create_voxel_counting_tasks(
+  cloudpath: str,
+  mip: int = 0,
+  shape: Sequence[int] = (512, 512, 512),
+  bounds: Optional[Bbox] = None,
+  fill_missing: bool = False,
+):
+  """Census phase of voxel statistics (reference :1928-2030); reduce with
+  tasks.stats.accumulate_voxel_counts."""
+  from ..tasks.stats import CountVoxelsTask
+
+  vol = Volume(cloudpath, mip=mip)
+  task_bounds = get_bounds(vol, bounds, mip, mip)
+  shape = Vec(*shape)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return CountVoxelsTask(
+      cloudpath=cloudpath,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      fill_missing=fill_missing,
+    )
+
+  return GridTaskIterator(task_bounds, shape, make_task)
+
+
+def create_spatial_index_tasks(
+  cloudpath: str,
+  prefix: str,
+  mip: int = 0,
+  shape: Sequence[int] = (448, 448, 448),
+  bounds: Optional[Bbox] = None,
+  fill_missing: bool = False,
+):
+  """Rebuild a layer's .spatial files (reference tasks/spatial_index.py)."""
+  from ..tasks.stats import SpatialIndexTask
+
+  vol = Volume(cloudpath, mip=mip)
+  task_bounds = get_bounds(vol, bounds, mip, mip)
+  shape = Vec(*shape)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return SpatialIndexTask(
+      cloudpath=cloudpath,
+      prefix=prefix,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      mip=mip,
+      fill_missing=fill_missing,
+    )
+
+  return GridTaskIterator(task_bounds, shape, make_task)
+
+
+def create_reordering_tasks(
+  src_path: str,
+  dest_path: str,
+  mapping: dict,
+  mip: int = 0,
+  z_per_task: int = 16,
+):
+  """Z-slice shuffle into a fresh layer (reference :1193)."""
+  from ..tasks.stats import ReorderTask
+
+  src = Volume(src_path, mip=mip)
+  scale = src.meta.scale(mip)
+  info = Volume.create_new_info(
+    num_channels=src.num_channels,
+    layer_type=src.layer_type,
+    data_type=src.meta.data_type,
+    encoding=scale["encoding"],
+    resolution=scale["resolution"],
+    voxel_offset=scale.get("voxel_offset", [0, 0, 0]),
+    volume_size=scale["size"],
+    chunk_size=scale["chunk_sizes"][0],
+  )
+  try:
+    Volume(dest_path)
+  except FileNotFoundError:
+    Volume.create(dest_path, info)
+
+  z0 = int(src.bounds.minpt.z)
+  z1 = int(src.bounds.maxpt.z)
+  for zs in range(z0, z1, z_per_task):
+    yield ReorderTask(
+      src_path=src_path,
+      dest_path=dest_path,
+      mip=mip,
+      z_start=zs,
+      z_end=min(zs + z_per_task, z1),
+      mapping=mapping,
+    )
+
+
+def create_fixup_downsample_tasks(
+  layer_path: str,
+  bad_bboxes: Sequence[Bbox],
+  mip: int = 0,
+  shape: Sequence[int] = (2048, 2048, 64),
+  fill_missing: bool = True,
+  num_mips: int = 1,
+  sparse: bool = False,
+):
+  """Re-run downsamples covering damaged regions (black spots)
+  (reference :1558-1581 repair tool)."""
+  vol = Volume(layer_path, mip=mip)
+  shape = Vec(*shape)
+  seen = set()
+  for bbx in bad_bboxes:
+    aligned = bbx.expand_to_chunk_size(shape, vol.meta.voxel_offset(mip))
+    aligned = Bbox.intersection(aligned, vol.meta.bounds(mip))
+    from ..lib import chunk_bboxes
+
+    for task_box in chunk_bboxes(aligned, shape, vol.meta.voxel_offset(mip),
+                                 clamp=False):
+      key = task_box.to_filename()
+      if key in seen:
+        continue
+      seen.add(key)
+      yield DownsampleTask(
+        layer_path=layer_path,
+        mip=mip,
+        shape=shape.tolist(),
+        offset=[int(v) for v in task_box.minpt],
+        fill_missing=fill_missing,
+        sparse=sparse,
+        num_mips=num_mips,
+      )
+
+
+def compute_rois(
+  cloudpath: str,
+  mip: Optional[int] = None,
+  threshold: float = 0.0,
+  dust_threshold: int = 100,
+) -> list:
+  """Detect tissue regions-of-interest: CCL over the coarsest mip's
+  foreground, returning physical-space bounding boxes
+  (reference :2032-2095 capability)."""
+  from scipy import ndimage as ndi
+
+  vol = Volume(cloudpath)
+  mip = vol.meta.num_mips - 1 if mip is None else mip
+  img = vol.download(vol.meta.bounds(mip), mip=mip)[..., 0]
+  fg = img > threshold
+  labeled, n = ndi.label(fg)
+  rois = []
+  res = np.asarray(vol.meta.resolution(mip), dtype=np.int64)
+  offset = np.asarray(vol.meta.voxel_offset(mip), dtype=np.int64)
+  for sl in ndi.find_objects(labeled):
+    if sl is None:
+      continue
+    size = np.prod([s.stop - s.start for s in sl])
+    if size < dust_threshold:
+      continue
+    mn = (np.asarray([s.start for s in sl]) + offset) * res
+    mx = (np.asarray([s.stop for s in sl]) + offset) * res
+    rois.append(Bbox(mn, mx))
+  return rois
+
+
 def create_quantized_affinity_info(
   src_layer: str,
   dest_layer: str,

@@ -106,19 +106,13 @@ def create_mesh_manifest_tasks(
   mesh_dir: Optional[str] = None,
 ) -> Iterator:
   """Stage-2 manifest tasks split by decimal label prefix
-  (reference task_creation/mesh.py:54-89 prefix strategy): full-length
-  prefixes have no leading zeros, and shorter labels are covered exactly
-  by their terminated ``N:`` prefixes — no dead tasks."""
-  for prefix in range(10 ** (magnitude - 1), 10**magnitude):
+  (common.label_prefixes: exactly-once coverage, no dead tasks)."""
+  from .common import label_prefixes
+
+  for prefix in label_prefixes(magnitude):
     yield MeshManifestPrefixTask(
-      layer_path=layer_path, prefix=str(prefix), mesh_dir=mesh_dir
+      layer_path=layer_path, prefix=prefix, mesh_dir=mesh_dir
     )
-  for ndigits in range(1, magnitude):
-    lo = 10 ** (ndigits - 1) if ndigits > 1 else 1
-    for prefix in range(lo, 10**ndigits):
-      yield MeshManifestPrefixTask(
-        layer_path=layer_path, prefix=f"{prefix}:", mesh_dir=mesh_dir
-      )
 
 
 def create_mesh_deletion_tasks(

@@ -24,6 +24,21 @@ from .mesh import (
   MeshTask,
   TransferMeshFilesTask,
 )
+from .skeleton import (
+  DeleteSkeletonFilesTask,
+  ShardedSkeletonMergeTask,
+  SkeletonTask,
+  TransferSkeletonFilesTask,
+  UnshardedSkeletonMergeTask,
+)
+from .contrast import CLAHETask, ContrastNormalizationTask, LuminanceLevelsTask
+from .stats import (
+  CountVoxelsTask,
+  ReorderTask,
+  SpatialIndexTask,
+  accumulate_voxel_counts,
+  load_voxel_counts,
+)
 
 
 class TouchFileTask(RegisteredTask):

@@ -1,0 +1,246 @@
+"""Contrast correction tasks: luminance levels, histogram stretch, CLAHE.
+
+Reference parity: /root/reference/igneous/tasks/image/image.py
+  LuminanceLevelsTask (:345-432)  per-z sampled histograms → levels JSONs
+  ContrastNormalizationTask (:211-342)  percentile stretch using levels
+  CLAHETask (:164-209)  per-z-slice CLAHE (OpenCV), overlap-padded
+
+The two-phase map/merge shape (histogram → normalize) is the pipeline's
+"luminance" instance of SURVEY.md §2.4 item 3.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec
+from ..queues.registry import RegisteredTask
+from ..storage import CloudFiles
+from ..volume import Volume
+
+LEVELS_BINS = 256
+
+
+def levels_key(mip: int) -> str:
+  return f"levels/{mip}"
+
+
+def _bin_width(dtype) -> int:
+  """Histogram bin width covering the full integer dtype range with
+  LEVELS_BINS bins (uint8 → 1, uint16 → 256, …)."""
+  dtype = np.dtype(dtype)
+  if dtype.kind not in "ui":
+    raise ValueError(
+      f"luminance histograms require an integer layer, got {dtype}"
+    )
+  return max((np.iinfo(dtype).max + 1) // LEVELS_BINS, 1)
+
+
+class LuminanceLevelsTask(RegisteredTask):
+  """Sample a fraction of one z-range's pixels; upload per-z histograms."""
+
+  def __init__(
+    self,
+    src_path: str,
+    levels_path_: Optional[str] = None,
+    shape: Sequence[int] = (2048, 2048, 1),
+    offset: Sequence[int] = (0, 0, 0),
+    mip: int = 0,
+    coverage_factor: float = 0.01,
+    fill_missing: bool = False,
+  ):
+    self.src_path = src_path
+    self.levels_path_ = levels_path_
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.coverage_factor = float(coverage_factor)
+    self.fill_missing = fill_missing
+
+  PATCH = 256  # xy patch edge for sampled downloads
+
+  def execute(self):
+    vol = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing,
+                 bounded=False)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), vol.bounds
+    )
+    if bounds.empty():
+      return
+    cf = CloudFiles(self.levels_path_ or vol.cloudpath)
+    width = _bin_width(vol.dtype)
+    rng = np.random.default_rng(int(self.offset.z))  # deterministic sampling
+
+    # sample patch LOCATIONS before downloading — coverage_factor bounds
+    # the bytes transferred, not just the pixels histogrammed
+    # (reference LuminanceLevelsTask's sampling design, image.py:345-432)
+    sx, sy, sz = (int(v) for v in bounds.size3())
+    area = sx * sy
+    patch = min(self.PATCH, sx, sy)
+    n_patches = max(int(np.ceil(area * self.coverage_factor / patch**2)), 1)
+    xs = rng.integers(0, max(sx - patch, 0) + 1, size=n_patches)
+    ys = rng.integers(0, max(sy - patch, 0) + 1, size=n_patches)
+
+    for dz in range(sz):
+      z = int(bounds.minpt.z) + dz
+      samples = []
+      for px, py in zip(xs, ys):
+        patch_box = Bbox(
+          bounds.minpt + (int(px), int(py), dz),
+          bounds.minpt + (int(px) + patch, int(py) + patch, dz + 1),
+        )
+        samples.append(vol.download(patch_box)[..., 0].reshape(-1))
+      sample = np.concatenate(samples)
+      hist = np.bincount(
+        (sample // sample.dtype.type(width)).astype(np.int64),
+        minlength=LEVELS_BINS,
+      )[:LEVELS_BINS]
+      cf.put_json(
+        f"{levels_key(self.mip)}/{z}",
+        {
+          "levels": hist.tolist(),
+          "bin_width": int(width),
+          "patch_size": [patch, patch, 1],
+          "num_samples": int(len(sample)),
+          "coverage_ratio": self.coverage_factor,
+        },
+      )
+
+
+def compute_stretch_bounds(levels: np.ndarray, clip_fraction: float):
+  """(low, high) bin indices clipping `clip_fraction` of mass per tail."""
+  total = levels.sum()
+  if total == 0:
+    return 0, LEVELS_BINS - 1
+  cdf = np.cumsum(levels) / total
+  lower = int(np.searchsorted(cdf, clip_fraction))
+  upper = int(np.searchsorted(cdf, 1.0 - clip_fraction))
+  upper = min(max(upper, lower + 1), LEVELS_BINS - 1)
+  return lower, upper
+
+
+class ContrastNormalizationTask(RegisteredTask):
+  """Histogram-stretch using the levels files (reference :211-342)."""
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    clip_fraction: float = 0.01,
+    fill_missing: bool = False,
+    translate: Sequence[int] = (0, 0, 0),
+    minval: int = 0,
+    maxval: int = 255,
+    levels_path_: Optional[str] = None,
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.clip_fraction = float(clip_fraction)
+    self.fill_missing = fill_missing
+    self.translate = Vec(*translate)
+    self.minval = int(minval)
+    self.maxval = int(maxval)
+    self.levels_path_ = levels_path_
+
+  def execute(self):
+    src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing,
+                 bounded=False)
+    dest = Volume(self.dest_path, mip=self.mip)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), src.bounds
+    )
+    if bounds.empty():
+      return
+    img = src.download(bounds).astype(np.float32)
+    cf = CloudFiles(self.levels_path_ or src.cloudpath)
+
+    for dz in range(img.shape[2]):
+      z = int(bounds.minpt.z) + dz
+      doc = cf.get_json(f"{levels_key(self.mip)}/{z}")
+      if doc is None:
+        raise FileNotFoundError(
+          f"levels histogram missing for z={z}; run LuminanceLevelsTask first"
+        )
+      low, high = compute_stretch_bounds(
+        np.asarray(doc["levels"]), self.clip_fraction
+      )
+      width = int(doc.get("bin_width", 1))
+      low, high = low * width, high * width
+      plane = img[:, :, dz]
+      stretched = (plane - low) / max(high - low, 1) * (
+        self.maxval - self.minval
+      ) + self.minval
+      img[:, :, dz] = stretched
+
+    img = np.clip(np.round(img), self.minval, self.maxval).astype(dest.dtype)
+    dest.upload(bounds.translate(self.translate), img)
+
+
+class CLAHETask(RegisteredTask):
+  """Per-z-slice contrast-limited adaptive histogram equalization
+  (reference :164-209; OpenCV backend with single-threading, since
+  parallelism comes from the task grid)."""
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    clip_limit: float = 40.0,
+    tile_grid_size: int = 8,
+    fill_missing: bool = False,
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.clip_limit = float(clip_limit)
+    self.tile_grid_size = int(tile_grid_size)
+    self.fill_missing = fill_missing
+
+  def execute(self):
+    import cv2
+
+    cv2.setNumThreads(0)  # the grid parallelizes; cv2 threads would fight it
+    src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing,
+                 bounded=False)
+    dest = Volume(self.dest_path, mip=self.mip)
+    core = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), src.bounds
+    )
+    if core.empty():
+      return
+    # overlap-pad x/y by one CLAHE tile so tile boundaries don't show at
+    # task seams (reference :192-197)
+    tile = np.asarray(core.size3()[:2]) // self.tile_grid_size
+    pad = Vec(int(tile[0]), int(tile[1]), 0)
+    cutout = Bbox.intersection(
+      Bbox(core.minpt - pad, core.maxpt + pad), src.bounds
+    )
+    img = src.download(cutout)[..., 0]
+
+    clahe = cv2.createCLAHE(
+      clipLimit=self.clip_limit,
+      tileGridSize=(self.tile_grid_size, self.tile_grid_size),
+    )
+    out = np.empty_like(img)
+    for dz in range(img.shape[2]):
+      out[:, :, dz] = clahe.apply(img[:, :, dz])
+
+    sl = tuple(
+      slice(int(a), int(b))
+      for a, b in zip(core.minpt - cutout.minpt, core.maxpt - cutout.minpt)
+    )
+    dest.upload(core, out[sl].astype(dest.dtype))

@@ -149,7 +149,10 @@ class MeshTask(RegisteredTask):
   def _upload(self, meshes, core, cutout, vol, label_bounds=None):
     mdir = mesh_dir_for(vol, self.mesh_dir)
     cf = CloudFiles(vol.cloudpath)
-    bbx_name = core.to_filename()
+    res = np.asarray(vol.resolution, dtype=np.int64)
+    # .frags and .spatial share the physical bbox name so merge consumers
+    # map spatial-index cells to fragment containers by rename alone
+    physical = Bbox(core.minpt * res, core.maxpt * res)
 
     if self.sharded:
       # the container itself stays uncompressed so ranged reads into the
@@ -158,18 +161,16 @@ class MeshTask(RegisteredTask):
       frags = {
         label: encode_mesh(m, self.encoding) for label, m in meshes.items()
       }
-      cf.put(f"{mdir}/{bbx_name}.frags", FragMap.tobytes(frags))
+      cf.put(f"{mdir}/{physical.to_filename()}.frags", FragMap.tobytes(frags))
     else:
       for label, m in meshes.items():
         cf.put(
-          f"{mdir}/{label}:0:{bbx_name}",
+          f"{mdir}/{label}:0:{core.to_filename()}",
           encode_mesh(m, self.encoding),
           compress="gzip",
         )
 
     if self.spatial_index and label_bounds is not None:
-      res = np.asarray(vol.resolution, dtype=np.int64)
-      physical = Bbox(core.minpt * res, core.maxpt * res)
       SpatialIndex(cf, mdir).put(physical, label_bounds)
 
 

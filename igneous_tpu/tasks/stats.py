@@ -1,0 +1,174 @@
+"""Voxel statistics + spatial index + reorder tasks.
+
+Reference parity:
+  CountVoxelsTask      /root/reference/igneous/tasks/image/image.py:849-884
+  accumulate_voxel_counts  igneous/task_creation/image.py:1975-2030
+  SpatialIndexTask     igneous/tasks/spatial_index.py:22-75
+  ReorderTask          igneous/tasks/image/image.py:552
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import defaultdict
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+from scipy import ndimage
+
+from ..lib import Bbox, Vec
+from ..mesh_io import FragMap
+from ..queues.registry import RegisteredTask
+from ..storage import CloudFiles
+from ..volume import Volume
+from ..ops import remap as fastremap
+
+VOXEL_COUNT_DIR = "stats/voxel_counts"
+
+
+class CountVoxelsTask(RegisteredTask):
+  """Per-task label→voxel-count census, uploaded as one JSON."""
+
+  def __init__(
+    self,
+    cloudpath: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    fill_missing: bool = False,
+  ):
+    self.cloudpath = cloudpath
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.fill_missing = fill_missing
+
+  def execute(self):
+    vol = Volume(self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
+                 bounded=False)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), vol.bounds
+    )
+    if bounds.empty():
+      return
+    img = vol.download(bounds)[..., 0]
+    labels, counts = fastremap.unique(img, return_counts=True)
+    cf = CloudFiles(vol.cloudpath)
+    cf.put_json(
+      f"{VOXEL_COUNT_DIR}/{self.mip}/{bounds.to_filename()}",
+      {str(int(l)): int(c) for l, c in zip(labels, counts)},
+      compress="gzip",
+    )
+
+
+def accumulate_voxel_counts(cloudpath: str, mip: int = 0) -> Dict[int, int]:
+  """Single-machine reduce: sum all census JSONs → ``voxel_counts.im``
+  (a FragMap of uint64 counts — the mapbuffer-format equivalent of the
+  reference's IntMap, task_creation/image.py:1975-2030). Returns totals."""
+  cf = CloudFiles(cloudpath)
+  totals: Dict[int, int] = defaultdict(int)
+  for key in cf.list(f"{VOXEL_COUNT_DIR}/{mip}/"):
+    doc = cf.get_json(key)
+    if not doc:
+      continue
+    for label, count in doc.items():
+      totals[int(label)] += int(count)
+  payload = {
+    label: struct.pack("<Q", count) for label, count in totals.items()
+  }
+  cf.put(f"{VOXEL_COUNT_DIR}/{mip}/voxel_counts.im", FragMap.tobytes(payload))
+  return dict(totals)
+
+
+def load_voxel_counts(cloudpath: str, mip: int = 0) -> Optional[FragMap]:
+  cf = CloudFiles(cloudpath)
+  data = cf.get(f"{VOXEL_COUNT_DIR}/{mip}/voxel_counts.im")
+  return None if data is None else FragMap.frombytes(data)
+
+
+class SpatialIndexTask(RegisteredTask):
+  """(Re)build one grid cell's .spatial file from the segmentation
+  (reference igneous/tasks/spatial_index.py:22-75)."""
+
+  def __init__(
+    self,
+    cloudpath: str,
+    prefix: str,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    mip: int = 0,
+    fill_missing: bool = False,
+  ):
+    self.cloudpath = cloudpath
+    self.prefix = prefix
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.mip = int(mip)
+    self.fill_missing = fill_missing
+
+  def execute(self):
+    from ..spatial_index import SpatialIndex
+
+    vol = Volume(self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
+                 bounded=False)
+    bounds = Bbox.intersection(
+      Bbox(self.offset, self.offset + self.shape), vol.bounds
+    )
+    if bounds.empty():
+      return
+    img = vol.download(bounds)[..., 0]
+    dense, mapping = fastremap.renumber(img)
+    slices = ndimage.find_objects(dense.astype(np.int32))
+    res = np.asarray(vol.resolution, dtype=np.int64)
+
+    label_bounds = {}
+    for new_id, sl in enumerate(slices, start=1):
+      if sl is None:
+        continue
+      mn = (np.asarray([s.start for s in sl]) + np.asarray(bounds.minpt)) * res
+      mx = (np.asarray([s.stop for s in sl]) + np.asarray(bounds.minpt)) * res
+      label_bounds[mapping[new_id]] = Bbox(mn, mx)
+
+    physical = Bbox(bounds.minpt * res, bounds.maxpt * res)
+    SpatialIndex(CloudFiles(vol.cloudpath), self.prefix).put(
+      physical, label_bounds
+    )
+
+
+class ReorderTask(RegisteredTask):
+  """Copy z-slices into a new z order (reference image.py:552):
+  dest[z] = src[mapping[z]] for the task's z range."""
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    mip: int,
+    z_start: int,
+    z_end: int,
+    mapping: Dict,
+    fill_missing: bool = False,
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.mip = int(mip)
+    self.z_start = int(z_start)
+    self.z_end = int(z_end)
+    self.mapping = {int(k): int(v) for k, v in mapping.items()}
+    self.fill_missing = fill_missing
+
+  def execute(self):
+    src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
+    dest = Volume(self.dest_path, mip=self.mip)
+    bounds = src.bounds
+    for z in range(self.z_start, self.z_end):
+      src_z = self.mapping.get(z, z)
+      sl = Bbox(
+        (bounds.minpt.x, bounds.minpt.y, src_z),
+        (bounds.maxpt.x, bounds.maxpt.y, src_z + 1),
+      )
+      dl = Bbox(
+        (bounds.minpt.x, bounds.minpt.y, z),
+        (bounds.maxpt.x, bounds.maxpt.y, z + 1),
+      )
+      dest.upload(dl, src.download(sl))

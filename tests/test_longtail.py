@@ -1,0 +1,243 @@
+"""Long-tail capability tests: contrast, voxel stats, spatial index,
+reorder, ROI detection, fixup, CLI."""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.volume import Volume
+
+
+def run(tasks):
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+
+
+# ---------------------------------------------------------------------------
+# contrast
+
+
+def test_luminance_levels_and_contrast(tmp_path, rng):
+  # dark image occupying a narrow band; stretch should widen it
+  data = rng.integers(100, 120, (128, 128, 4)).astype(np.uint8)
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dst"
+  Volume.from_numpy(data, src, chunk_size=(128, 128, 4))
+
+  run(tc.create_luminance_levels_tasks(src, coverage_factor=0.5))
+  vol = Volume(src)
+  levels_keys = list(vol.cf.list("levels/0/"))
+  assert len(levels_keys) == 4  # one histogram per z
+  doc = vol.cf.get_json(levels_keys[0])
+  assert sum(doc["levels"]) == doc["num_samples"]
+
+  run(tc.create_contrast_normalization_tasks(
+    src, dest, clip_fraction=0.01, shape=(128, 128, 4)))
+  out = Volume(dest)[Bbox((0, 0, 0), (128, 128, 4))][..., 0]
+  # dynamic range expanded well beyond the 20-value input band
+  assert int(out.max()) - int(out.min()) > 150
+
+
+def test_contrast_requires_levels(tmp_path, rng):
+  data = rng.integers(0, 255, (64, 64, 2)).astype(np.uint8)
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dst"
+  Volume.from_numpy(data, src, chunk_size=(64, 64, 2))
+  with pytest.raises(FileNotFoundError):
+    run(tc.create_contrast_normalization_tasks(
+      src, dest, shape=(64, 64, 2)))
+
+
+def test_clahe(tmp_path, rng):
+  data = rng.integers(90, 110, (256, 256, 2)).astype(np.uint8)
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dst"
+  Volume.from_numpy(data, src, chunk_size=(256, 256, 2))
+  run(tc.create_clahe_tasks(src, dest, shape=(256, 256, 2)))
+  out = Volume(dest)[Bbox((0, 0, 0), (256, 256, 2))][..., 0]
+  assert out.shape == data.shape
+  assert int(out.max()) - int(out.min()) >= int(data.max()) - int(data.min())
+
+
+# ---------------------------------------------------------------------------
+# voxel stats / spatial index / reorder
+
+
+def test_voxel_counting_and_accumulate(tmp_path, rng):
+  data = rng.integers(0, 5, (96, 96, 32)).astype(np.uint64) * 11
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, layer_type="segmentation")
+  run(tc.create_voxel_counting_tasks(path, shape=(64, 64, 32)))
+  totals = tc.accumulate_voxel_counts(path)
+  labels, counts = np.unique(data, return_counts=True)
+  assert totals == {int(l): int(c) for l, c in zip(labels, counts)}
+  # the reduced FragMap is loadable with packed uint64 counts
+  fm = tc.load_voxel_counts(path)
+  for l, c in zip(labels, counts):
+    assert struct.unpack("<Q", fm[int(l)])[0] == c
+
+
+def test_spatial_index_task(tmp_path):
+  from igneous_tpu.spatial_index import SpatialIndex
+
+  data = np.zeros((96, 64, 32), np.uint64)
+  data[10:30, 10:30, 5:20] = 42
+  data[70:90, 10:30, 5:20] = 77
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, resolution=(2, 2, 2),
+                    layer_type="segmentation")
+  run(tc.create_spatial_index_tasks(path, prefix="six", shape=(48, 64, 32)))
+  vol = Volume(path)
+  si = SpatialIndex(vol.cf, "six")
+  assert si.query() == {42, 77}
+  # physical-space query at res 2: label 42 lives in x<60nm
+  assert si.query(Bbox((0, 0, 0), (61, 128, 64))) == {42}
+
+
+def test_reorder_task(tmp_path, rng):
+  data = rng.integers(0, 255, (64, 64, 8)).astype(np.uint8)
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dst"
+  Volume.from_numpy(data, src, chunk_size=(64, 64, 1))
+  mapping = {z: 7 - z for z in range(8)}  # reverse z
+  run(tc.create_reordering_tasks(src, dest, mapping, z_per_task=3))
+  out = Volume(dest)[Bbox((0, 0, 0), (64, 64, 8))][..., 0]
+  assert np.array_equal(out, data[:, :, ::-1])
+
+
+def test_compute_rois(tmp_path):
+  data = np.zeros((128, 128, 32), np.uint8)
+  data[10:50, 10:50, 5:25] = 200
+  data[90:120, 80:120, 5:25] = 180
+  path = f"file://{tmp_path}/img"
+  Volume.from_numpy(data, path, resolution=(4, 4, 4))
+  rois = tc.compute_rois(path, threshold=10, dust_threshold=10)
+  assert len(rois) == 2
+  assert any(r.contains((10 * 4 + 1, 10 * 4 + 1, 5 * 4 + 1)) for r in rois)
+
+
+def test_fixup_downsample(tmp_path, rng):
+  from igneous_tpu.ops import oracle
+
+  path = f"file://{tmp_path}/img"
+  data = rng.integers(0, 255, (128, 128, 64)).astype(np.uint8)
+  Volume.from_numpy(data, path)
+  run(tc.create_downsampling_tasks(path, num_mips=1,
+                                   memory_target=16 * 1024 * 1024))
+  vol = Volume(path)
+  # damage a mip-1 chunk, then fix it up
+  vol.delete(Bbox((0, 0, 0), (64, 64, 64)), mip=1)
+  tasks = list(tc.create_fixup_downsample_tasks(
+    path, [Bbox((0, 0, 0), (10, 10, 10))], shape=(128, 128, 64)))
+  assert len(tasks) == 1
+  run(tasks)
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  exp = oracle.np_downsample_with_averaging(data, (2, 2, 1))[0]
+  assert np.array_equal(out[..., 0], exp)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_end_to_end(tmp_path, rng):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  arr = rng.integers(0, 255, (128, 128, 64)).astype(np.uint8)
+  npy = tmp_path / "img.npy"
+  np.save(npy, arr)
+  runner = CliRunner()
+
+  r = runner.invoke(main, [
+    "image", "create", str(npy), f"file://{tmp_path}/vol",
+    "--resolution", "4,4,40", "--chunk-size", "64,64,64",
+  ])
+  assert r.exit_code == 0, r.output
+
+  r = runner.invoke(main, [
+    "image", "downsample", f"file://{tmp_path}/vol",
+    "--num-mips", "2", "--memory", str(16 * 1024 * 1024),
+  ])
+  assert r.exit_code == 0, r.output
+  vol = Volume(f"file://{tmp_path}/vol")
+  assert vol.meta.num_mips == 3
+
+  r = runner.invoke(main, ["design", "ds-shape", f"file://{tmp_path}/vol"])
+  assert r.exit_code == 0 and "," in r.output
+
+  r = runner.invoke(main, [
+    "design", "bounds", f"file://{tmp_path}/vol"])
+  assert r.exit_code == 0 and "chunks:" in r.output
+
+
+def test_cli_queue_workflow(tmp_path, rng):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  arr = rng.integers(0, 255, (64, 64, 64)).astype(np.uint8)
+  Volume.from_numpy(arr, f"file://{tmp_path}/vol")
+  runner = CliRunner()
+  q = f"fq://{tmp_path}/q"
+
+  r = runner.invoke(main, [
+    "image", "downsample", f"file://{tmp_path}/vol", "--queue", q,
+    "--num-mips", "1", "--memory", str(16 * 1024 * 1024),
+  ])
+  assert r.exit_code == 0, r.output
+
+  r = runner.invoke(main, ["queue", "status", q])
+  assert "enqueued: 1" in r.output
+
+  r = runner.invoke(main, ["execute", q, "--exit-on-empty"])
+  assert r.exit_code == 0, r.output
+  assert Volume(f"file://{tmp_path}/vol").meta.num_mips == 2
+
+  r = runner.invoke(main, ["queue", "status", q])
+  assert "completed: 1" in r.output
+
+
+def test_levels_uint16(tmp_path, rng):
+  data = rng.integers(20000, 22000, (128, 128, 2)).astype(np.uint16)
+  src = f"file://{tmp_path}/src16"
+  dest = f"file://{tmp_path}/dst16"
+  Volume.from_numpy(data, src, chunk_size=(128, 128, 2))
+  run(tc.create_luminance_levels_tasks(src, coverage_factor=0.5))
+  run(tc.create_contrast_normalization_tasks(
+    src, dest, shape=(128, 128, 2), maxval=65535))
+  out = Volume(dest)[Bbox((0, 0, 0), (128, 128, 2))][..., 0]
+  assert int(out.max()) - int(out.min()) > 30000  # stretched
+
+
+def test_levels_rejects_float(tmp_path, rng):
+  data = rng.random((64, 64, 1)).astype(np.float32)
+  src = f"file://{tmp_path}/f32"
+  Volume.from_numpy(data, src, chunk_size=(64, 64, 1), layer_type="image")
+  with pytest.raises(ValueError):
+    run(tc.create_luminance_levels_tasks(src, coverage_factor=0.5))
+
+
+def test_teasar_params_ignores_unknown():
+  import warnings
+  from igneous_tpu.ops.skeletonize import TeasarParams
+  with warnings.catch_warnings(record=True) as w:
+    warnings.simplefilter("always")
+    p = TeasarParams.from_dict(
+      {"scale": 3, "const": 10, "fix_branching": True})
+  assert p.scale == 3 and p.const == 10
+  assert any("fix_branching" in str(x.message) for x in w)
+
+
+def test_skeleton_prefix_coverage():
+  from igneous_tpu.task_creation.common import label_prefixes
+  prefixes = list(label_prefixes(2))
+  assert len(prefixes) == len(set(prefixes))
+  for label in (1, 9, 10, 99, 100, 54321):
+    hits = [p for p in prefixes if f"{label}:x".startswith(p)]
+    assert len(hits) == 1, (label, hits)

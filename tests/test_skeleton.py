@@ -1,0 +1,217 @@
+"""Skeleton tests: device EDT oracle, TEASAR geometry, codec round-trips,
+postprocess pruning, and the forge→merge pipelines (reference strategy:
+parametrized skeletonization asserting non-empty vertices,
+test/test_tasks.py:700-735)."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox
+from igneous_tpu.mesh_io import FragMap
+from igneous_tpu.ops.edt import edt
+from igneous_tpu.ops.skeletonize import TeasarParams, skeletonize, skeletonize_mask
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.skeleton_io import Skeleton, postprocess
+from igneous_tpu.volume import Volume
+
+
+def run(tasks):
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+
+
+# ---------------------------------------------------------------------------
+# EDT
+
+
+def scipy_multilabel_edt(labels, anisotropy):
+  out = np.zeros(labels.shape, np.float32)
+  for v in np.unique(labels):
+    if v == 0:
+      continue
+    d = ndimage.distance_transform_edt(labels == v, sampling=anisotropy)
+    out[labels == v] = d[labels == v]
+  return out
+
+
+@pytest.mark.parametrize("anisotropy", [(1, 1, 1), (4, 4, 40)])
+def test_edt_multilabel_vs_scipy(rng, anisotropy):
+  lab = (rng.integers(0, 3, (22, 18, 14)) * 9).astype(np.uint64)
+  got = edt(lab, anisotropy)
+  exp = scipy_multilabel_edt(lab, anisotropy)
+  assert np.allclose(got, exp, atol=1e-3)
+
+
+def test_edt_black_border():
+  mask = np.ones((10, 10, 10), np.uint8)
+  d = edt(mask, (1, 1, 1), black_border=True)
+  assert d[0, 0, 0] == 1.0
+  assert d[5, 5, 5] == 5.0  # nearest padded border voxel at index 10
+
+
+# ---------------------------------------------------------------------------
+# TEASAR
+
+
+def test_skeletonize_tube_centerline():
+  mask = np.zeros((60, 12, 12), bool)
+  mask[2:58, 3:9, 3:9] = True
+  s = skeletonize_mask(mask, params=TeasarParams(scale=4, const=3))
+  assert len(s) > 20
+  assert len(np.unique(s.components_by_vertex())) == 1
+  # centerline spans the tube and stays near the axis
+  assert s.vertices[:, 0].max() - s.vertices[:, 0].min() > 45
+  assert np.abs(s.vertices[:, 1] - 5.5).mean() < 1.5
+  assert (s.radii > 0).all()
+
+
+def test_skeletonize_multilabel_anisotropy(rng):
+  lab = np.zeros((40, 20, 20), np.uint64)
+  lab[2:18, 4:16, 4:16] = 7
+  lab[22:38, 4:16, 4:16] = 9
+  skels = skeletonize(lab, anisotropy=(2, 2, 2),
+                      params=TeasarParams(scale=4, const=6))
+  assert sorted(skels) == [7, 9]
+  for s in skels.values():
+    assert not s.empty
+    # physical units: vertices are scaled by anisotropy
+    assert s.vertices.max() <= 40 * 2
+
+
+def test_extra_targets_pin_vertices():
+  mask = np.zeros((30, 10, 10), bool)
+  mask[2:28, 2:8, 2:8] = True
+  target = np.array([[27, 5, 5]])
+  s = skeletonize_mask(
+    mask, params=TeasarParams(scale=4, const=3), extra_targets=target
+  )
+  assert np.any(np.all(s.vertices == np.array([27, 5, 5], np.float32), axis=1))
+
+
+# ---------------------------------------------------------------------------
+# container / codec / postprocess
+
+
+def test_skeleton_precomputed_roundtrip(rng):
+  s = Skeleton(
+    rng.random((12, 3)).astype(np.float32) * 100,
+    rng.integers(0, 12, (11, 2)),
+    radii=rng.random(12).astype(np.float32),
+    vertex_types=rng.integers(0, 4, 12).astype(np.uint8),
+  )
+  s2 = Skeleton.from_precomputed(s.to_precomputed())
+  assert np.array_equal(s.vertices, s2.vertices)
+  assert np.array_equal(s.edges, s2.edges)
+  assert np.array_equal(s.radii, s2.radii)
+  assert np.array_equal(s.vertex_types, s2.vertex_types)
+
+
+def test_simple_merge_and_consolidate():
+  a = Skeleton([[0, 0, 0], [10, 0, 0]], [[0, 1]], radii=[1, 2])
+  b = Skeleton([[10, 0, 0], [20, 0, 0]], [[0, 1]], radii=[2, 3])
+  m = Skeleton.simple_merge([a, b]).consolidate()
+  assert len(m) == 3  # shared vertex welded
+  assert len(m.edges) == 2
+  assert len(np.unique(m.components_by_vertex())) == 1
+  assert m.cable_length() == 20.0
+
+
+def test_postprocess_dust_and_ticks():
+  # main path 0-100nm with a 3nm tick hanging off the middle, plus a tiny
+  # separate dust component
+  verts = [[float(i * 10), 0, 0] for i in range(11)]  # 0..100
+  edges = [[i, i + 1] for i in range(10)]
+  verts.append([50.0, 3.0, 0])  # tick vertex near the middle (idx 11)
+  edges.append([5, 11])
+  verts.append([500.0, 500.0, 0])  # dust (idx 12)
+  verts.append([501.0, 500.0, 0])  # dust (idx 13)
+  edges.append([12, 13])
+  s = Skeleton(verts, edges)
+  out = postprocess(s, dust_threshold=50.0, tick_threshold=5.0)
+  assert len(out) == 11  # tick and dust removed
+  assert len(np.unique(out.components_by_vertex())) == 1
+  assert abs(out.cable_length() - 100.0) < 1e-3
+
+
+# ---------------------------------------------------------------------------
+# pipelines
+
+
+def make_tube_seg(tmp_path, shape=(120, 32, 32)):
+  data = np.zeros(shape, np.uint64)
+  data[4:116, 10:22, 10:22] = 55  # tube crossing the x=64 task boundary
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, resolution=(16, 16, 16),
+                    layer_type="segmentation", chunk_size=(64, 32, 32))
+  return path, data
+
+
+def test_skeleton_forge_and_unsharded_merge(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+  ))
+  vol = Volume(path)
+  assert vol.info["skeletons"].startswith("skeletons")
+  sdir = vol.info["skeletons"]
+  info = vol.cf.get_json(f"{sdir}/info")
+  assert info["@type"] == "neuroglancer_skeletons"
+  frag_keys = [k for k in vol.cf.list(f"{sdir}/") if k.endswith(".sk")]
+  assert len(frag_keys) == 2  # one fragment per task
+
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, magnitude=1, dust_threshold=100, tick_threshold=100))
+  final = vol.cf.get(f"{sdir}/55")
+  assert final is not None
+  s = Skeleton.from_precomputed(final)
+  # merged skeleton: connected across the task boundary, spans the tube
+  assert len(np.unique(s.components_by_vertex())) == 1
+  span = s.vertices[:, 0].max() - s.vertices[:, 0].min()
+  assert span > 100 * 16 * 0.8  # ≥80% of tube length in nm
+
+
+def test_skeleton_forge_sharded_merge(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10, sharded=True,
+    teasar_params={"scale": 4, "const": 50},
+  ))
+  vol = Volume(path)
+  sdir = vol.info["skeletons"]
+  frag_keys = [k for k in vol.cf.list(f"{sdir}/") if k.endswith(".frags")]
+  assert len(frag_keys) == 2
+  FragMap.frombytes(vol.cf.get(frag_keys[0]))  # container decodes
+
+  run(tc.create_sharded_skeleton_merge_tasks(
+    path, dust_threshold=100, tick_threshold=100))
+  shard_files = [k for k in vol.cf.list(f"{sdir}/") if k.endswith(".shard")]
+  assert len(shard_files) >= 1
+  # read the merged skeleton back through the shard reader
+  from igneous_tpu.sharding import ShardReader, ShardingSpecification
+  info = vol.cf.get_json(f"{sdir}/info")
+  spec = ShardingSpecification.from_dict(info["sharding"])
+  reader = ShardReader(vol.cf, spec, prefix=sdir)
+  blob = reader.get_chunk(55)
+  assert blob is not None
+  s = Skeleton.from_precomputed(blob)
+  assert len(np.unique(s.components_by_vertex())) == 1
+
+
+def test_consolidate_keeps_first_attributes():
+  s = Skeleton([[0, 0, 0], [1, 0, 0], [0, 0, 0]], [[0, 1], [2, 1]],
+               radii=[5, 6, 7])
+  out = s.consolidate()
+  got = {tuple(v): r for v, r in zip(out.vertices.tolist(), out.radii.tolist())}
+  assert got[(0, 0, 0)] == 5.0 and got[(1, 0, 0)] == 6.0
+
+
+def test_skeletonize_disconnected_components():
+  mask = np.zeros((40, 10, 10), bool)
+  mask[2:14, 2:8, 2:8] = True
+  mask[25:37, 2:8, 2:8] = True
+  s = skeletonize_mask(mask, params=TeasarParams(scale=4, const=3))
+  assert len(np.unique(s.components_by_vertex())) == 2
+  xs = s.vertices[:, 0]
+  assert xs.min() < 14 and xs.max() > 25  # both pieces skeletonized
