@@ -114,6 +114,32 @@ def _encode_channel(chan: np.ndarray, block_size: Tuple[int, int, int]) -> np.nd
   return headers
 
 
+def _native_encode_channel(chan: np.ndarray, block_size) -> "np.ndarray | None":
+  """C++ fast path (igneous_tpu/native/csrc/cseg.cpp); None → numpy path."""
+  import ctypes
+
+  from .native import cseg_lib
+
+  lib = cseg_lib()
+  if lib is None:
+    return None
+  chan = np.ascontiguousarray(chan)
+  out = ctypes.POINTER(ctypes.c_uint32)()
+  n = lib.cseg_encode_channel(
+    chan.ctypes.data_as(ctypes.c_void_p),
+    1 if chan.dtype.itemsize == 8 else 0,
+    *[int(v) for v in chan.shape],
+    *[int(b) for b in block_size],
+    ctypes.byref(out),
+  )
+  if n <= 0:
+    return None
+  try:
+    return np.ctypeslib.as_array(out, shape=(n,)).copy()
+  finally:
+    lib.cseg_free(out)
+
+
 def compress(img: np.ndarray, block_size: Sequence[int] = (8, 8, 8)) -> bytes:
   """img: (x, y, z, c) array of uint32/uint64 (smaller uints are widened)."""
   if img.ndim == 3:
@@ -128,11 +154,36 @@ def compress(img: np.ndarray, block_size: Sequence[int] = (8, 8, 8)) -> bytes:
   offsets = np.zeros(num_channels, dtype=np.uint32)
   pos = num_channels
   for c in range(num_channels):
-    enc = _encode_channel(img[:, :, :, c], tuple(int(b) for b in block_size))
+    enc = _native_encode_channel(img[:, :, :, c], block_size)
+    if enc is None:
+      enc = _encode_channel(img[:, :, :, c], tuple(int(b) for b in block_size))
     offsets[c] = pos
     pos += len(enc)
     channels.append(enc)
   return np.concatenate([offsets] + channels).tobytes()
+
+
+def _native_decode_channel(words, shape3, dtype, block_size):
+  import ctypes
+
+  from .native import cseg_lib
+
+  lib = cseg_lib()
+  if lib is None:
+    return None
+  words = np.ascontiguousarray(words)
+  out = np.empty(shape3, dtype=dtype)
+  rc = lib.cseg_decode_channel(
+    words.ctypes.data_as(ctypes.c_void_p),
+    len(words),
+    1 if np.dtype(dtype).itemsize == 8 else 0,
+    *[int(v) for v in shape3],
+    *[int(b) for b in block_size],
+    out.ctypes.data_as(ctypes.c_void_p),
+  )
+  if rc != 0:
+    raise ValueError(f"corrupt compressed_segmentation stream (code {rc})")
+  return out
 
 
 def decompress(
@@ -145,21 +196,53 @@ def decompress(
   words = np.frombuffer(bytearray(data), dtype=np.uint32)
   sx, sy, sz, num_channels = [int(v) for v in shape]
   bx, by, bz = [int(b) for b in block_size]
+
+  # native fast path decodes whole channels; needs a word dtype matching
+  # the output dtype width (uint32/uint64)
+  if np.dtype(dtype).itemsize in (4, 8):
+    native_dtype = np.uint64 if np.dtype(dtype).itemsize == 8 else np.uint32
+    outs = []
+    ok = True
+    for c in range(num_channels):
+      start = int(words[c])
+      end = int(words[c + 1]) if c + 1 < num_channels else len(words)
+      chan = _native_decode_channel(
+        words[start:end] if c + 1 < num_channels else words[start:],
+        (sx, sy, sz), native_dtype, (bx, by, bz),
+      )
+      if chan is None:
+        ok = False
+        break
+      outs.append(chan)
+    if ok:
+      return np.stack(outs, axis=-1).astype(dtype)
   gx, gy, gz = -(-sx // bx), -(-sy // by), -(-sz // bz)
   dtype = np.dtype(dtype)
   words_per_entry = 2 if dtype.itemsize == 8 else 1
 
   out = np.zeros((sx, sy, sz, num_channels), dtype=np.uint64)
 
+  def corrupt(reason: str):
+    # mirror the native decoder: invalid offsets fail loudly instead of
+    # silently truncating (the two paths must behave identically)
+    raise ValueError(f"corrupt compressed_segmentation stream ({reason})")
+
+  total_words = len(words)
   for c in range(num_channels):
+    if c >= total_words:
+      corrupt("missing channel offset")
     base = int(words[c])
     bi = 0
     for z0 in range(0, gz * bz, bz):
       for y0 in range(0, gy * by, by):
         for x0 in range(0, gx * bx, bx):
+          if base + 2 * bi + 1 >= total_words:
+            corrupt("header out of range")
           w0 = int(words[base + 2 * bi])
           w1 = int(words[base + 2 * bi + 1])
           bits = w0 >> 24
+          if bits not in VALID_BITS:
+            corrupt(f"invalid bit width {bits}")
           table_offset = base + (w0 & 0xFFFFFF)
           values_offset = base + w1
           cx = min(bx, sx - x0)
@@ -172,6 +255,8 @@ def decompress(
           else:
             vals_per_word = 32 // bits
             nwords = -(-n // vals_per_word)
+            if values_offset + nwords > total_words:
+              corrupt("encoded values out of range")
             packed = words[values_offset : values_offset + nwords]
             shifts = (np.arange(vals_per_word, dtype=np.uint32) * np.uint32(bits))
             mask = np.uint32((1 << bits) - 1) if bits < 32 else np.uint32(0xFFFFFFFF)
@@ -180,6 +265,8 @@ def decompress(
 
           max_idx = int(idx.max()) if n else 0
           tlen = (max_idx + 1) * words_per_entry
+          if table_offset + tlen > total_words:
+            corrupt("lookup table out of range")
           traw = words[table_offset : table_offset + tlen]
           if words_per_entry == 2:
             table = traw[0::2].astype(np.uint64) | (

@@ -1,0 +1,181 @@
+"""Multi-resolution (LOD) mesh format: octree chunking + manifests.
+
+Reference parity: /root/reference/igneous/tasks/mesh/multires.py
+(process_mesh :83-178, create_octree_level_from_mesh + z-order sort
+:515-586, labels_for_shard :484-508) and igneous/tasks/mesh/draco.py
+(quantization settings solver :7-59).
+
+Produces the Neuroglancer ``neuroglancer_multilod_draco`` structures:
+per-label manifest (chunk grid, lod scales, fragment positions/sizes) and
+per-LOD octree fragments. Fragment payload encoding goes through the
+pluggable draco hook (mesh_io.register_draco_codec) — no draco library
+ships in this environment, so consumers must register one (tests register
+a stand-in codec to exercise the full structure).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .lib import Bbox
+from .mesh_io import Mesh, encode_mesh, simplify
+from .sharding import compressed_morton_code
+
+
+def draco_quantization_settings(
+  chunk_size: Sequence[float],
+  grid_origin: Sequence[float],
+  mesh_bbox: Bbox,
+  quantization_bits: int = 16,
+) -> dict:
+  """Quantization origin/range/bits such that the draco grid aligns with
+  chunk boundaries (fresh derivation of reference draco.py:7-59: the
+  quantization step must evenly divide the chunk so fragment borders land
+  on representable positions and adjacent fragments stitch exactly)."""
+  chunk_size = np.asarray(chunk_size, dtype=np.float64)
+  grid_origin = np.asarray(grid_origin, dtype=np.float64)
+  span = np.asarray(mesh_bbox.maxpt, np.float64) - grid_origin
+  n_chunks = np.maximum(np.ceil(span / chunk_size), 1)
+  full_range = float(np.max(n_chunks * chunk_size))
+  # steps per chunk must be a power of two so every chunk boundary is a
+  # lattice point; choose the largest bits that keeps that true
+  steps = (1 << quantization_bits) - 1
+  steps_per_chunk = steps * chunk_size.max() / full_range
+  bits_per_chunk = int(np.floor(np.log2(max(steps_per_chunk, 1))))
+  return {
+    "quantization_origin": [float(v) for v in grid_origin],
+    "quantization_range": full_range,
+    "quantization_bits": quantization_bits,
+    "steps_per_chunk": 1 << max(bits_per_chunk, 0),
+  }
+
+
+def _zorder(positions: np.ndarray) -> np.ndarray:
+  """Sort order of (n, 3) grid positions by compressed morton code
+  (reference multires.py:515-529)."""
+  if len(positions) == 0:
+    return np.zeros(0, dtype=np.int64)
+  gs = positions.max(axis=0) + 1
+  codes = [int(compressed_morton_code(p, gs)) for p in positions]
+  return np.argsort(np.asarray(codes), kind="stable")
+
+
+def octree_fragments(
+  mesh: Mesh, chunk_size: np.ndarray, grid_origin: np.ndarray
+) -> Dict[Tuple[int, int, int], Mesh]:
+  """Split a mesh into octree cells; each triangle goes to the cell
+  containing its centroid (the reference retriangulates at cell walls via
+  zmesh.chunk_mesh; centroid assignment keeps geometry identical while
+  letting fragments slightly overhang their cells)."""
+  if len(mesh.faces) == 0:
+    return {}
+  tri = mesh.vertices[mesh.faces.astype(np.int64)]  # (F, 3, 3)
+  centroids = tri.mean(axis=1)
+  cells = np.floor((centroids - grid_origin) / chunk_size).astype(np.int64)
+  cells = np.maximum(cells, 0)
+  out: Dict[Tuple[int, int, int], Mesh] = {}
+  keys, inverse = np.unique(cells, axis=0, return_inverse=True)
+  for i, key in enumerate(keys):
+    faces = mesh.faces[inverse == i]
+    sub = Mesh(mesh.vertices, faces).consolidate()
+    out[tuple(int(v) for v in key)] = sub
+  return out
+
+
+def generate_lods(mesh: Mesh, num_lods: int, reduction: float = 4.0) -> List[Mesh]:
+  """LOD pyramid: lod 0 is the full mesh; each level reduces ~4x
+  (reference multires.py:308-359 via fqmr; here the clustering simplifier)."""
+  lods = [mesh]
+  for _ in range(1, num_lods):
+    prev = lods[-1]
+    if len(prev.faces) <= 16:
+      lods.append(prev.clone())
+      continue
+    lods.append(simplify(prev, reduction_factor=reduction, max_error=None))
+  return lods
+
+
+def process_mesh(
+  mesh: Mesh,
+  num_lods: int = 2,
+  chunk_size: Optional[Sequence[float]] = None,
+  encoding: str = "draco",
+  quantization_bits: int = 16,
+) -> Tuple[bytes, bytes]:
+  """One label's mesh → (manifest bytes, concatenated fragment bytes).
+
+  Neuroglancer multilod manifest layout (little endian):
+    chunk_shape float32[3] | grid_origin float32[3] | num_lods uint32 |
+    lod_scales float32[num_lods] | vertex_offsets float32[num_lods][3] |
+    num_fragments_per_lod uint32[num_lods] |
+    per lod: fragment_positions uint32[n][3], fragment_offsets uint32[n]
+  Fragment data is concatenated lod 0 … lod n-1, z-order within each lod,
+  in exactly the order fragment_offsets describes.
+  """
+  mesh = mesh.consolidate()
+  if len(mesh.vertices) == 0:
+    raise ValueError("empty mesh")
+  mn = mesh.vertices.min(axis=0)
+  mx = mesh.vertices.max(axis=0)
+  if chunk_size is None:
+    # one chunk at the coarsest lod
+    chunk_size = (mx - mn) / (2 ** (num_lods - 1)) + 1e-3
+  chunk_size = np.asarray(chunk_size, dtype=np.float32)
+  grid_origin = mn.astype(np.float32)
+
+  lods = generate_lods(mesh, num_lods)
+
+  frag_payloads: List[bytes] = []
+  lod_positions: List[np.ndarray] = []
+  lod_sizes: List[np.ndarray] = []
+  for lod, lod_mesh in enumerate(lods):
+    cell = chunk_size * (2**lod)
+    frags = octree_fragments(lod_mesh, cell, grid_origin)
+    positions = np.asarray(sorted(frags.keys()), dtype=np.int64).reshape(-1, 3)
+    order = _zorder(positions)
+    positions = positions[order]
+    sizes = []
+    for pos in positions:
+      payload = encode_mesh(frags[tuple(int(v) for v in pos)], encoding)
+      frag_payloads.append(payload)
+      sizes.append(len(payload))
+    lod_positions.append(positions.astype(np.uint32))
+    lod_sizes.append(np.asarray(sizes, dtype=np.uint32))
+
+  manifest = [
+    chunk_size.astype("<f4").tobytes(),
+    grid_origin.astype("<f4").tobytes(),
+    struct.pack("<I", num_lods),
+    np.asarray([2.0**lod for lod in range(num_lods)], "<f4").tobytes(),
+    np.zeros((num_lods, 3), "<f4").tobytes(),  # vertex_offsets
+    np.asarray([len(p) for p in lod_positions], "<u4").tobytes(),
+  ]
+  for positions, sizes in zip(lod_positions, lod_sizes):
+    manifest.append(positions.astype("<u4").tobytes())
+    manifest.append(sizes.astype("<u4").tobytes())
+
+  return b"".join(manifest), b"".join(frag_payloads)
+
+
+def multires_info(
+  vertex_quantization_bits: int = 16,
+  transform: Optional[Sequence[float]] = None,
+  sharding: Optional[dict] = None,
+  mip: int = 0,
+) -> dict:
+  """The multires mesh dir's info file
+  (reference configure_multires_info, task_creation/mesh.py:437-479)."""
+  info = {
+    "@type": "neuroglancer_multilod_draco",
+    "vertex_quantization_bits": int(vertex_quantization_bits),
+    "transform": list(transform) if transform is not None
+    else [1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0],
+    "lod_scale_multiplier": 1,
+    "mip": int(mip),
+  }
+  if sharding is not None:
+    info["sharding"] = sharding
+  return info

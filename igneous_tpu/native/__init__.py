@@ -1,0 +1,90 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its per-voxel host codecs in compiled C++ packages
+(SURVEY.md §2.3); igneous_tpu builds its equivalents from ``csrc/`` on
+first use with the system toolchain and falls back to the pure-numpy
+implementations when no compiler is available
+(set IGNEOUS_TPU_NO_NATIVE=1 to force the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "csrc")
+_BUILD = os.path.join(_HERE, "build")
+
+_lock = threading.Lock()
+_libs = {}
+_failed = set()
+
+
+def _build_lib(name: str) -> Optional[str]:
+  src = os.path.join(_CSRC, f"{name}.cpp")
+  # content-hash in the artifact name: staleness is decided by the source
+  # bytes, never by mtimes (git checkouts do not preserve them)
+  with open(src, "rb") as f:
+    digest = hashlib.sha256(f.read()).hexdigest()[:12]
+  out = os.path.join(_BUILD, f"lib{name}-{digest}.so")
+  if os.path.exists(out):
+    return out
+  os.makedirs(_BUILD, exist_ok=True)
+  tmp = out + f".tmp{os.getpid()}"
+  cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+  try:
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+  except Exception:
+    return None
+  os.replace(tmp, out)
+  return out
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+  """Compile (if needed) and load csrc/<name>.cpp; None on any failure."""
+  if os.environ.get("IGNEOUS_TPU_NO_NATIVE"):
+    return None
+  with _lock:
+    if name in _libs:
+      return _libs[name]
+    if name in _failed:
+      return None
+    path = _build_lib(name)
+    if path is None:
+      _failed.add(name)
+      return None
+    try:
+      lib = ctypes.CDLL(path)
+    except OSError:
+      _failed.add(name)
+      return None
+    _libs[name] = lib
+    return lib
+
+
+def cseg_lib() -> Optional[ctypes.CDLL]:
+  lib = load("cseg")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.cseg_encode_channel.restype = ctypes.c_int64
+    lib.cseg_encode_channel.argtypes = [
+      ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+      ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+      ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
+    ]
+    lib.cseg_free.restype = None
+    lib.cseg_free.argtypes = [ctypes.POINTER(ctypes.c_uint32)]
+    lib.cseg_decode_channel.restype = ctypes.c_int
+    lib.cseg_decode_channel.argtypes = [
+      ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+      ctypes.c_int, ctypes.c_int, ctypes.c_int,
+      ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+    ]
+    lib._configured = True
+  return lib

@@ -215,9 +215,20 @@ class ShardingSpecification:
       return gzip_mod.decompress(data)
     return data
 
-  def synthesize_shard(self, chunks: Dict[int, bytes]) -> bytes:
+  def synthesize_shard(
+    self,
+    chunks: Dict[int, bytes],
+    preambles: Optional[Dict[int, bytes]] = None,
+  ) -> bytes:
     """Build one shard file from {chunk_id: raw bytes}. All ids must map to
-    the same shard number (not re-verified here)."""
+    the same shard number (not re-verified here).
+
+    ``preambles``: optional per-id bytes written immediately BEFORE the
+    indexed chunk content but excluded from its indexed byte range — the
+    multires mesh layout, where fragment data precedes each label's
+    manifest in the shard (requires data_encoding='raw')."""
+    if preambles and self.data_encoding != "raw":
+      raise ValueError("preambles require data_encoding='raw'")
     n_minishards = 1 << self.minishard_bits
     buckets: Dict[int, List[Tuple[int, bytes]]] = {}
     for cid, data in chunks.items():
@@ -235,10 +246,14 @@ class ShardingSpecification:
       sizes = np.array([len(r) for r in raw], dtype=U64)
       starts = np.zeros(len(raw), dtype=U64)
       pos = data_pos
-      for i, r in enumerate(raw):
+      for i, (cid, _) in enumerate(entries):
+        pre = preambles.get(cid, b"") if preambles else b""
+        if pre:
+          data_parts.append(pre)
+          pos += len(pre)
         starts[i] = pos
-        pos += len(r)
-      data_parts.extend(raw)
+        pos += len(raw[i])
+        data_parts.append(raw[i])
 
       index = np.zeros((3, len(raw)), dtype=U64)
       index[0, 0] = ids[0]
@@ -269,7 +284,11 @@ class ShardingSpecification:
 
     return shard_index.tobytes() + b"".join(data_parts)
 
-  def synthesize_shard_files(self, chunks: Dict[int, bytes]) -> Dict[str, bytes]:
+  def synthesize_shard_files(
+    self,
+    chunks: Dict[int, bytes],
+    preambles: Optional[Dict[int, bytes]] = None,
+  ) -> Dict[str, bytes]:
     """Group {chunk_id: bytes} by shard and build every shard file."""
     ids = np.array(sorted(chunks.keys()), dtype=U64)
     if len(ids) == 0:
@@ -279,7 +298,8 @@ class ShardingSpecification:
     for s in np.unique(shard_nums):
       members = ids[shard_nums == s]
       out[self.shard_filename(int(s))] = self.synthesize_shard(
-        {int(i): chunks[int(i)] for i in members}
+        {int(i): chunks[int(i)] for i in members},
+        preambles=preambles,
       )
     return out
 

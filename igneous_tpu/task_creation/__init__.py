@@ -20,6 +20,7 @@ from .ccl import (
   create_relabeling,
 )
 from .skeleton import (
+  create_sharded_from_unsharded_skeleton_merge_tasks,
   create_sharded_skeleton_merge_tasks,
   create_skeleton_deletion_tasks,
   create_skeleton_transfer_tasks,
@@ -27,10 +28,14 @@ from .skeleton import (
   create_unsharded_skeleton_merge_tasks,
 )
 from .mesh import (
+  configure_multires_info,
   create_mesh_deletion_tasks,
   create_mesh_manifest_tasks,
   create_mesh_transfer_tasks,
   create_meshing_tasks,
+  create_sharded_multires_mesh_from_unsharded_tasks,
+  create_sharded_multires_mesh_tasks,
+  create_unsharded_multires_mesh_tasks,
 )
 from .image import (
   MEMORY_TARGET,

@@ -115,6 +115,136 @@ def create_mesh_manifest_tasks(
     )
 
 
+def configure_multires_info(
+  cloudpath: str,
+  mesh_dir: str,
+  vertex_quantization_bits: int = 16,
+  sharding: Optional[dict] = None,
+  mip: int = 0,
+) -> dict:
+  """Write the multires mesh dir's info and point the layer at it
+  (reference task_creation/mesh.py:437-479)."""
+  from ..mesh_multires import multires_info
+
+  vol = Volume(cloudpath)
+  info = multires_info(
+    vertex_quantization_bits=vertex_quantization_bits,
+    sharding=sharding,
+    mip=mip,
+  )
+  vol.cf.put_json(f"{mesh_dir}/info", info)
+  vol.info["mesh"] = mesh_dir
+  vol.commit_info()
+  return info
+
+
+def create_unsharded_multires_mesh_tasks(
+  cloudpath: str,
+  magnitude: int = 2,
+  src_mesh_dir: Optional[str] = None,
+  mesh_dir: Optional[str] = None,
+  num_lods: int = 2,
+  encoding: str = "draco",
+) -> Iterator:
+  """Legacy fragments → unsharded multires (reference :481-546)."""
+  from ..tasks.mesh import mesh_dir_for
+  from ..tasks.mesh_multires import MultiResUnshardedMeshMergeTask
+  from .common import label_prefixes
+
+  vol = Volume(cloudpath)
+  src = mesh_dir_for(vol, src_mesh_dir)  # raises if nothing is configured
+  out = mesh_dir or f"{src}_multires"
+  configure_multires_info(cloudpath, out)
+  for prefix in label_prefixes(magnitude):
+    yield MultiResUnshardedMeshMergeTask(
+      cloudpath=cloudpath,
+      prefix=prefix,
+      src_mesh_dir=src,
+      mesh_dir=out,
+      num_lods=num_lods,
+      encoding=encoding,
+    )
+
+
+def _multires_shard_spec(num_labels: int):
+  from ..sharding import ShardingSpecification, compute_shard_params_for_hashed
+
+  shard_bits, minishard_bits, preshift_bits = compute_shard_params_for_hashed(
+    num_labels
+  )
+  return ShardingSpecification(
+    preshift_bits=preshift_bits,
+    hash="murmurhash3_x86_128",
+    minishard_bits=minishard_bits,
+    shard_bits=shard_bits,
+    # raw: fragment ranges inside the shard are read by offset; the
+    # multires fragment-before-manifest layout requires it
+    minishard_index_encoding="gzip",
+    data_encoding="raw",
+  )
+
+
+def create_sharded_multires_mesh_tasks(
+  cloudpath: str,
+  mesh_dir: Optional[str] = None,
+  num_lods: int = 2,
+  encoding: str = "draco",
+) -> Iterator:
+  """Sharded stage-1 .frags → sharded multires: census labels via the
+  spatial index, solve shard bits, write the info, one task per shard
+  (reference :706-813)."""
+  from ..spatial_index import SpatialIndex
+  from ..tasks.mesh import mesh_dir_for
+  from ..tasks.mesh_multires import MultiResShardedMeshMergeTask
+
+  vol = Volume(cloudpath)
+  mdir = mesh_dir_for(vol, mesh_dir)
+  labels = SpatialIndex(vol.cf, mdir).query()
+  spec = _multires_shard_spec(len(labels))
+  configure_multires_info(cloudpath, mdir, sharding=spec.to_dict())
+
+  for shard_no in range(2**spec.shard_bits):
+    yield MultiResShardedMeshMergeTask(
+      cloudpath=cloudpath,
+      shard_no=shard_no,
+      mesh_dir=mdir,
+      num_lods=num_lods,
+      encoding=encoding,
+    )
+
+
+def create_sharded_multires_mesh_from_unsharded_tasks(
+  cloudpath: str,
+  src_mesh_dir: Optional[str] = None,
+  mesh_dir: Optional[str] = None,
+  num_lods: int = 2,
+  encoding: str = "draco",
+) -> Iterator:
+  """Legacy unsharded meshes → sharded multires (reference :590-704)."""
+  from ..tasks.mesh import mesh_dir_for
+  from ..tasks.mesh_multires import (
+    MultiResShardedFromUnshardedMeshMergeTask,
+    legacy_manifest_labels,
+  )
+
+  vol = Volume(cloudpath)
+  src = mesh_dir_for(vol, src_mesh_dir)  # raises if nothing is configured
+  out = mesh_dir or f"{src}_multires"
+  labels = legacy_manifest_labels(vol.cf, src)
+  spec = _multires_shard_spec(len(labels))
+  configure_multires_info(cloudpath, out, sharding=spec.to_dict())
+
+  for shard_no in range(2**spec.shard_bits):
+    yield MultiResShardedFromUnshardedMeshMergeTask(
+      cloudpath=cloudpath,
+      shard_no=shard_no,
+      src_mesh_dir=src,
+      mesh_dir=out,
+      num_lods=num_lods,
+      encoding=encoding,
+    )
+
+
 def create_mesh_deletion_tasks(
   layer_path: str, magnitude: int = 1, mesh_dir: Optional[str] = None
 ):

@@ -172,6 +172,53 @@ def create_sharded_skeleton_merge_tasks(
     )
 
 
+def create_sharded_from_unsharded_skeleton_merge_tasks(
+  cloudpath: str,
+  src_skel_dir: Optional[str] = None,
+  skel_dir: Optional[str] = None,
+) -> Iterator:
+  """Re-pack finished unsharded skeletons into shard files
+  (reference :659-754)."""
+  from ..sharding import ShardingSpecification, compute_shard_params_for_hashed
+  from ..skeleton_io import DEFAULT_ATTRIBUTES as _ATTRS
+  from ..tasks.skeleton import ShardedFromUnshardedSkeletonMergeTask
+
+  vol = Volume(cloudpath)
+  src = src_skel_dir or skel_dir_for(vol, None)
+  out = skel_dir or f"{src}_sharded"
+
+  labels = [
+    int(k.split("/")[-1]) for k in vol.cf.list(f"{src}/")
+    if k.split("/")[-1].isdigit()
+  ]
+  shard_bits, minishard_bits, preshift_bits = compute_shard_params_for_hashed(
+    len(labels)
+  )
+  spec = ShardingSpecification(
+    preshift_bits=preshift_bits,
+    hash="murmurhash3_x86_128",
+    minishard_bits=minishard_bits,
+    shard_bits=shard_bits,
+  )
+  src_info = vol.cf.get_json(f"{src}/info") or {
+    "@type": "neuroglancer_skeletons",
+    "transform": [1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0],
+    "vertex_attributes": _ATTRS,
+  }
+  src_info["sharding"] = spec.to_dict()
+  vol.cf.put_json(f"{out}/info", src_info)
+  vol.info["skeletons"] = out
+  vol.commit_info()
+
+  for shard_no in range(2**shard_bits):
+    yield ShardedFromUnshardedSkeletonMergeTask(
+      cloudpath=cloudpath,
+      shard_no=shard_no,
+      src_skel_dir=src,
+      skel_dir=out,
+    )
+
+
 def create_skeleton_deletion_tasks(
   cloudpath: str, magnitude: int = 1, skel_dir: Optional[str] = None
 ):

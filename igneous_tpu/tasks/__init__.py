@@ -26,10 +26,16 @@ from .mesh import (
 )
 from .skeleton import (
   DeleteSkeletonFilesTask,
+  ShardedFromUnshardedSkeletonMergeTask,
   ShardedSkeletonMergeTask,
   SkeletonTask,
   TransferSkeletonFilesTask,
   UnshardedSkeletonMergeTask,
+)
+from .mesh_multires import (
+  MultiResShardedFromUnshardedMeshMergeTask,
+  MultiResShardedMeshMergeTask,
+  MultiResUnshardedMeshMergeTask,
 )
 from .contrast import CLAHETask, ContrastNormalizationTask, LuminanceLevelsTask
 from .stats import (

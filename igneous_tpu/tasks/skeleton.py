@@ -302,6 +302,51 @@ class ShardedSkeletonMergeTask(RegisteredTask):
         cf.put(f"{sdir}/{filename}", data, compress=None)
 
 
+class ShardedFromUnshardedSkeletonMergeTask(RegisteredTask):
+  """Re-pack finished unsharded skeletons into one shard file
+  (reference :1091-1130)."""
+
+  def __init__(
+    self,
+    cloudpath: str,
+    shard_no: int,
+    src_skel_dir: str,
+    skel_dir: str,
+  ):
+    self.cloudpath = cloudpath
+    self.shard_no = int(shard_no)
+    self.src_skel_dir = src_skel_dir
+    self.skel_dir = skel_dir
+
+  def execute(self):
+    from ..sharding import ShardingSpecification
+
+    vol = Volume(self.cloudpath)
+    cf = CloudFiles(vol.cloudpath)
+    skel_info = cf.get_json(f"{self.skel_dir}/info") or {}
+    spec = ShardingSpecification.from_dict(skel_info["sharding"])
+
+    labels = []
+    for key in cf.list(f"{self.src_skel_dir}/"):
+      name = key.split("/")[-1]
+      if name.isdigit():  # finished skeletons are bare label files
+        labels.append(int(name))
+    labels = np.array(sorted(labels), dtype=np.uint64)
+    if len(labels) == 0:
+      return
+    mine = labels[spec.shard_number(labels) == self.shard_no]
+
+    out = {}
+    for label in mine.tolist():
+      data = cf.get(f"{self.src_skel_dir}/{label}")
+      if data is not None:
+        out[int(label)] = data
+    if out:
+      files = spec.synthesize_shard_files(out)
+      for filename, data in files.items():
+        cf.put(f"{self.skel_dir}/{filename}", data, compress=None)
+
+
 @queueable
 def TransferSkeletonFilesTask(
   src: str, dest: str, skel_dir: str, prefix: str = ""

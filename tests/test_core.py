@@ -348,3 +348,40 @@ def test_point_to_mip_both_directions(tmp_path, rng):
   vol.meta.add_scale((2, 2, 1))
   assert vol.meta.point_to_mip(Vec(10, 11, 12), 0, 1).tolist() == [5, 5, 12]
   assert vol.meta.point_to_mip(Vec(5, 5, 12), 1, 0).tolist() == [10, 10, 12]
+
+
+def test_cseg_native_numpy_bitstream_parity(rng):
+  """The C++ and numpy encoders must stay byte-identical (mixed-host
+  deployments decode each other's chunks)."""
+  import os
+  from igneous_tpu import cseg as cseg_mod
+
+  for dtype, shape in ((np.uint32, (32, 32, 16, 1)), (np.uint64, (33, 17, 9, 2))):
+    labels = (rng.integers(0, 25, shape) * 13).astype(dtype)
+    os.environ["IGNEOUS_TPU_NO_NATIVE"] = "1"
+    try:
+      py = cseg_mod.compress(labels)
+      out_py = cseg_mod.decompress(py, labels.shape, dtype)
+    finally:
+      del os.environ["IGNEOUS_TPU_NO_NATIVE"]
+    nat = cseg_mod.compress(labels)
+    assert py == nat, (dtype, shape)
+    assert np.array_equal(out_py, labels)
+    assert np.array_equal(cseg_mod.decompress(nat, labels.shape, dtype), labels)
+
+
+def test_cseg_corrupt_stream_raises(rng):
+  import os
+  from igneous_tpu import cseg as cseg_mod
+
+  labels = rng.integers(0, 50, (16, 16, 16, 1)).astype(np.uint32)
+  good = cseg_mod.compress(labels)
+  truncated = good[: len(good) // 3]
+  for no_native in ("1", None):
+    if no_native:
+      os.environ["IGNEOUS_TPU_NO_NATIVE"] = no_native
+    try:
+      with pytest.raises(ValueError):
+        cseg_mod.decompress(truncated, labels.shape, np.uint32)
+    finally:
+      os.environ.pop("IGNEOUS_TPU_NO_NATIVE", None)
