@@ -595,14 +595,13 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
 @click.option("--exit-on-empty", is_flag=True)
 @click.option("--min-sec", default=-1.0, show_default=True,
               help="Keep polling at least this long (<0: forever).")
+@click.option("--time", "timing", is_flag=True,
+              help="Log per-task wall time + stage breakdown as JSON lines.")
 @click.pass_context
-def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
+def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
+            timing):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics)."""
-  import time
-
-  from .queues import TaskQueue
-
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp
@@ -611,7 +610,8 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
     procs = [
       ctx_mp.Process(
         target=_execute_worker,
-        args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec),
+        args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
+              timing),
       )
       for _ in range(parallel)
     ]
@@ -620,10 +620,12 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
     for p in procs:
       p.join()
     return
-  _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec)
+  _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
+                  timing)
 
 
-def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
+def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
+                    timing=False):
   import time
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
@@ -641,7 +643,16 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec):
       return True
     return False
 
-  executed = tq.poll(lease_seconds=lease_sec, verbose=True, stop_fn=stop_fn)
+  before_fn = after_fn = None
+  if timing:
+    from .telemetry import timed_poll_hooks
+
+    before_fn, after_fn = timed_poll_hooks()
+
+  executed = tq.poll(
+    lease_seconds=lease_sec, verbose=True, stop_fn=stop_fn,
+    before_fn=before_fn, after_fn=after_fn,
+  )
   click.echo(f"executed {executed} tasks")
 
 
@@ -652,7 +663,9 @@ def queue_group():
 
 @queue_group.command("status")
 @click.argument("queue_spec")
-def queue_status(queue_spec):
+@click.option("--eta", is_flag=True, help="Sample throughput and estimate ETA.")
+@click.option("--sample-sec", default=10.0, show_default=True)
+def queue_status(queue_spec, eta, sample_sec):
   from .queues import TaskQueue
 
   tq = TaskQueue(queue_spec)
@@ -660,6 +673,12 @@ def queue_status(queue_spec):
   click.echo(f"enqueued: {tq.enqueued}")
   click.echo(f"leased: {tq.leased}")
   click.echo(f"completed: {tq.completed}")
+  if eta:
+    from .telemetry import queue_eta
+
+    stats = queue_eta(tq, sample_seconds=sample_sec)
+    click.echo(f"tasks/sec: {stats['tasks_per_sec']}")
+    click.echo(f"eta_sec: {stats['eta_sec']}")
 
 
 @queue_group.command("release")
@@ -750,6 +769,17 @@ def design_bounds(path, mip):
   click.echo(f"chunks: {len(boxes)}")
   click.echo(f"bounds: {total}")
   click.echo(f"info bounds: {vol.meta.bounds(mip)}")
+
+
+@main.command("view")
+@click.argument("path")
+@click.option("--port", default=1337, show_default=True)
+def view_cmd(path, port):
+  """Serve PATH locally and print a Neuroglancer link
+  (reference cli.py:1735-1850)."""
+  from .view import serve
+
+  serve(path, port=port, block=True)
 
 
 @main.command("license")

@@ -38,6 +38,13 @@ from .mesh_multires import (
   MultiResUnshardedMeshMergeTask,
 )
 from .contrast import CLAHETask, ContrastNormalizationTask, LuminanceLevelsTask
+from .obsolete import (
+  HyperSquareConsensusTask,
+  InferenceTask,
+  MaskAffinitymapTask,
+  WatershedRemapTask,
+  register_inference_model,
+)
 from .stats import (
   CountVoxelsTask,
   ReorderTask,

@@ -24,6 +24,7 @@ from ..queues.registry import RegisteredTask, queueable
 from ..volume import Volume
 from ..downsample_scales import compute_factors, DEFAULT_FACTOR
 from ..ops import pooling
+from .. import telemetry
 
 
 def downsample_and_upload(
@@ -54,9 +55,10 @@ def downsample_and_upload(
 
   method = pooling.method_for_layer(vol.layer_type, method)
   # uint64 labels are handled natively (hi/lo uint32 planes on device)
-  mips_out = pooling.downsample(
-    image, factors[0], len(factors), method=method, sparse=sparse
-  )
+  with telemetry.stage("device_pool"):
+    mips_out = pooling.downsample(
+      image, factors[0], len(factors), method=method, sparse=sparse
+    )
 
   cur_bounds = bounds.clone()
   for i, mipped in enumerate(mips_out):
@@ -67,12 +69,13 @@ def downsample_and_upload(
     cur_bounds = Bbox(minpt, minpt + Vec(*shape3))
     dest_bounds = Bbox.intersection(cur_bounds, vol.meta.bounds(dest_mip))
     sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
-    vol.upload(
-      dest_bounds,
-      np.asarray(mipped[sl], dtype=vol.dtype),
-      mip=dest_mip,
-      compress=compress,
-    )
+    with telemetry.stage("upload"):
+      vol.upload(
+        dest_bounds,
+        np.asarray(mipped[sl], dtype=vol.dtype),
+        mip=dest_mip,
+        compress=compress,
+      )
 
 
 class TransferTask(RegisteredTask):
@@ -131,11 +134,13 @@ class TransferTask(RegisteredTask):
     if bounds.empty():
       return
 
-    image = src.download(bounds)
+    with telemetry.stage("download"):
+      image = src.download(bounds)
     dest_bounds = bounds.translate(self.translate)
 
     if not self.skip_first:
-      dest.upload(dest_bounds, image, compress=self.compress)
+      with telemetry.stage("upload"):
+        dest.upload(dest_bounds, image, compress=self.compress)
     if not self.skip_downsamples:
       downsample_and_upload(
         image,

@@ -1,0 +1,138 @@
+"""Per-stage timing + device profiling — first-class observability.
+
+The reference has no built-in tracing (SURVEY.md §5.1: tqdm bars and
+queue-level ETA only); this module is the improvement the survey calls
+for: named stage timers threaded through task execution, one-line JSON
+summaries, and an optional jax.profiler trace capture around device work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+_local = threading.local()
+
+
+def _stack():
+  if not hasattr(_local, "stack"):
+    _local.stack = []
+  return _local.stack
+
+
+class StageTimes:
+  """Accumulates wall-clock per named stage (download/compute/upload/…)."""
+
+  def __init__(self):
+    self.totals: Dict[str, float] = defaultdict(float)
+    self.counts: Dict[str, int] = defaultdict(int)
+
+  def add(self, stage: str, seconds: float):
+    self.totals[stage] += seconds
+    self.counts[stage] += 1
+
+  def summary(self) -> dict:
+    return {
+      stage: {"seconds": round(self.totals[stage], 4), "count": self.counts[stage]}
+      for stage in sorted(self.totals)
+    }
+
+  def __str__(self):
+    return json.dumps(self.summary())
+
+
+@contextlib.contextmanager
+def task_timing() -> Iterator[StageTimes]:
+  """Collect stage timings for one task execution."""
+  st = StageTimes()
+  _stack().append(st)
+  try:
+    yield st
+  finally:
+    _stack().pop()
+
+
+@contextlib.contextmanager
+def stage(name: str):
+  """Time a stage; attributes to every active task_timing() scope."""
+  t0 = time.perf_counter()
+  try:
+    yield
+  finally:
+    dt = time.perf_counter() - t0
+    for st in _stack():
+      st.add(name, dt)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str] = None):
+  """jax.profiler trace around a device-heavy region.
+
+  Enabled when ``logdir`` is given or IGNEOUS_TPU_PROFILE_DIR is set;
+  otherwise a no-op (safe in workers without profiling infrastructure).
+  """
+  logdir = logdir or os.environ.get("IGNEOUS_TPU_PROFILE_DIR")
+  if not logdir:
+    yield
+    return
+  import jax
+
+  jax.profiler.start_trace(logdir)
+  try:
+    yield
+  finally:
+    jax.profiler.stop_trace()
+
+
+def timed_poll_hooks(verbose: bool = True):
+  """(before_fn, after_fn) for FileQueue.poll: logs per-task wall time and
+  stage breakdown as one JSON line per completed task."""
+  state = {}
+
+  def _close():
+    scope = state.pop("scope", None)
+    if scope is not None:
+      scope.__exit__(None, None, None)
+
+  def before(task):
+    # poll() calls after_fn only on success: if the previous task raised,
+    # its scope is still open — close it here so the stack never grows
+    _close()
+    state["t0"] = time.perf_counter()
+    scope = task_timing()
+    state["st"] = scope.__enter__()
+    state["scope"] = scope
+
+  def after(task):
+    st: StageTimes = state["st"]
+    _close()
+    record = {
+      "task": type(task).__name__,
+      "wall_s": round(time.perf_counter() - state["t0"], 4),
+      "stages": st.summary(),
+    }
+    if verbose:
+      print(json.dumps(record), flush=True)
+
+  return before, after
+
+
+def queue_eta(queue, sample_seconds: float = 10.0) -> dict:
+  """Tasks/sec + ETA from two enqueued-count samples
+  (reference `igneous queue status --eta`, cli.py:1998-2048)."""
+  first = queue.enqueued
+  t0 = time.time()
+  time.sleep(sample_seconds)
+  second = queue.enqueued
+  dt = time.time() - t0
+  rate = max((first - second) / dt, 0.0)
+  return {
+    "enqueued": second,
+    "tasks_per_sec": round(rate, 3),
+    "eta_sec": round(second / rate, 1) if rate > 0 else None,
+  }

@@ -1,0 +1,101 @@
+"""Local Precomputed server + Neuroglancer link — `igneous-tpu view`.
+
+Reference capability: `igneous view` (cli.py:1735-1850) serves a local
+layer over HTTP with CORS so the public Neuroglancer webapp can display
+it. The server maps URL paths directly onto the layer's storage keys
+(decompressing the .gz layout transparently).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .storage import CloudFiles
+
+
+def make_handler(cf: CloudFiles):
+  class Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+      pass
+
+    def _cors(self):
+      self.send_header("Access-Control-Allow-Origin", "*")
+      self.send_header("Access-Control-Allow-Headers", "*")
+
+    def do_OPTIONS(self):
+      self.send_response(204)
+      self._cors()
+      self.end_headers()
+
+    def do_GET(self):
+      import posixpath
+
+      key = posixpath.normpath(self.path.split("?")[0].lstrip("/"))
+      # never allow escaping the served layer (the CORS wildcard makes
+      # any traversal remotely exploitable)
+      if key.startswith("..") or key.startswith("/") or key == ".":
+        self.send_response(403)
+        self._cors()
+        self.end_headers()
+        return
+      data = cf.get(key)
+      if data is None:
+        self.send_response(404)
+        self._cors()
+        self.end_headers()
+        return
+      self.send_response(200)
+      self._cors()
+      if key.endswith("info") or key.endswith(".json"):
+        self.send_header("Content-Type", "application/json")
+      else:
+        self.send_header("Content-Type", "application/octet-stream")
+      self.send_header("Content-Length", str(len(data)))
+      self.end_headers()
+      self.wfile.write(data)
+
+  return Handler
+
+
+def neuroglancer_url(port: int, layer_name: str, layer_type: str) -> str:
+  state = {
+    "layers": [
+      {
+        "type": layer_type,
+        "source": f"precomputed://http://localhost:{port}",
+        "name": layer_name,
+      }
+    ],
+  }
+  fragment = json.dumps(state, separators=(",", ":"))
+  return f"https://neuroglancer-demo.appspot.com/#!{fragment}"
+
+
+def serve(
+  cloudpath: str,
+  port: int = 1337,
+  block: bool = True,
+) -> Optional[ThreadingHTTPServer]:
+  """Serve a layer for Neuroglancer; returns the server when block=False."""
+  cf = CloudFiles(cloudpath)
+  httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(cf))
+  port = httpd.server_address[1]  # resolves port=0 to the bound port
+  info = cf.get_json("info") or {}
+  url = neuroglancer_url(port, cloudpath.rstrip("/").split("/")[-1],
+                         info.get("type", "image"))
+  print(f"Serving {cloudpath} at http://localhost:{port}")
+  print(f"View in Neuroglancer:\n  {url}")
+  if block:
+    try:
+      httpd.serve_forever()
+    except KeyboardInterrupt:
+      pass
+    finally:
+      httpd.shutdown()
+    return None
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  return httpd
