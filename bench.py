@@ -148,6 +148,60 @@ def measure_transfer_MBps():
   return round(up, 1), round(down, 1)
 
 
+def bench_mesh_kernel():
+  """BASELINE config 3: marching-tetrahedra count pass on a 256^3 mask
+  (the per-voxel device stage; emission is O(surface) host work)."""
+  import jax
+  import jax.numpy as jnp
+
+  from igneous_tpu.ops.mesh import _count_kernel
+
+  n = 128 if QUICK else 256
+  g = np.indices((n, n, n)).astype(np.float32) - (n - 1) / 2
+  mask = (np.sqrt((g**2).sum(0)) < n // 3).astype(np.uint8)
+  dev = jnp.asarray(mask.transpose(2, 1, 0))
+
+  def step():
+    cases, per, total = _count_kernel(dev)
+    return int(total)
+
+  step()  # compile
+  t0 = time.perf_counter()
+  iters = 3 if QUICK else 5
+  for _ in range(iters):
+    step()  # int(total) forces execution (scalar materialization)
+  dt = (time.perf_counter() - t0) / iters
+  return mask.size / dt
+
+
+def bench_ccl_kernel():
+  """BASELINE config 4: block CCL (device) + host union-find merge."""
+  from igneous_tpu.ops.ccl import connected_components
+
+  n = 128 if QUICK else 256
+  rng = np.random.default_rng(0)
+  lab = (rng.integers(0, 3, (n, n, n)) * 7).astype(np.uint32)
+  connected_components(lab)  # compile
+  t0 = time.perf_counter()
+  connected_components(lab)
+  dt = time.perf_counter() - t0
+  return lab.size / dt
+
+
+def bench_edt_kernel():
+  """BASELINE config 5's device core: multilabel anisotropic EDT."""
+  from igneous_tpu.ops.edt import edt
+
+  n = 96 if QUICK else 160
+  rng = np.random.default_rng(0)
+  lab = (rng.integers(0, 3, (n, n, n)) * 9).astype(np.uint32)
+  edt(lab, (4, 4, 40))  # compile
+  t0 = time.perf_counter()
+  edt(lab, (4, 4, 40))
+  dt = time.perf_counter() - t0
+  return lab.size / dt
+
+
 def main():
   img, seg = make_data()
   tpu_kernel = bench_tpu_kernels(img, seg)
@@ -155,6 +209,9 @@ def main():
   cpu8 = cpu1 * 8.0
   e2e = bench_e2e(img, seg)
   up, down = measure_transfer_MBps()
+  mesh_rate = bench_mesh_kernel()
+  ccl_rate = bench_ccl_kernel()
+  edt_rate = bench_edt_kernel()
 
   result = {
     "metric": "downsample_kernel_mip0to4_voxels_per_sec",
@@ -168,6 +225,9 @@ def main():
       "cpu8_baseline_voxps": round(cpu8, 1),
       "e2e_pipeline_voxps": round(e2e, 1),
       "transfer_MBps_up_down": [up, down],
+      "mesh_count_kernel_voxps": round(mesh_rate, 1),
+      "ccl_kernel_voxps": round(ccl_rate, 1),
+      "edt_kernel_voxps": round(edt_rate, 1),
       "baseline": "numpy-oracle kernels x8-core credit "
                   "(reference stack not installed in this image)",
       "device": _device_name(),
