@@ -1,0 +1,167 @@
+"""Batched downsample driver: many grid cells per device dispatch.
+
+SURVEY.md §5.8's TPU mapping made concrete: instead of one process per
+task (the reference's LocalTaskQueue(parallel=N)), one host walks the task
+grid, downloads K equal-shaped cutouts with an IO thread pool, runs ONE
+shard_map'd pooling program for all K across the chip mesh, and uploads
+every mip — IO overlaps device compute via double buffering.
+
+Edge cells (clamped to odd shapes) fall back to the per-task path so the
+batched program keeps a single compiled shape.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec
+from ..volume import Volume
+from ..downsample_scales import compute_factors, DEFAULT_FACTOR
+from ..task_creation.common import get_bounds
+from ..tasks.image import DownsampleTask, downsample_and_upload
+from .executor import ChunkExecutor, make_mesh
+
+
+def _to_batch_layout(img: np.ndarray) -> np.ndarray:
+  # (x, y, z, c) → (c, z, y, x)
+  return np.ascontiguousarray(img.transpose(3, 2, 1, 0))
+
+
+def _from_batch_layout(arr: np.ndarray) -> np.ndarray:
+  return np.asarray(arr).transpose(3, 2, 1, 0)
+
+
+def batched_downsample(
+  layer_path: str,
+  mip: int = 0,
+  num_mips: int = 4,
+  shape: Sequence[int] = (256, 256, 64),
+  batch_size: int = 8,
+  factor: Sequence[int] = DEFAULT_FACTOR,
+  sparse: bool = False,
+  fill_missing: bool = False,
+  compress="gzip",
+  mesh=None,
+) -> dict:
+  """Downsample a whole layer with batched device dispatches.
+
+  Creates destination scales (like create_downsampling_tasks), then
+  processes the grid in K-cutout batches. Returns run statistics.
+  """
+  from ..downsample_scales import create_downsample_scales
+  from ..ops import pooling
+
+  vol = Volume(layer_path, mip=mip, fill_missing=fill_missing)
+  # chunk_size guard: every produced mip must stay chunk-writable
+  factors = compute_factors(
+    shape, factor, num_mips, chunk_size=vol.meta.chunk_size(mip)
+  )
+  if not factors:
+    raise ValueError(
+      f"shape {list(shape)} admits no chunk-aligned downsamples by "
+      f"{list(factor)} (chunk {vol.meta.chunk_size(mip).tolist()})"
+    )
+  create_downsample_scales(vol.meta, mip, shape, factor, num_mips=len(factors))
+  vol.commit_info()
+
+  method = pooling.method_for_layer(vol.layer_type, "auto")
+  bounds = get_bounds(vol, None, mip, mip)
+  shape = Vec(*shape)
+
+  full_boxes = []
+  edge_offsets = []  # nominal grid offsets; the per-task path clamps itself
+  from ..lib import chunk_bboxes
+
+  for gbox in chunk_bboxes(bounds, shape, offset=bounds.minpt, clamp=False):
+    clipped = Bbox.intersection(gbox, bounds)
+    if clipped == gbox:
+      full_boxes.append(gbox)
+    elif not clipped.empty():
+      edge_offsets.append(gbox.minpt)
+
+  mesh = mesh if mesh is not None else make_mesh()
+  is_u64_mode = method == "mode" and vol.dtype.itemsize == 8
+  executor = ChunkExecutor(
+    mesh, factors=tuple(factors), method=method, sparse=sparse,
+    planes=2 if is_u64_mode else 1,
+  )
+
+  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
+
+  def upload_batch(io_pool, boxes, mips_out):
+    futures = []
+    for mip_idx, batch_arr in enumerate(mips_out):
+      f = Vec(*np.prod(np.asarray(factors[: mip_idx + 1]), axis=0))
+      dest_mip = mip + mip_idx + 1
+      for k, box in enumerate(boxes):
+        mn = box.minpt // f
+        arr = _from_batch_layout(batch_arr[k])
+        dest_box = Bbox(mn, mn + Vec(*arr.shape[:3]))
+        dest_box = Bbox.intersection(dest_box, vol.meta.bounds(dest_mip))
+        sl = tuple(slice(0, int(s)) for s in dest_box.size3())
+        futures.append(io_pool.submit(
+          vol.upload, dest_box, arr[sl].astype(vol.dtype), dest_mip, compress
+        ))
+    for fut in futures:
+      fut.result()
+
+  def run_batch(io_pool, boxes, imgs):
+    if is_u64_mode:
+      lo = np.stack([
+        _to_batch_layout((i & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        for i in imgs
+      ])
+      hi = np.stack([
+        _to_batch_layout((i >> np.uint64(32)).astype(np.uint32)) for i in imgs
+      ])
+      outs, _ = executor((lo, hi))
+      mips_out = [
+        (ol.astype(np.uint64) | (oh.astype(np.uint64) << np.uint64(32)))
+        for ol, oh in outs
+      ]
+    else:
+      batch = np.stack([_to_batch_layout(i) for i in imgs])
+      mips_out, _ = executor(batch)
+    upload_batch(io_pool, boxes, mips_out)
+    stats["batched_cutouts"] += len(boxes)
+    stats["dispatches"] += 1
+
+  # double buffering: batch i+1's downloads run while batch i computes
+  # and uploads
+  batches = [
+    full_boxes[i : i + batch_size]
+    for i in range(0, len(full_boxes), batch_size)
+  ]
+  with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+    pending = (
+      [io_pool.submit(vol.download, b) for b in batches[0]]
+      if batches else []
+    )
+    for i, batch in enumerate(batches):
+      imgs = [f.result() for f in pending]
+      pending = (
+        [io_pool.submit(vol.download, b) for b in batches[i + 1]]
+        if i + 1 < len(batches) else []
+      )
+      run_batch(io_pool, batch, imgs)
+
+    # ragged edge cells: the standard per-task path (nominal grid shape —
+    # the task clamps to bounds itself, keeping even pooling extents)
+    for offset in edge_offsets:
+      DownsampleTask(
+        layer_path=layer_path,
+        mip=mip,
+        shape=shape.tolist(),
+        offset=[int(v) for v in offset],
+        fill_missing=fill_missing,
+        sparse=sparse,
+        num_mips=len(factors),
+        factor=tuple(factor),
+        compress=compress,
+      ).execute()
+      stats["edge_cutouts"] += 1
+
+  return stats

@@ -40,29 +40,47 @@ class ChunkExecutor:
     factors: Sequence[Tuple[int, int, int]] = ((2, 2, 1),),
     method: str = "average",
     sparse: bool = False,
+    planes: int = 1,
   ):
+    """``planes=2`` takes (lo, hi) uint32 plane pairs — the uint64 label
+    representation (see ops.pooling) — and returns per-mip plane pairs."""
     self.mesh = mesh if mesh is not None else make_mesh()
     self.factors = tuple(tuple(int(v) for v in f) for f in factors)
     self.method = method
     self.sparse = sparse
+    self.planes = int(planes)
+    if self.planes not in (1, 2):
+      raise ValueError("planes must be 1 or 2")
+    if self.planes == 2 and method != "mode":
+      raise ValueError("plane pairs are only meaningful for mode pooling")
     self.axis = self.mesh.axis_names[0]
     self._fn = self._build()
 
   def _build(self):
     factors, method, sparse = self.factors, self.method, self.sparse
     axis = self.axis
+    planes = self.planes
 
-    def per_shard(x):  # x: (k, c, z, y, x) local shard
-      outs = jax.vmap(lambda a: _pyramid_impl(a, factors, method, sparse))(x)
+    def per_shard(xs):  # xs: tuple of (k, c, z, y, x) local shards
+      def one(arrs):
+        val = arrs if planes == 2 else arrs[0]
+        return _pyramid_impl(val, factors, method, sparse)
+
+      outs = jax.vmap(lambda *arrs: one(arrs))(*xs)
       # voxel count psum: a cross-chip collective over ICI so callers get
       # a global nonzero tally with no host gather
-      nonzero = jax.lax.psum(
-        jnp.sum(x != 0, dtype=jnp.int32), axis_name=axis
-      )
+      fg = xs[0] != 0
+      for extra in xs[1:]:
+        fg = fg | (extra != 0)
+      nonzero = jax.lax.psum(jnp.sum(fg, dtype=jnp.int32), axis_name=axis)
       return outs, nonzero
 
-    in_spec = P(self.axis)
-    out_spec = (tuple(P(self.axis) for _ in factors), P())
+    in_spec = tuple(P(self.axis) for _ in range(planes))
+    if planes == 2:
+      mip_spec = tuple((P(self.axis), P(self.axis)) for _ in factors)
+    else:
+      mip_spec = tuple(P(self.axis) for _ in factors)
+    out_spec = (mip_spec, P())
     fn = jax.shard_map(
       per_shard, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec
     )
@@ -80,10 +98,25 @@ class ChunkExecutor:
       batch = np.concatenate([batch, np.zeros((rem,) + batch.shape[1:], batch.dtype)])
     return batch, k
 
-  def __call__(self, batch: np.ndarray):
-    """batch: (K, c, z, y, x) → (list of (K, …) mip arrays, global_nonzero)."""
-    padded, k = self.pad_batch(np.asarray(batch))
+  def __call__(self, batch):
+    """batch: (K, c, z, y, x) array (planes=1) or a (lo, hi) tuple of such
+    arrays (planes=2) → (per-mip outputs, global_nonzero). Per-mip outputs
+    mirror the input arity: arrays, or (lo, hi) tuples."""
+    arrs = batch if isinstance(batch, tuple) else (batch,)
+    if len(arrs) != self.planes:
+      raise ValueError(f"expected {self.planes} plane(s), got {len(arrs)}")
+    padded = []
+    k = arrs[0].shape[0]
+    for a in arrs:
+      p, _ = self.pad_batch(np.asarray(a))
+      padded.append(p)
     sharding = NamedSharding(self.mesh, P(self.axis))
-    x = jax.device_put(padded, sharding)
-    outs, nonzero = self._fn(x)
-    return [np.asarray(o)[:k] for o in outs], int(nonzero)
+    xs = tuple(jax.device_put(p, sharding) for p in padded)
+    outs, nonzero = self._fn(xs)
+    if self.planes == 2:
+      result = [
+        (np.asarray(ol)[:k], np.asarray(oh)[:k]) for ol, oh in outs
+      ]
+    else:
+      result = [np.asarray(o)[:k] for o in outs]
+    return result, int(nonzero)

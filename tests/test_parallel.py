@@ -1,0 +1,109 @@
+"""Mesh-sharded batched execution tests (virtual 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+from igneous_tpu.lib import Bbox
+from igneous_tpu.ops import oracle
+from igneous_tpu.parallel import ChunkExecutor, batched_downsample, make_mesh
+from igneous_tpu.volume import Volume
+
+
+def test_executor_single_plane(rng):
+  mesh = make_mesh(8)
+  ex = ChunkExecutor(mesh, factors=((2, 2, 1), (2, 2, 2)), method="average")
+  batch = rng.integers(0, 255, (13, 1, 16, 32, 32)).astype(np.uint8)
+  outs, nonzero = ex(batch)
+  assert outs[0].shape == (13, 1, 16, 16, 16)
+  assert outs[1].shape == (13, 8, 8, 8, 1)[:1] + (1, 8, 8, 8)
+  assert nonzero == int((batch != 0).sum())
+  img = batch[3, 0].transpose(2, 1, 0)
+  exp = oracle.np_downsample_with_averaging(img, (2, 2, 1), 1)[0]
+  assert np.array_equal(outs[0][3, 0].transpose(2, 1, 0), exp)
+
+
+def test_executor_u64_planes(rng):
+  mesh = make_mesh(4)
+  ex = ChunkExecutor(mesh, factors=((2, 2, 1),), method="mode", planes=2)
+  seg = (rng.integers(0, 6, (5, 1, 8, 16, 16)) * (2**40 + 3)).astype(np.uint64)
+  lo = (seg & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+  hi = (seg >> np.uint64(32)).astype(np.uint32)
+  outs, nonzero = ex((lo, hi))
+  ol, oh = outs[0]
+  got = ol.astype(np.uint64) | (oh.astype(np.uint64) << np.uint64(32))
+  img = seg[2, 0].transpose(2, 1, 0)
+  exp = oracle.np_downsample_segmentation(img, (2, 2, 1), 1)[0]
+  assert np.array_equal(got[2, 0].transpose(2, 1, 0), exp)
+  assert nonzero == int((seg != 0).sum())
+
+
+def test_executor_plane_arity_checked(rng):
+  ex = ChunkExecutor(make_mesh(2), method="average")
+  with pytest.raises(ValueError):
+    ex((np.zeros((2, 1, 4, 8, 8), np.uint8),) * 2)
+  with pytest.raises(ValueError):
+    ChunkExecutor(make_mesh(2), method="average", planes=2)
+
+
+def test_batched_downsample_uint8(tmp_path, rng):
+  data = rng.integers(0, 255, (600, 520, 64)).astype(np.uint8)
+  path = f"file://{tmp_path}/img"
+  Volume.from_numpy(data, path)
+  stats = batched_downsample(
+    path, num_mips=2, shape=(256, 256, 64), batch_size=4,
+    mesh=make_mesh(4), compress=None,
+  )
+  assert stats["batched_cutouts"] == 4  # 2x2 interior cells
+  assert stats["edge_cutouts"] == 5  # ragged border cells
+  vol = Volume(path)
+  exp = oracle.np_downsample_with_averaging(data, (2, 2, 1), 2)
+  for m in (1, 2):
+    out = vol.download(vol.meta.bounds(m), mip=m)
+    assert np.array_equal(out[..., 0], exp[m - 1]), f"mip {m}"
+
+
+def test_batched_downsample_uint64_mode(tmp_path, rng):
+  blocks = (rng.integers(1, 2**40, (16, 16, 8))).astype(np.uint64)
+  data = np.kron(blocks, np.ones((16, 16, 16), np.uint64))  # 256,256,128
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, layer_type="segmentation")
+  stats = batched_downsample(
+    path, num_mips=1, shape=(128, 128, 128), batch_size=4,
+    mesh=make_mesh(4), compress=None,
+  )
+  assert stats["batched_cutouts"] == 4 and stats["edge_cutouts"] == 0
+  vol = Volume(path)
+  exp = oracle.np_downsample_segmentation(data, (2, 2, 1), 1)
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  assert np.array_equal(out[..., 0], exp[0])
+
+
+def test_pallas_pool_matches_oracle(rng):
+  from igneous_tpu.ops import pallas_pooling
+
+  if not pallas_pooling.available():
+    pytest.skip("pallas unavailable")
+  img = rng.integers(0, 255, (65, 33, 130)).astype(np.uint8)
+  got = pallas_pooling.pool2x2x1(img, "average", interpret=True)
+  exp = oracle.np_downsample_with_averaging(img, (2, 2, 1), 1)[0]
+  assert np.array_equal(got, exp)
+  seg = (rng.integers(0, 5, (64, 32, 128)) * 9).astype(np.uint32)
+  got = pallas_pooling.pool2x2x1(seg, "mode", interpret=True)
+  exp = oracle.np_downsample_segmentation(seg, (2, 2, 1), 1)[0]
+  assert np.array_equal(got, exp)
+
+
+def test_batched_downsample_odd_edges(tmp_path, rng):
+  # odd-extent edge cells must still produce their downsampled mips
+  data = rng.integers(0, 255, (321, 256, 64)).astype(np.uint8)
+  path = f"file://{tmp_path}/img"
+  Volume.from_numpy(data, path)
+  stats = batched_downsample(
+    path, num_mips=1, shape=(256, 256, 64), batch_size=4,
+    mesh=make_mesh(2), compress=None,
+  )
+  assert stats["edge_cutouts"] == 1
+  vol = Volume(path)
+  exp = oracle.np_downsample_with_averaging(data, (2, 2, 1), 1)[0]
+  out = vol.download(vol.meta.bounds(1), mip=1)
+  assert np.array_equal(out[..., 0], exp)
