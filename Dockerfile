@@ -1,0 +1,29 @@
+# Worker image for igneous-tpu queue execution.
+#
+# Reference analogue: /root/reference/Dockerfile (python slim worker whose
+# CMD polls the queue). TPU-first difference: the production image is meant
+# for GKE TPU node pools, so jax[tpu] is installed and one pod drives all
+# the host's chips via the batched executor (deployment.yaml).
+
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+      g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY igneous_tpu ./igneous_tpu
+
+# jax[tpu] resolves libtpu on TPU VMs; harmless (cpu jax) elsewhere
+RUN pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir .
+
+ENV QUEUE_URL="fq:///queue" \
+    LEASE_SECONDS="600"
+
+# the same worker loop the reference container runs (its Dockerfile CMD is
+# `igneous execute -q --lease-sec $LEASE_SECONDS $SQS_URL`). exec keeps the
+# worker as PID 1 so Kubernetes SIGTERM reaches it and leases release fast.
+CMD ["sh", "-c", "exec igneous-tpu execute \"$QUEUE_URL\" --lease-sec \"$LEASE_SECONDS\" --time"]

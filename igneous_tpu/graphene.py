@@ -1,0 +1,43 @@
+"""Graphene (PyChunkGraph proofreading volume) support gate.
+
+The reference supports ``graphene://`` volumes — proofreadable
+segmentations backed by a PyChunkGraph server — for agglomerated
+downloads, L2-chunk meshing, and skeleton voxel-connectivity graphs
+(/root/reference/igneous/tasks/mesh/mesh.py:466-622 GrapheneMeshTask,
+tasks/mesh/mesh_graphene_remap.py, tasks/skeleton.py:337-398).
+
+Graphene requires a live PCG server (authentication, timestamped root
+lookups) which a zero-egress build cannot exercise; this module defines
+the client interface those code paths call so a deployment can register a
+real implementation, and fails with actionable errors otherwise.
+"""
+
+from __future__ import annotations
+
+
+
+_GRAPHENE_CLIENT_FACTORY = None
+
+
+def register_graphene_client(factory):
+  """factory(cloudpath) → client with:
+  - download(bbox, mip, agglomerate: bool, timestamp, stop_layer) → ndarray
+  - get_root_ids(supervoxels, timestamp) → ndarray
+  - level2_chunk_graph(chunk_id) → edge list
+  """
+  global _GRAPHENE_CLIENT_FACTORY
+  _GRAPHENE_CLIENT_FACTORY = factory
+
+
+def graphene_client(cloudpath: str):
+  if _GRAPHENE_CLIENT_FACTORY is None:
+    raise NotImplementedError(
+      "graphene:// volumes need a PyChunkGraph server client; register one "
+      "with igneous_tpu.graphene.register_graphene_client(factory). "
+      "This environment has no network egress, so none ships in-tree."
+    )
+  return _GRAPHENE_CLIENT_FACTORY(cloudpath)
+
+
+def is_graphene(cloudpath: str) -> bool:
+  return cloudpath.startswith("graphene://")

@@ -13,7 +13,7 @@ batched program keeps a single compiled shape.
 from __future__ import annotations
 
 import concurrent.futures as cf
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -21,17 +21,13 @@ from ..lib import Bbox, Vec
 from ..volume import Volume
 from ..downsample_scales import compute_factors, DEFAULT_FACTOR
 from ..task_creation.common import get_bounds
-from ..tasks.image import DownsampleTask, downsample_and_upload
+from ..tasks.image import DownsampleTask
+from ..ops.pooling import _from_device_layout, _to_device_layout
 from .executor import ChunkExecutor, make_mesh
 
-
-def _to_batch_layout(img: np.ndarray) -> np.ndarray:
-  # (x, y, z, c) → (c, z, y, x)
-  return np.ascontiguousarray(img.transpose(3, 2, 1, 0))
-
-
-def _from_batch_layout(arr: np.ndarray) -> np.ndarray:
-  return np.asarray(arr).transpose(3, 2, 1, 0)
+# single source of truth for the (x,y,z,c) <-> (c,z,y,x) convention
+_to_batch_layout = _to_device_layout
+_from_batch_layout = _from_device_layout
 
 
 def batched_downsample(
@@ -92,6 +88,8 @@ def batched_downsample(
   stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
 
   def upload_batch(io_pool, boxes, mips_out):
+    """Submit the uploads and return their futures — callers overlap them
+    with the next batch's compute and only join one batch behind."""
     futures = []
     for mip_idx, batch_arr in enumerate(mips_out):
       f = Vec(*np.prod(np.asarray(factors[: mip_idx + 1]), axis=0))
@@ -105,8 +103,7 @@ def batched_downsample(
         futures.append(io_pool.submit(
           vol.upload, dest_box, arr[sl].astype(vol.dtype), dest_mip, compress
         ))
-    for fut in futures:
-      fut.result()
+    return futures
 
   def run_batch(io_pool, boxes, imgs):
     if is_u64_mode:
@@ -125,9 +122,9 @@ def batched_downsample(
     else:
       batch = np.stack([_to_batch_layout(i) for i in imgs])
       mips_out, _ = executor(batch)
-    upload_batch(io_pool, boxes, mips_out)
     stats["batched_cutouts"] += len(boxes)
     stats["dispatches"] += 1
+    return upload_batch(io_pool, boxes, mips_out)
 
   # double buffering: batch i+1's downloads run while batch i computes
   # and uploads
@@ -140,13 +137,20 @@ def batched_downsample(
       [io_pool.submit(vol.download, b) for b in batches[0]]
       if batches else []
     )
+    prev_uploads = []
     for i, batch in enumerate(batches):
       imgs = [f.result() for f in pending]
       pending = (
         [io_pool.submit(vol.download, b) for b in batches[i + 1]]
         if i + 1 < len(batches) else []
       )
-      run_batch(io_pool, batch, imgs)
+      # join batch i-1's uploads only now: they overlapped batch i's
+      # downloads and this batch's device dispatch
+      for fut in prev_uploads:
+        fut.result()
+      prev_uploads = run_batch(io_pool, batch, imgs)
+    for fut in prev_uploads:
+      fut.result()
 
     # ragged edge cells: the standard per-task path (nominal grid shape —
     # the task clamps to bounds itself, keeping even pooling extents)

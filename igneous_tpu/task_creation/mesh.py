@@ -41,6 +41,7 @@ def create_meshing_tasks(
   sharded: bool = False,
   bounds: Optional[Bbox] = None,
   closed_dataset_edges: bool = True,
+  fill_holes: int = 0,
 ):
   """Stage-1 mesh forge grid; creates the mesh info
   (reference task_creation/mesh.py:158-267)."""
@@ -85,6 +86,7 @@ def create_meshing_tasks(
       spatial_index=spatial_index,
       sharded=sharded,
       closed_dataset_edges=closed_dataset_edges,
+      fill_holes=fill_holes,
     )
 
   def finish():

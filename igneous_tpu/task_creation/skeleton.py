@@ -40,6 +40,7 @@ def create_skeletonizing_tasks(
   skel_dir: Optional[str] = None,
   spatial_index: bool = True,
   fix_borders: bool = True,
+  fill_holes: bool = False,
   bounds: Optional[Bbox] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
@@ -88,6 +89,7 @@ def create_skeletonizing_tasks(
       skel_dir=skel_dir,
       spatial_index=spatial_index,
       fix_borders=fix_borders,
+      fill_holes=fill_holes,
     )
 
   def finish():

@@ -55,6 +55,7 @@ class MeshTask(RegisteredTask):
     spatial_index: bool = True,
     sharded: bool = False,
     closed_dataset_edges: bool = True,
+    fill_holes: int = 0,
   ):
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
@@ -70,6 +71,7 @@ class MeshTask(RegisteredTask):
     self.spatial_index = spatial_index
     self.sharded = sharded
     self.closed_dataset_edges = closed_dataset_edges
+    self.fill_holes = int(fill_holes)
 
   def execute(self):
     vol = Volume(
@@ -87,6 +89,14 @@ class MeshTask(RegisteredTask):
 
     if self.object_ids:
       img = fastremap.mask_except(img, self.object_ids)
+
+    if self.fill_holes:
+      # close internal cavities so meshes have no interior shells
+      # (reference mesh.py:211-246 fastmorph.fill_holes levels; see
+      # ops.morphology.fill_holes for the level ladder)
+      from ..ops.morphology import fill_holes as _fill_holes
+
+      img = _fill_holes(img, level=self.fill_holes)
 
     # zero-pad where the cutout touches the dataset boundary so surfaces
     # close instead of gaping (reference mesh.py:267-303); interior task

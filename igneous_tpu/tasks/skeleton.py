@@ -97,6 +97,7 @@ class SkeletonTask(RegisteredTask):
     skel_dir: Optional[str] = None,
     spatial_index: bool = True,
     fix_borders: bool = True,
+    fill_holes: bool = False,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -111,6 +112,7 @@ class SkeletonTask(RegisteredTask):
     self.skel_dir = skel_dir
     self.spatial_index = spatial_index
     self.fix_borders = fix_borders
+    self.fill_holes = bool(fill_holes)
 
   def execute(self):
     vol = Volume(
@@ -130,6 +132,12 @@ class SkeletonTask(RegisteredTask):
       labels = fastremap.mask_except(labels, self.object_ids)
     if self.mask_ids:
       labels = fastremap.mask(labels, self.mask_ids)
+    if self.fill_holes:
+      # cavities distort the EDT and spawn spurious loops
+      # (reference tasks/skeleton.py:268-301)
+      from ..ops.morphology import fill_holes as _fill_holes
+
+      labels = _fill_holes(labels)
 
     targets = (
       border_targets(

@@ -1,0 +1,107 @@
+"""Morphology (fastmorph-parity) tests."""
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from igneous_tpu.ops.morphology import dilate, erode, fill_holes
+
+
+def test_dilate_binary_vs_scipy(rng):
+  mask = (rng.random((24, 20, 16)) < 0.1).astype(np.uint8)
+  got = dilate(mask)
+  exp = ndimage.binary_dilation(
+    mask, structure=ndimage.generate_binary_structure(3, 1)
+  ).astype(np.uint8)
+  assert np.array_equal(got != 0, exp != 0)
+
+
+def test_dilate_multilabel_keeps_foreground(rng):
+  lab = np.zeros((20, 20, 8), np.uint64)
+  lab[4:8, 4:8, 2:6] = 5
+  lab[12:16, 4:8, 2:6] = 9
+  out = dilate(lab)
+  # existing labels unchanged
+  assert np.array_equal(out[lab != 0], lab[lab != 0])
+  # grows by one 6-connected shell
+  assert out[8, 5, 3] == 5 and out[11, 5, 3] == 9
+  assert out[9, 5, 3] == 0  # two voxels away stays background
+
+
+def test_erode_inverse_of_dilate_on_solid():
+  lab = np.zeros((16, 16, 16), np.uint32)
+  lab[4:12, 4:12, 4:12] = 7
+  shrunk = erode(lab)
+  assert shrunk.sum() < lab.sum()
+  exp = ndimage.binary_erosion(
+    lab != 0, structure=ndimage.generate_binary_structure(3, 1)
+  )
+  assert np.array_equal(shrunk != 0, exp)
+
+
+def test_fill_holes():
+  lab = np.zeros((16, 16, 16), np.uint64)
+  lab[2:14, 2:14, 2:14] = 3
+  lab[6:10, 6:10, 6:10] = 0  # internal cavity
+  out, counts = fill_holes(lab, return_fill_count=True)
+  assert counts == {3: 64}
+  assert (out[6:10, 6:10, 6:10] == 3).all()
+  # a cavity belonging to another label is untouched
+  lab2 = lab.copy()
+  lab2[6:10, 6:10, 6:10] = 8
+  out2 = fill_holes(lab2)
+  assert (out2[6:10, 6:10, 6:10] == 8).all()
+
+
+def test_mesh_task_fill_holes(tmp_path):
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.mesh_io import Mesh
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.volume import Volume
+
+  lab = np.zeros((32, 32, 32), np.uint64)
+  lab[4:28, 4:28, 4:28] = 7
+  lab[12:20, 12:20, 12:20] = 0  # cavity would add an inner shell
+  Volume.from_numpy(lab, f"file://{tmp_path}/seg", layer_type="segmentation",
+                    chunk_size=(32, 32, 32))
+  LocalTaskQueue(progress=False).insert(tc.create_meshing_tasks(
+    f"file://{tmp_path}/seg", shape=(32, 32, 32), mesh_dir="mesh",
+    simplification=False, fill_holes=1))
+  vol = Volume(f"file://{tmp_path}/seg")
+  frag = [k for k in vol.cf.list("mesh/") if ":0:" in k][0]
+  m = Mesh.from_precomputed(vol.cf.get(frag))
+  p = m.vertices[m.faces.astype(np.int64)]
+  volume = float(np.sum(
+    np.einsum("ij,ij->i", p[:, 0], np.cross(p[:, 1], p[:, 2]))) / 6.0)
+  # filled solid: volume ≈ 24^3, not 24^3 - 8^3
+  assert abs(volume - 24**3) / 24**3 < 0.1
+
+
+def test_dilate_large_uint64_labels():
+  # labels >= 2^53 must survive the dense<->label round trip exactly
+  a, b = np.uint64(2**60 + 1), np.uint64(2**60 + 5)
+  lab = np.zeros((6, 6, 6), np.uint64)
+  lab[1, 1, 1] = a
+  lab[4, 4, 4] = b
+  out = dilate(lab)
+  assert out[1, 1, 1] == a and out[4, 4, 4] == b
+  assert out[2, 1, 1] == a and out[4, 4, 3] == b
+  assert set(np.unique(out).tolist()) == {0, int(a), int(b)}
+
+
+def test_fill_holes_level3_closes_cracked_cavity():
+  lab = np.zeros((16, 16, 16), np.uint64)
+  lab[2:14, 2:14, 2:14] = 3
+  lab[6:10, 6:10, 6:10] = 0  # cavity...
+  lab[7:9, 7:9, 2:10] = 0  # ...with a thin crack to the outside
+  assert (fill_holes(lab, level=1)[6:10, 6:10, 6:10] == 0).any()
+  closed = fill_holes(lab, level=3)
+  assert (closed[6:10, 6:10, 6:10] == 3).all()
+
+
+def test_graphene_gate_on_volume():
+  from igneous_tpu.volume import Volume
+
+  with pytest.raises(NotImplementedError) as e:
+    Volume("graphene://https://example.com/seg")
+  assert "PyChunkGraph" in str(e.value)
