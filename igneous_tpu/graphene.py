@@ -29,13 +29,20 @@ def register_graphene_client(factory):
   _GRAPHENE_CLIENT_FACTORY = factory
 
 
-def graphene_client(cloudpath: str):
+def require_graphene_client(cloudpath: str) -> None:
+  """Raise the curated error when no PCG client is registered (checked at
+  Volume construction; no client is instantiated)."""
   if _GRAPHENE_CLIENT_FACTORY is None:
     raise NotImplementedError(
-      "graphene:// volumes need a PyChunkGraph server client; register one "
-      "with igneous_tpu.graphene.register_graphene_client(factory). "
+      f"{cloudpath!r}: graphene:// volumes need a PyChunkGraph server "
+      "client; register one with "
+      "igneous_tpu.graphene.register_graphene_client(factory). "
       "This environment has no network egress, so none ships in-tree."
     )
+
+
+def graphene_client(cloudpath: str):
+  require_graphene_client(cloudpath)
   return _GRAPHENE_CLIENT_FACTORY(cloudpath)
 
 

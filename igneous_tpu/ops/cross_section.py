@@ -1,0 +1,127 @@
+"""Cross-sectional area at skeleton vertices — xs3d capability parity.
+
+Reference: kimimaro.cross_sectional_area (backed by the xs3d C++ library,
+/root/reference/igneous/tasks/skeleton.py:400-572) computes, per skeleton
+vertex, the area of the label's planar slice perpendicular to the local
+skeleton direction.
+
+Implementation: voxel-slab counting. For vertex v with unit tangent t,
+every foreground voxel center p in a local window contributes when
+|(p - v)·t| < 1/2 voxel step (a one-voxel-thick slab) and p is
+flood-connected to v within the slab (so parallel branches of the same
+label do not inflate the area). Area = count x (voxel volume / step),
+which converges to the geometric slice area for slabs through voxelized
+solids. Accuracy is the voxelization's (compare the tube test: pi*r^2
+within ~10%); exact polygonal slicing a la xs3d can swap in behind the
+same signature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+from scipy import ndimage
+
+from ..skeleton_io import Skeleton
+
+
+def vertex_tangents(skel: Skeleton) -> np.ndarray:
+  """Unit tangent per vertex: mean direction of incident edges."""
+  n = len(skel.vertices)
+  tangents = np.zeros((n, 3), np.float32)
+  edges = skel.edges.astype(np.int64)
+  for a, b in edges:
+    d = skel.vertices[b] - skel.vertices[a]
+    norm = np.linalg.norm(d)
+    if norm == 0:
+      continue
+    d = d / norm
+    # orient consistently (sign-insensitive accumulation)
+    for idx in (a, b):
+      ref = tangents[idx]
+      if np.dot(ref, d) < 0:
+        tangents[idx] -= d
+      else:
+        tangents[idx] += d
+  norms = np.linalg.norm(tangents, axis=1, keepdims=True)
+  norms[norms == 0] = 1.0
+  return tangents / norms
+
+
+def cross_sectional_area(
+  mask: np.ndarray,
+  skel: Skeleton,
+  anisotropy: Sequence[float] = (1.0, 1.0, 1.0),
+  offset: Sequence[float] = (0.0, 0.0, 0.0),
+  window: int = 48,
+) -> np.ndarray:
+  """Per-vertex slice areas (physical units²) of one label's mask.
+
+  ``skel`` vertices are physical; ``mask`` is the (x,y,z) label mask whose
+  voxel grid starts at ``offset`` (voxels). Returns float32 values:
+    area > 0   clean slice;
+    area < 0   |area| is a LOWER BOUND — the slice was clipped by the
+               window or the cutout boundary (the reference's
+               boundary-contact case, which its repair pass re-visits,
+               tasks/skeleton.py:574-720);
+    -1         vertex outside the mask.
+  """
+  anis = np.asarray(anisotropy, np.float32)
+  voxel_volume = float(np.prod(anis))
+  tangents = vertex_tangents(skel)
+  out = np.full(len(skel.vertices), -1.0, np.float32)
+  shape = np.asarray(mask.shape, dtype=np.int64)
+
+  # one shared window coordinate grid; per vertex only a slice + the
+  # sub-voxel shift changes
+  w = int(window)
+  base_grid = (
+    np.indices((2 * w + 1,) * 3).astype(np.float32) - w
+  )  # (3, 2w+1, 2w+1, 2w+1), centered
+
+  for i, (v, t) in enumerate(zip(skel.vertices, tangents)):
+    vv = v / anis - np.asarray(offset, np.float32)  # voxel coords
+    vi = np.round(vv).astype(np.int64)
+    if np.any(vi < 0) or np.any(vi >= shape):
+      continue
+    if not mask[tuple(vi)]:
+      continue
+    if t[0] == 0 and t[1] == 0 and t[2] == 0:
+      continue
+    lo = np.maximum(vi - w, 0)
+    hi = np.minimum(vi + w + 1, shape)
+    sub = mask[lo[0]:hi[0], lo[1]:hi[1], lo[2]:hi[2]]
+
+    gsl = tuple(
+      slice(int(a - (c - w)), int(b - (c - w)))
+      for a, b, c in zip(lo, hi, vi)
+    )
+    frac = (vi.astype(np.float32) - vv) * anis  # sub-voxel shift, physical
+    dist = (
+      base_grid[0][gsl] * (anis[0] * t[0])
+      + base_grid[1][gsl] * (anis[1] * t[1])
+      + base_grid[2][gsl] * (anis[2] * t[2])
+    ) + float(frac @ t)
+    # slab thickness: one step of the (anisotropic) voxel grid along t
+    step = float(np.linalg.norm(anis * t))
+    slab = sub & (np.abs(dist) < step / 2.0)
+    seed = tuple(vi - lo)
+    if not slab[seed]:
+      continue
+    # connectivity within the slab: other branches crossing the plane
+    # must not count (xs3d's contiguous-section semantics)
+    labeled, _ = ndimage.label(slab, structure=np.ones((3, 3, 3), bool))
+    comp_mask = labeled == labeled[seed]
+    count = int(comp_mask.sum())
+    area = count * voxel_volume / step
+
+    # truncation: the section touches the window or cutout boundary, so
+    # the true slice may continue beyond what we counted (window-clipped
+    # and cutout-contact cases both surface as a border touch)
+    clipped = any(
+      comp_mask.take(0, axis=a).any() or comp_mask.take(-1, axis=a).any()
+      for a in range(3)
+    )
+    out[i] = -area if clipped else area
+  return out

@@ -105,19 +105,26 @@ def fill_holes(
     closed = np.where(labels != 0, labels, closed).astype(labels.dtype)
     if return_fill_count:
       add = (closed != 0) & (labels == 0)
-      return closed, {"closed_voxels": int(add.sum())}
+      vals, counts = np.unique(closed[add], return_counts=True)
+      return closed, {int(v): int(c) for v, c in zip(vals, counts)}
     return closed
   out = labels.copy()
   fill_counts = {}
-  uniq = np.unique(labels)
-  for v in uniq:
-    if v == 0:
+  # crop each label to its bbox: O(sum of label extents), not O(L x V)
+  from .remap import renumber as _renumber
+
+  dense, mapping = _renumber(labels)
+  for new_id, sl in enumerate(
+    ndimage.find_objects(dense.astype(np.int32)), start=1
+  ):
+    if sl is None:
       continue
-    mask = labels == v
-    filled = ndimage.binary_fill_holes(mask)
-    add = filled & ~mask & (out == 0)  # only claim true background cavities
+    v = mapping[new_id]
+    sub_mask = dense[sl] == new_id
+    filled = ndimage.binary_fill_holes(sub_mask)
+    add = filled & ~sub_mask & (out[sl] == 0)  # true background cavities only
     if add.any():
-      out[add] = v
+      out[sl][add] = v
       fill_counts[int(v)] = int(add.sum())
   if return_fill_count:
     return out, fill_counts

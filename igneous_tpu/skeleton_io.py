@@ -175,7 +175,13 @@ class Skeleton:
       self.vertex_types.astype("u1").tobytes(),
     ]
     for name in sorted(self.extra_attributes):
-      out.append(np.ascontiguousarray(self.extra_attributes[name]).tobytes())
+      arr = np.ascontiguousarray(self.extra_attributes[name])
+      # pin the wire dtype to what the info declares (extras are float32
+      # single-component by convention here): an accidental float64 array
+      # would silently shift every byte after it
+      if arr.dtype.kind == "f" and arr.dtype.itemsize != 4:
+        arr = arr.astype("<f4")
+      out.append(arr.tobytes())
     return b"".join(out)
 
   @classmethod

@@ -41,6 +41,7 @@ def create_skeletonizing_tasks(
   spatial_index: bool = True,
   fix_borders: bool = True,
   fill_holes: bool = False,
+  cross_sectional_area: bool = False,
   bounds: Optional[Bbox] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
@@ -53,11 +54,21 @@ def create_skeletonizing_tasks(
     skel_dir = vol.info.get("skeletons") or f"skeletons_mip_{mip}"
   vol.info["skeletons"] = skel_dir
 
+  vertex_attributes = list(DEFAULT_ATTRIBUTES)
+  if cross_sectional_area:
+    # extra attributes serialize sorted by id after the defaults
+    # (skeleton_io.Skeleton.to_precomputed); the info must list the same
+    # order (reference vertex_attributes management, :244-268)
+    vertex_attributes.append({
+      "id": "cross_sectional_area",
+      "data_type": "float32",
+      "num_components": 1,
+    })
   skel_info = {
     "@type": "neuroglancer_skeletons",
     # vertices are stored in physical nm already: identity transform
     "transform": [1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0],
-    "vertex_attributes": DEFAULT_ATTRIBUTES,
+    "vertex_attributes": vertex_attributes,
     "mip": int(mip),
   }
   if spatial_index:
@@ -90,6 +101,7 @@ def create_skeletonizing_tasks(
       spatial_index=spatial_index,
       fix_borders=fix_borders,
       fill_holes=fill_holes,
+      cross_sectional_area=cross_sectional_area,
     )
 
   def finish():

@@ -98,6 +98,7 @@ class SkeletonTask(RegisteredTask):
     spatial_index: bool = True,
     fix_borders: bool = True,
     fill_holes: bool = False,
+    cross_sectional_area: bool = False,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -113,6 +114,7 @@ class SkeletonTask(RegisteredTask):
     self.spatial_index = spatial_index
     self.fix_borders = fix_borders
     self.fill_holes = bool(fill_holes)
+    self.cross_sectional_area = bool(cross_sectional_area)
 
   def execute(self):
     vol = Volume(
@@ -158,6 +160,35 @@ class SkeletonTask(RegisteredTask):
       dust_threshold=self.dust_threshold,
       extra_targets_per_label=targets,
     )
+
+    if self.cross_sectional_area:
+      # per-vertex slice areas (xs3d capability, reference
+      # tasks/skeleton.py:400-572); crop each label to its bbox so the
+      # pass costs O(sum of label extents), not O(labels x volume)
+      from ..ops.cross_section import cross_sectional_area as _csa
+
+      anis = tuple(float(v) for v in vol.resolution)
+      dense, mapping = fastremap.renumber(labels)
+      slices = ndimage.find_objects(dense.astype(np.int32))
+      by_orig = {mapping[new_id]: sl for new_id, sl in
+                 enumerate(slices, start=1) if sl is not None}
+      for label, skel in skels.items():
+        sl = by_orig.get(int(label))
+        if sl is None:
+          continue
+        # +1 shell (clamped): an object ending inside the cutout keeps a
+        # background border, so only genuine cutout contacts flag as
+        # clipped (negative area)
+        grow = tuple(
+          slice(max(s.start - 1, 0), min(s.stop + 1, labels.shape[a]))
+          for a, s in enumerate(sl)
+        )
+        crop_off = np.asarray([g.start for g in grow], dtype=np.float32)
+        areas = _csa(
+          labels[grow] == label, skel, anisotropy=anis,
+          offset=tuple(np.asarray(cutout.minpt, np.float32) + crop_off),
+        )
+        skel.extra_attributes["cross_sectional_area"] = areas
 
     sdir = skel_dir_for(vol, self.skel_dir)
     cf = CloudFiles(vol.cloudpath)
@@ -222,6 +253,7 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
     vol = Volume(self.cloudpath)
     sdir = skel_dir_for(vol, self.skel_dir)
     cf = CloudFiles(vol.cloudpath)
+    attrs = (cf.get_json(f"{sdir}/info") or {}).get("vertex_attributes")
 
     frags = defaultdict(list)
     frag_keys = []
@@ -234,7 +266,10 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
       frags[label].append(key)
 
     for label, keys in frags.items():
-      skels = [Skeleton.from_precomputed(cf.get(k)) for k in keys]
+      skels = [
+        Skeleton.from_precomputed(cf.get(k), vertex_attributes=attrs)
+        for k in keys
+      ]
       merged = _merge_label(skels, self.dust_threshold, self.tick_threshold)
       if merged.empty:
         continue
@@ -291,13 +326,14 @@ class ShardedSkeletonMergeTask(RegisteredTask):
       if data is not None:
         fragmaps.append(FragMap.frombytes(data))
 
+    attrs = skel_info.get("vertex_attributes")
     out = {}
     for label in mine.tolist():
       pieces = []
       for fm in fragmaps:
         blob = fm.get(label)
         if blob is not None:
-          pieces.append(Skeleton.from_precomputed(blob))
+          pieces.append(Skeleton.from_precomputed(blob, vertex_attributes=attrs))
       if not pieces:
         continue
       merged = _merge_label(pieces, self.dust_threshold, self.tick_threshold)

@@ -66,11 +66,11 @@ class Volume:
     progress: bool = False,
     parallel: int = 1,
   ):
-    if cloudpath.startswith("graphene://"):
-      # curated gate: proofreading volumes need a PCG client registered
-      from .graphene import graphene_client
+    from .graphene import is_graphene, require_graphene_client
 
-      graphene_client(cloudpath)  # raises unless a client is registered
+    if is_graphene(cloudpath):
+      # curated gate: proofreading volumes need a PCG client registered
+      require_graphene_client(cloudpath)
     self.meta = PrecomputedMetadata(cloudpath, info=info)
     self.cloudpath = self.meta.cloudpath
     self.cf = self.meta.cf

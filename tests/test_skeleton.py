@@ -215,3 +215,43 @@ def test_skeletonize_disconnected_components():
   assert len(np.unique(s.components_by_vertex())) == 2
   xs = s.vertices[:, 0]
   assert xs.min() < 14 and xs.max() > 25  # both pieces skeletonized
+
+
+def test_cross_sectional_area_square_tube():
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  mask = np.zeros((60, 20, 20), bool)
+  mask[2:58, 4:16, 4:16] = True  # 12x12 cross-section
+  s = skeletonize_mask(mask, anisotropy=(2, 2, 2),
+                       params=TeasarParams(scale=4, const=6))
+  areas = cross_sectional_area(mask, s, anisotropy=(2, 2, 2))
+  # interior vertices: area ~= (12*2)*(12*2) = 576 nm^2
+  xs = s.vertices[:, 0]
+  interior = (xs > 20) & (xs < 96)
+  good = areas[interior]
+  assert (good > 0).all()
+  assert np.median(np.abs(good - 576.0)) / 576.0 < 0.15
+
+
+def test_skeleton_task_csa_attribute(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+    cross_sectional_area=True,
+  ))
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, dust_threshold=100, tick_threshold=100))
+  vol = Volume(path)
+  sdir = vol.info["skeletons"]
+  info = vol.cf.get_json(f"{sdir}/info")
+  ids = [a["id"] for a in info["vertex_attributes"]]
+  assert "cross_sectional_area" in ids
+  s = Skeleton.from_precomputed(
+    vol.cf.get(f"{sdir}/55"), vertex_attributes=info["vertex_attributes"])
+  csa = s.extra_attributes["cross_sectional_area"]
+  assert len(csa) == len(s.vertices)
+  # tube cross-section 12x12 voxels at 16nm: 192*192 nm^2
+  interior = csa[csa > 0]
+  assert len(interior) > 0
+  assert np.median(np.abs(interior - 192.0 * 192.0)) / (192.0**2) < 0.25
