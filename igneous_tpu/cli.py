@@ -74,6 +74,8 @@ def image():
 @click.option("--mip", default=0, show_default=True)
 @click.option("--num-mips", default=5, show_default=True)
 @click.option("--factor", type=TUPLE3, default=None, help="e.g. 2,2,1")
+@click.option("--isotropic", is_flag=True,
+              help="Per-mip factors driving the resolution toward isotropy.")
 @click.option("--sparse", is_flag=True)
 @click.option("--sharded", is_flag=True)
 @click.option("--fill-missing", is_flag=True)
@@ -82,12 +84,16 @@ def image():
 @click.option("--memory", "memory_target", default=int(3.5e9), show_default=True)
 @click.option("--method", "downsample_method", default="auto", show_default=True)
 @click.pass_context
-def image_downsample(ctx, path, queue, mip, num_mips, factor, sparse, sharded,
-                     fill_missing, chunk_size, encoding, memory_target,
-                     downsample_method):
+def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
+                     sparse, sharded, fill_missing, chunk_size, encoding,
+                     memory_target, downsample_method):
   """Build the downsample pyramid of PATH."""
   from . import task_creation as tc
 
+  if isotropic:
+    if factor is not None:
+      raise click.UsageError("--isotropic and --factor are exclusive")
+    factor = "isotropic"
   if sharded:
     tasks = tc.create_image_shard_downsample_tasks(
       path, mip=mip, fill_missing=fill_missing, sparse=sparse,
@@ -458,6 +464,43 @@ def mesh_merge(ctx, path, queue, magnitude, mesh_dir):
     path, magnitude=magnitude, mesh_dir=mesh_dir), ctx.obj["parallel"])
 
 
+@mesh.command("merge-sharded")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mesh-dir", default=None)
+@click.option("--num-lods", default=2, show_default=True)
+@click.pass_context
+def mesh_merge_sharded(ctx, path, queue, mesh_dir, num_lods):
+  """Sharded multires merge (requires a registered draco codec)."""
+  from . import task_creation as tc
+
+  enqueue(queue, tc.create_sharded_multires_mesh_tasks(
+    path, mesh_dir=mesh_dir, num_lods=num_lods), ctx.obj["parallel"])
+
+
+@mesh.group("spatial-index")
+def mesh_spatial_index():
+  """Mesh spatial-index maintenance."""
+
+
+@mesh_spatial_index.command("create")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(448, 448, 448), show_default=True)
+@click.option("--mesh-dir", default=None)
+@click.pass_context
+def mesh_spatial_index_create(ctx, path, queue, mip, shape, mesh_dir):
+  from . import task_creation as tc
+  from .tasks.mesh import mesh_dir_for
+  from .volume import Volume
+
+  mdir = mesh_dir_for(Volume(path), mesh_dir)
+  enqueue(queue, tc.create_spatial_index_tasks(path, mdir, mip=mip,
+                                               shape=shape),
+          ctx.obj["parallel"])
+
+
 @mesh.command("xfer")
 @click.argument("src")
 @click.argument("dest")
@@ -554,6 +597,59 @@ def skeleton_merge_sharded(ctx, path, queue, skel_dir, dust_threshold,
     path, skel_dir=skel_dir, dust_threshold=dust_threshold,
     tick_threshold=tick_threshold,
   ), ctx.obj["parallel"])
+
+
+@skeleton.command("convert")
+@click.argument("path")
+@click.argument("out_dir", type=click.Path())
+@click.option("--skel-dir", default=None)
+@click.option("--labels", default=None, help="comma-separated label ids")
+def skeleton_convert(path, out_dir, skel_dir, labels):
+  """Export finished skeletons as SWC files
+  (reference `igneous skeleton convert`)."""
+  import os
+
+  from .skeleton_io import Skeleton, to_swc
+  from .tasks.skeleton import skel_dir_for
+  from .volume import Volume
+
+  vol = Volume(path)
+  sdir = skel_dir_for(vol, skel_dir)
+  attrs = (vol.cf.get_json(f"{sdir}/info") or {}).get("vertex_attributes")
+  os.makedirs(out_dir, exist_ok=True)
+  wanted = set(int(l) for l in labels.split(",")) if labels else None
+  n = 0
+  for key in vol.cf.list(f"{sdir}/"):
+    name = key.split("/")[-1]
+    if not name.isdigit():
+      continue
+    label = int(name)
+    if wanted is not None and label not in wanted:
+      continue
+    s = Skeleton.from_precomputed(vol.cf.get(key), vertex_attributes=attrs)
+    with open(os.path.join(out_dir, f"{label}.swc"), "w") as f:
+      f.write(to_swc(s, label=label))
+    n += 1
+  click.echo(f"wrote {n} swc files to {out_dir}")
+
+
+@skeleton.command("spatial-index")
+@click.argument("path")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
+@click.option("--skel-dir", default=None)
+@click.pass_context
+def skeleton_spatial_index(ctx, path, queue, mip, shape, skel_dir):
+  """Rebuild the skeleton spatial index."""
+  from . import task_creation as tc
+  from .tasks.skeleton import skel_dir_for
+  from .volume import Volume
+
+  sdir = skel_dir_for(Volume(path), skel_dir)
+  enqueue(queue, tc.create_spatial_index_tasks(path, sdir, mip=mip,
+                                               shape=shape),
+          ctx.obj["parallel"])
 
 
 @skeleton.command("xfer")
@@ -704,6 +800,26 @@ def queue_rezero(queue_spec):
   from .queues import TaskQueue
 
   TaskQueue(queue_spec).rezero()
+
+
+@queue_group.command("cp")
+@click.argument("src")
+@click.argument("dest")
+def queue_cp(src, dest):
+  """Copy pending tasks between queues."""
+  from .queues import copy_queue
+
+  click.echo(f"copied {copy_queue(src, dest)} tasks")
+
+
+@queue_group.command("mv")
+@click.argument("src")
+@click.argument("dest")
+def queue_mv(src, dest):
+  """Move pending tasks between queues."""
+  from .queues import move_queue
+
+  click.echo(f"moved {move_queue(src, dest)} tasks")
 
 
 @main.group()

@@ -29,32 +29,61 @@ def axis_to_factor(axis: str) -> Tuple[int, int, int]:
   }[axis]
 
 
+def normalize_factor_sequence(factor, num_mips: int) -> List[Tuple[int, int, int]]:
+  """A single (fx,fy,fz) repeats per mip; a sequence of triples (e.g. from
+  near_isotropic_factor_sequence) is used per-mip as given."""
+  arr = np.asarray(factor, dtype=np.int64)
+  if arr.ndim == 2:
+    return [tuple(int(v) for v in f) for f in arr[:num_mips]]
+  return [tuple(int(v) for v in arr)] * num_mips
+
+
 def compute_factors(
   task_shape: Sequence[int],
-  factor: Sequence[int],
+  factor,
   num_mips: int,
   chunk_size: Optional[Sequence[int]] = None,
 ) -> List[Tuple[int, int, int]]:
   """Per-mip factors achievable inside one task of ``task_shape``.
 
-  A mip is achievable while the running shape divides evenly by ``factor``
+  ``factor`` is a triple or a per-mip sequence of triples. A mip is
+  achievable while the running shape divides evenly by that mip's factor
   and (when given) the result stays chunk-writable. Mirrors the role of
   reference downsample_scales.py:135-172.
   """
   shape = np.asarray(task_shape, dtype=np.int64)
-  f = np.asarray(factor, dtype=np.int64)
   factors: List[Tuple[int, int, int]] = []
-  for _ in range(num_mips):
-    if np.any(shape % f != 0):
+  for f in normalize_factor_sequence(factor, num_mips):
+    fa = np.asarray(f, dtype=np.int64)
+    if np.any(shape % fa != 0):
       break
-    nxt = shape // f
+    nxt = shape // fa
     if chunk_size is not None and np.any(
       (nxt % np.asarray(chunk_size, dtype=np.int64) != 0) & (nxt != 1)
     ):
       break
-    factors.append(tuple(int(v) for v in f))
+    factors.append(f)
     shape = nxt
   return factors
+
+
+def near_isotropic_factor_sequence(
+  resolution: Sequence[int], num_mips: int
+) -> List[Tuple[int, int, int]]:
+  """Per-mip 2x factors that drive the resolution toward isotropy
+  (capability of the reference's Neuroglancer-derived planners,
+  downsample_scales.py:33-133): at each level, halve every axis whose
+  resolution is within 2x of the smallest — coarse axes (e.g. EM z) are
+  left alone until the fine axes catch up."""
+  res = np.asarray(resolution, dtype=np.float64)
+  out: List[Tuple[int, int, int]] = []
+  for _ in range(num_mips):
+    smallest = res.min()
+    # the smallest axis always halves; coarser axes join once within 2x
+    f = np.where(res < 2 * smallest, 2, 1).astype(np.int64)
+    out.append(tuple(int(v) for v in f))
+    res = res * f
+  return out
 
 
 def scale_series(factor: Sequence[int], num_mips: int) -> List[Vec]:

@@ -227,17 +227,26 @@ def _from_device_layout(x) -> np.ndarray:
 
 def downsample(
   img: np.ndarray,
-  factor: Sequence[int],
+  factor,
   num_mips: int = 1,
   method: str = "average",
   sparse: bool = False,
 ) -> List[np.ndarray]:
-  """Pool ``img`` (x,y,z[,c]) iteratively; returns one array per mip."""
+  """Pool ``img`` (x,y,z[,c]) iteratively; returns one array per mip.
+
+  ``factor`` is one (fx,fy,fz) triple applied every mip, or a per-mip
+  sequence of triples (near-isotropic pyramids)."""
   squeeze = img.ndim == 3
   orig_dtype = img.dtype
   if img.dtype == bool:
     img = img.view(np.uint8)
-  factors = tuple(tuple(int(v) for v in factor) for _ in range(num_mips))
+  arr = np.asarray(factor, dtype=np.int64)
+  if arr.ndim == 2:
+    if len(arr) < num_mips:
+      raise ValueError(f"need {num_mips} per-mip factors, got {len(arr)}")
+    factors = tuple(tuple(int(v) for v in f) for f in arr[:num_mips])
+  else:
+    factors = tuple(tuple(int(v) for v in arr) for _ in range(num_mips))
 
   if method == "mode" and img.dtype.itemsize == 8:
     # 64-bit labels ride as (lo, hi) uint32 planes: equality distributes

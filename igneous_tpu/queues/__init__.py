@@ -21,4 +21,4 @@ from .registry import (
 )
 from .local import LocalTaskQueue, MockTaskQueue
 from .filequeue import FileQueue
-from .queue import TaskQueue, register_queue_protocol
+from .queue import TaskQueue, copy_queue, move_queue, register_queue_protocol

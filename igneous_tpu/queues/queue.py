@@ -17,6 +17,62 @@ def register_queue_protocol(name: str, factory):
   _QUEUE_PROTOCOLS[name] = factory
 
 
+def _require_filequeue(q, spec):
+  from .filequeue import FileQueue
+
+  if not isinstance(q, FileQueue):
+    raise ValueError(
+      f"queue cp/mv supports fq:// queues only (got {spec!r}); protocol "
+      "backends expose their own bulk-transfer tooling"
+    )
+  return q
+
+
+def _snapshot_payloads(src, delete: bool):
+  """Yield pending payloads, tolerating workers leasing files mid-walk
+  (the same FileNotFoundError races lease()/release() absorb)."""
+  import os
+
+  for name in sorted(os.listdir(src.queue_dir)):
+    path = os.path.join(src.queue_dir, name)
+    try:
+      with open(path) as f:
+        payload = f.read()
+    except FileNotFoundError:
+      continue  # a worker leased it between listing and reading
+    yield name, payload
+    if delete:
+      try:
+        os.remove(path)
+      except FileNotFoundError:
+        pass
+
+
+def copy_queue(src_spec: str, dest_spec: str) -> int:
+  """Copy all pending tasks from one fq:// queue to another
+  (`igneous queue cp`). Leased tasks are not copied."""
+  src = _require_filequeue(TaskQueue(src_spec), src_spec)
+  dest = TaskQueue(dest_spec)
+  n = 0
+  for _name, payload in _snapshot_payloads(src, delete=False):
+    dest.insert(payload)
+    n += 1
+  return n
+
+
+def move_queue(src_spec: str, dest_spec: str) -> int:
+  """Move all pending tasks (`igneous queue mv`). Each file is deleted
+  only AFTER its copy lands, so tasks inserted concurrently are never
+  dropped (they simply stay in the source)."""
+  src = _require_filequeue(TaskQueue(src_spec), src_spec)
+  dest = TaskQueue(dest_spec)
+  n = 0
+  for _name, payload in _snapshot_payloads(src, delete=True):
+    dest.insert(payload)
+    n += 1
+  return n
+
+
 def TaskQueue(spec, **kw):
   """Create a queue from a URL spec (or pass through a queue object)."""
   if not isinstance(spec, str):

@@ -42,20 +42,39 @@ def _provenance(vol: Volume, method: dict):
 def _pick_task_shape(
   vol: Volume,
   mip: int,
-  factor: Sequence[int],
+  factor,
   memory_target: int,
   num_mips: int,
   chunk_size: Optional[Sequence[int]] = None,
 ) -> Vec:
   cs = Vec(*(chunk_size if chunk_size is not None else vol.meta.chunk_size(mip)))
-  shape = downsample_shape_from_memory_target(
-    vol.dtype.itemsize,
-    int(cs.x), int(cs.y), int(cs.z),
-    factor,
-    memory_target,
-    max_mips=num_mips,
-    num_channels=vol.num_channels,
-  )
+  arr = np.asarray(factor, dtype=np.int64)
+  if arr.ndim == 2:
+    # per-mip factor sequence: the largest chunk-aligned shape whose
+    # pyramid fits the byte budget
+    width = vol.dtype.itemsize * vol.num_channels
+    seq = [np.asarray(f, dtype=np.int64) for f in arr[:num_mips]]
+    shape = np.asarray(cs) * seq[0]
+    for m in range(1, len(seq) + 1):
+      cum = np.prod(np.stack(seq[:m]), axis=0)
+      cand = np.asarray(cs) * cum
+      vox = float(np.prod(cand))
+      series = 1.0 + sum(
+        1.0 / float(np.prod(np.prod(np.stack(seq[:i]), axis=0)))
+        for i in range(1, m + 1)
+      )
+      if vox * series * width > memory_target and m > 1:
+        break
+      shape = cand
+  else:
+    shape = downsample_shape_from_memory_target(
+      vol.dtype.itemsize,
+      int(cs.x), int(cs.y), int(cs.z),
+      factor,
+      memory_target,
+      max_mips=num_mips,
+      num_channels=vol.num_channels,
+    )
   return Vec(*np.minimum(
     np.asarray(shape),
     np.asarray(vol.meta.bounds(mip).expand_to_chunk_size(
@@ -83,8 +102,20 @@ def create_downsampling_tasks(
   downsample_method: str = "auto",
 ):
   """Grid of DownsampleTasks; creates the destination scales first
-  (reference: task_creation/image.py:195-345)."""
+  (reference: task_creation/image.py:195-345).
+
+  ``factor`` may be one triple, a per-mip sequence of triples, or the
+  string "isotropic" (per-mip factors from the reference's near-isotropic
+  planners, driving resolution toward isotropy)."""
   vol = Volume(layer_path, mip=mip)
+  if isinstance(factor, str):
+    if factor != "isotropic":
+      raise ValueError(f"unknown factor spec {factor!r}")
+    from ..downsample_scales import near_isotropic_factor_sequence
+
+    factor = near_isotropic_factor_sequence(
+      [int(v) for v in vol.resolution], num_mips
+    )
   if factor is None:
     factor = axis_to_factor(axis) if axis != "z" else DEFAULT_FACTOR
 

@@ -57,7 +57,7 @@ def downsample_and_upload(
   # uint64 labels are handled natively (hi/lo uint32 planes on device)
   with telemetry.stage("device_pool"):
     mips_out = pooling.downsample(
-      image, factors[0], len(factors), method=method, sparse=sparse
+      image, factors, len(factors), method=method, sparse=sparse
     )
 
   cur_bounds = bounds.clone()

@@ -241,3 +241,75 @@ def test_validate_provenance_skips_mesh_info(tmp_path, rng):
   run(tc.create_meshing_tasks(path, shape=(32, 32, 32), mesh_dir="mesh"))
   # the mesh dir's info has no provenance and must NOT be reported
   assert validate_provenance(f"file://{tmp_path}/bucket") == {}
+
+
+def test_queue_cp_mv(tmp_path):
+  from igneous_tpu.queues import FileQueue, copy_queue, move_queue
+  from igneous_tpu.queues.registry import PrintTask
+
+  a = FileQueue(f"fq://{tmp_path}/a")
+  a.insert([PrintTask(str(i)) for i in range(5)])
+  n = copy_queue(f"fq://{tmp_path}/a", f"fq://{tmp_path}/b")
+  assert n == 5
+  b = FileQueue(f"fq://{tmp_path}/b")
+  assert b.enqueued == 5 and a.enqueued == 5
+  n = move_queue(f"fq://{tmp_path}/a", f"fq://{tmp_path}/c")
+  assert n == 5
+  assert a.enqueued == 0
+  assert FileQueue(f"fq://{tmp_path}/c").enqueued == 5
+
+
+def test_swc_roundtrip():
+  from igneous_tpu.skeleton_io import Skeleton, from_swc, to_swc
+
+  s = Skeleton(
+    [[0, 0, 0], [10, 0, 0], [20, 0, 0], [10, 10, 0], [100, 100, 100],
+     [110, 100, 100]],
+    [[0, 1], [1, 2], [1, 3], [4, 5]],  # a branch + a separate component
+    radii=[1, 2, 3, 4, 5, 6],
+  )
+  text = to_swc(s, label=42)
+  assert text.startswith("# label 42")
+  s2 = from_swc(text)
+  assert len(s2) == 6
+  assert len(s2.edges) == 4
+  # same connectivity structure (2 components, same cable length)
+  assert len(np.unique(s2.components_by_vertex())) == 2
+  assert abs(s2.cable_length() - s.cable_length()) < 1e-3
+  # parents: exactly one root per component
+  roots = [l for l in text.splitlines() if l.endswith(" -1")]
+  assert len(roots) == 2
+
+
+def test_near_isotropic_factors():
+  from igneous_tpu.downsample_scales import near_isotropic_factor_sequence
+
+  seq = near_isotropic_factor_sequence((4, 4, 40), 5)
+  assert seq[0] == (2, 2, 1)  # z is >2x coarser: left alone
+  res = np.array([4.0, 4.0, 40.0])
+  for f in seq:
+    res *= f
+  # after 5 mips the anisotropy ratio has collapsed
+  assert res.max() / res.min() <= 40 / 4
+
+
+def test_cli_skeleton_convert(tmp_path, rng):
+  from click.testing import CliRunner
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.cli import main
+
+  data = np.zeros((64, 32, 32), np.uint64)
+  data[4:60, 10:22, 10:22] = 77
+  Volume.from_numpy(data, f"file://{tmp_path}/seg", resolution=(16, 16, 16),
+                    layer_type="segmentation", chunk_size=(64, 32, 32))
+  run(tc.create_skeletonizing_tasks(
+    f"file://{tmp_path}/seg", shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50}))
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    f"file://{tmp_path}/seg", dust_threshold=100, tick_threshold=100))
+  r = CliRunner().invoke(main, [
+    "skeleton", "convert", f"file://{tmp_path}/seg", str(tmp_path / "swc")])
+  assert r.exit_code == 0, r.output
+  swc = (tmp_path / "swc" / "77.swc").read_text()
+  assert swc.count("\n") > 5

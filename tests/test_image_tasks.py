@@ -274,3 +274,35 @@ def test_num_mips_zero_creates_no_scales(tmp_path):
     path, num_mips=0, memory_target=16 * 1024 * 1024))
   vol = Volume(path)
   assert vol.meta.num_mips == 1
+
+
+def test_downsample_isotropic_sequence(tmp_path, rng):
+  # 4x4x40 resolution: z held until x/y catch up
+  path = f"file://{tmp_path}/iso"
+  data = rng.integers(0, 255, (256, 256, 64)).astype(np.uint8)
+  Volume.from_numpy(data, path, resolution=(4, 4, 40), chunk_size=(64, 64, 64))
+  run(tc.create_downsampling_tasks(
+    path, num_mips=2, factor="isotropic", memory_target=64 * 1024 * 1024))
+  vol = Volume(path)
+  assert vol.meta.resolution(1).tolist() == [8, 8, 40]
+  assert vol.meta.resolution(2).tolist() == [16, 16, 40]
+  # oracle: apply the per-mip factors sequentially
+  exp1 = oracle.np_downsample_with_averaging(data, (2, 2, 1), 1)[0]
+  exp2 = oracle.np_downsample_with_averaging(exp1, (2, 2, 1), 1)[0]
+  assert np.array_equal(vol.download(vol.meta.bounds(1), mip=1)[..., 0], exp1)
+  assert np.array_equal(vol.download(vol.meta.bounds(2), mip=2)[..., 0], exp2)
+
+
+def test_downsample_mixed_factor_sequence(tmp_path, rng):
+  path = f"file://{tmp_path}/mix"
+  data = rng.integers(0, 255, (128, 128, 128)).astype(np.uint8)
+  Volume.from_numpy(data, path, resolution=(8, 8, 8), chunk_size=(32, 32, 32))
+  run(tc.create_downsampling_tasks(
+    path, num_mips=2, factor=[(2, 2, 1), (1, 1, 2)],
+    memory_target=64 * 1024 * 1024))
+  vol = Volume(path)
+  assert vol.meta.resolution(1).tolist() == [16, 16, 8]
+  assert vol.meta.resolution(2).tolist() == [16, 16, 16]
+  exp1 = oracle.np_downsample_with_averaging(data, (2, 2, 1), 1)[0]
+  exp2 = oracle.np_downsample_with_averaging(exp1, (1, 1, 2), 1)[0]
+  assert np.array_equal(vol.download(vol.meta.bounds(2), mip=2)[..., 0], exp2)
