@@ -769,6 +769,10 @@ def queue_status(queue_spec, eta, sample_sec):
   click.echo(f"enqueued: {tq.enqueued}")
   click.echo(f"leased: {tq.leased}")
   click.echo(f"completed: {tq.completed}")
+  if hasattr(tq, "lease_ages"):
+    ages = tq.lease_ages()
+    if ages:
+      click.echo(f"lease_expiry_sec (min/max): {ages[0]:.0f}/{ages[-1]:.0f}")
   if eta:
     from .telemetry import queue_eta
 
@@ -800,6 +804,22 @@ def queue_rezero(queue_spec):
   from .queues import TaskQueue
 
   TaskQueue(queue_spec).rezero()
+
+
+@queue_group.command("fsck")
+@click.argument("queue_spec")
+@click.option("--repair", is_flag=True,
+              help="Quarantine malformed tasks, recycle bad leases.")
+def queue_fsck(queue_spec, repair):
+  """Audit queue consistency (malformed tasks, bad leases, counter drift)."""
+  import json as json_mod
+
+  from .queues import TaskQueue
+
+  tq = TaskQueue(queue_spec)
+  if not hasattr(tq, "fsck"):
+    raise click.UsageError("fsck supports fq:// queues only")
+  click.echo(json_mod.dumps(tq.fsck(repair=repair), indent=2))
 
 
 @queue_group.command("cp")

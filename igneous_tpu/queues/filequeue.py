@@ -62,6 +62,76 @@ class FileQueue:
   def leased(self) -> int:
     return len(os.listdir(self.lease_dir))
 
+  def lease_ages(self) -> List[float]:
+    """Seconds until each outstanding lease expires (negative = overdue,
+    will recycle on the next poll)."""
+    now = time.time()
+    out = []
+    for name in os.listdir(self.lease_dir):
+      try:
+        out.append(float(name.split(LEASE_SEP, 1)[0]) - now)
+      except ValueError:
+        continue
+    return sorted(out)
+
+  def fsck(self, repair: bool = False) -> dict:
+    """Consistency audit: undeserializable task files (the same check
+    lease() applies), unparseable lease names, counter drift. With
+    repair=True, malformed files move to ``<queue>/quarantine/`` and
+    bad-name leases with VALID payloads recycle into the queue (corrupt
+    ones are quarantined too)."""
+    problems = {"malformed_tasks": [], "bad_lease_names": [],
+                "counter_drift": self.inserted - self.completed - self.enqueued}
+    quarantine_dir = os.path.join(self.path, "quarantine")
+
+    def payload_ok(path: str):
+      """None if a worker raced us; else (valid, contents)."""
+      try:
+        with open(path) as f:
+          contents = f.read()
+      except FileNotFoundError:
+        return None  # leased/recycled mid-scan: healthy, skip
+      try:
+        deserialize(contents)  # exactly what lease() will do
+        return (True, contents)
+      except Exception:
+        return (False, contents)
+
+    def quarantine(path: str, name: str):
+      os.makedirs(quarantine_dir, exist_ok=True)
+      try:
+        os.rename(path, os.path.join(quarantine_dir, name))
+      except FileNotFoundError:
+        pass
+
+    for name in list(os.listdir(self.queue_dir)):
+      path = os.path.join(self.queue_dir, name)
+      result = payload_ok(path)
+      if result is None or result[0]:
+        continue
+      problems["malformed_tasks"].append(name)
+      if repair:
+        quarantine(path, name)
+
+    for name in list(os.listdir(self.lease_dir)):
+      try:
+        float(name.split(LEASE_SEP, 1)[0])
+        continue  # well-formed lease
+      except ValueError:
+        pass
+      problems["bad_lease_names"].append(name)
+      if repair:
+        path = os.path.join(self.lease_dir, name)
+        result = payload_ok(path)
+        if result is not None and result[0]:
+          try:
+            os.rename(path, os.path.join(self.queue_dir, name))
+          except FileNotFoundError:
+            pass
+        elif result is not None:
+          quarantine(path, name)
+    return problems
+
   def is_empty(self) -> bool:
     return self.enqueued == 0
 
