@@ -234,3 +234,23 @@ def test_mesh_deletion_requires_mesh_dir(tmp_path):
     np.zeros((8, 8, 8), np.uint64), path, layer_type="segmentation")
   with pytest.raises(ValueError):
     list(tc.create_mesh_deletion_tasks(path))
+
+
+def test_simplify_qem_preserves_corners():
+  mask = np.zeros((40, 40, 40), np.uint8)
+  mask[4:36, 4:36, 4:36] = 1
+  v, f = marching_tetrahedra(mask)
+  m = Mesh(v, f)
+  corner = np.array([3.5, 3.5, 3.5], np.float32)
+  s_cent = simplify(m, reduction_factor=50, max_error=6, placement="centroid")
+  s_qem = simplify(m, reduction_factor=50, max_error=6, placement="qem")
+  d_cent = np.linalg.norm(s_cent.vertices - corner, axis=1).min()
+  d_qem = np.linalg.norm(s_qem.vertices - corner, axis=1).min()
+  assert d_qem < 0.05  # QEM snaps a vertex onto the true corner
+  assert d_qem < d_cent
+
+
+def test_simplify_validates_placement():
+  m = Mesh([[0, 0, 0], [1, 0, 0], [0, 1, 0]], [[0, 1, 2]])
+  with pytest.raises(ValueError):
+    simplify(m, reduction_factor=2, placement="QEM")
