@@ -501,6 +501,22 @@ def mesh_spatial_index_create(ctx, path, queue, mip, shape, mesh_dir):
           ctx.obj["parallel"])
 
 
+@mesh_spatial_index.command("db")
+@click.argument("path")
+@click.argument("db_path", type=click.Path())
+@click.option("--mesh-dir", default=None)
+def mesh_spatial_index_db(path, db_path, mesh_dir):
+  """Materialize the spatial index into a sqlite database."""
+  from .spatial_index import SpatialIndex
+  from .tasks.mesh import mesh_dir_for
+  from .volume import Volume
+
+  vol = Volume(path)
+  mdir = mesh_dir_for(vol, mesh_dir)
+  n = SpatialIndex(vol.cf, mdir).to_sqlite(db_path)
+  click.echo(f"wrote {n} rows to {db_path}")
+
+
 @mesh.command("xfer")
 @click.argument("src")
 @click.argument("dest")

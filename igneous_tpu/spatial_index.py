@@ -54,6 +54,63 @@ class SpatialIndex:
           out.add(int(label))
     return out
 
+  def to_sqlite(self, db_path: str) -> int:
+    """Materialize the index into a sqlite db for fast repeated queries
+    (reference `igneous mesh spatial-index db`, cli.py capability).
+    Returns the number of (label, cell) rows."""
+    import sqlite3
+
+    conn = sqlite3.connect(db_path)
+    cur = conn.cursor()
+    cur.execute("DROP TABLE IF EXISTS spatial_index")
+    cur.execute(
+      "CREATE TABLE spatial_index ("
+      " label INTEGER, cell TEXT,"
+      " minx REAL, miny REAL, minz REAL,"
+      " maxx REAL, maxy REAL, maxz REAL)"
+    )
+    n = 0
+    for key in self.index_files():
+      doc = self.cf.get_json(key)
+      if not doc:
+        continue
+      rows = [
+        (int(label), key, *map(float, mn), *map(float, mx))
+        for label, (mn, mx) in doc.items()
+      ]
+      cur.executemany(
+        "INSERT INTO spatial_index VALUES (?,?,?,?,?,?,?,?)", rows
+      )
+      n += len(rows)
+    cur.execute("CREATE INDEX idx_label ON spatial_index(label)")
+    cur.execute(
+      "CREATE INDEX idx_bbox ON spatial_index(minx, miny, minz)"
+    )
+    conn.commit()
+    conn.close()
+    return n
+
+  @staticmethod
+  def query_sqlite(db_path: str, bbox: Optional[Bbox] = None) -> Set[int]:
+    import sqlite3
+
+    conn = sqlite3.connect(db_path)
+    cur = conn.cursor()
+    if bbox is None:
+      cur.execute("SELECT DISTINCT label FROM spatial_index")
+    else:
+      mn = [float(v) for v in bbox.minpt]
+      mx = [float(v) for v in bbox.maxpt]
+      cur.execute(
+        "SELECT DISTINCT label FROM spatial_index WHERE "
+        "minx < ? AND maxx > ? AND miny < ? AND maxy > ? "
+        "AND minz < ? AND maxz > ?",
+        (mx[0], mn[0], mx[1], mn[1], mx[2], mn[2]),
+      )
+    out = {int(r[0]) for r in cur.fetchall()}
+    conn.close()
+    return out
+
   def file_locations_per_label(
     self, labels: Optional[Iterable[int]] = None
   ) -> Dict[int, List[str]]:

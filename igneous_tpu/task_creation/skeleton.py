@@ -42,6 +42,7 @@ def create_skeletonizing_tasks(
   fix_borders: bool = True,
   fill_holes: bool = False,
   cross_sectional_area: bool = False,
+  synapses: Optional[dict] = None,
   bounds: Optional[Bbox] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
@@ -85,6 +86,53 @@ def create_skeletonizing_tasks(
     vol, bounds, mip, mip, chunk_size=vol.meta.chunk_size(mip)
   )
 
+  # synapses → per-task voxel targets. Accepted forms:
+  #   {label: [[x,y,z] PHYSICAL points]}                      (dict)
+  #   [((x,y,z), label, swc_label), ...]                      (reference
+  #     task_creation/skeleton.py:390-411 tuple list)
+  # Points are bucketed by grid cell once, so per-task lookup is O(1)
+  # (the reference's kD-tree serves the same purpose).
+  cell_targets = {}  # (cx,cy,cz) -> {label: [[x,y,z,swc_label], ...]}
+  if synapses:
+    res = np.asarray(vol.resolution, dtype=np.float64)
+    grid_lo = np.asarray(task_bounds.minpt, dtype=np.int64)
+    shape_arr = np.asarray(shape, dtype=np.int64)
+
+    def normalized():
+      if isinstance(synapses, dict):
+        for label, pts in synapses.items():
+          for p in pts:
+            yield (p, int(label), 0)
+      else:
+        for p, label, swc_label in synapses:
+          yield (p, int(label), int(swc_label))
+
+    for p, label, swc_label in normalized():
+      vox = (np.asarray(p, dtype=np.float64) / res).astype(np.int64)
+      rel = vox - grid_lo
+      cells = {tuple((rel // shape_arr).tolist())}
+      # a point on a cell's first plane also sits in the previous cell's
+      # +1 overlap cutout
+      for axis in range(3):
+        if rel[axis] % shape_arr[axis] == 0 and rel[axis] > 0:
+          for c in list(cells):
+            lower = list(c)
+            lower[axis] -= 1
+            cells.add(tuple(lower))
+      entry = [int(vox[0]), int(vox[1]), int(vox[2]), swc_label]
+      for c in cells:
+        cell_targets.setdefault(c, {}).setdefault(label, []).append(entry)
+
+  def task_targets(offset: Vec, shape_: Vec):
+    if not cell_targets:
+      return None
+    cell = tuple((
+      (np.asarray(offset, dtype=np.int64)
+       - np.asarray(task_bounds.minpt, dtype=np.int64))
+      // np.asarray(shape_, dtype=np.int64)
+    ).tolist())
+    return cell_targets.get(cell)
+
   def make_task(shape_: Vec, offset: Vec):
     return SkeletonTask(
       cloudpath=cloudpath,
@@ -102,6 +150,7 @@ def create_skeletonizing_tasks(
       fix_borders=fix_borders,
       fill_holes=fill_holes,
       cross_sectional_area=cross_sectional_area,
+      extra_targets=task_targets(offset, shape_),
     )
 
   def finish():

@@ -99,6 +99,7 @@ class SkeletonTask(RegisteredTask):
     fix_borders: bool = True,
     fill_holes: bool = False,
     cross_sectional_area: bool = False,
+    extra_targets: Optional[Dict] = None,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -115,6 +116,17 @@ class SkeletonTask(RegisteredTask):
     self.fix_borders = fix_borders
     self.fill_holes = bool(fill_holes)
     self.cross_sectional_area = bool(cross_sectional_area)
+    # {label: [[x,y,z(,swc_label)] global voxel coords]} — synapse/marker
+    # points that must become skeleton vertices, optionally typed for SWC
+    # export (reference synapse kD-tree targets,
+    # task_creation/skeleton.py:390-411)
+    self.extra_targets = {
+      int(k): [
+        [int(p[0]), int(p[1]), int(p[2]), int(p[3]) if len(p) > 3 else 0]
+        for p in v
+      ]
+      for k, v in (extra_targets or {}).items()
+    }
 
   def execute(self):
     vol = Volume(
@@ -150,8 +162,22 @@ class SkeletonTask(RegisteredTask):
         ),
       )
       if self.fix_borders
-      else None
+      else {}
     )
+    # synapse/marker targets: global voxel coords → cutout-local
+    for label, pts in self.extra_targets.items():
+      arr = np.asarray(pts, dtype=np.int64).reshape(-1, 4)
+      local = arr[:, :3] - np.asarray(cutout.minpt)
+      inside = np.all(
+        (local >= 0) & (local < np.asarray(labels.shape)), axis=1
+      )
+      if inside.any():
+        prior = targets.get(label)
+        merged = local[inside] if prior is None else np.concatenate(
+          [prior, local[inside]]
+        )
+        targets[label] = merged
+    targets = targets or None
     skels = skeletonize(
       labels,
       anisotropy=tuple(float(v) for v in vol.resolution),
@@ -160,6 +186,22 @@ class SkeletonTask(RegisteredTask):
       dust_threshold=self.dust_threshold,
       extra_targets_per_label=targets,
     )
+
+    # type the synapse vertices for SWC export (reference swc_label)
+    if self.extra_targets:
+      res_f = np.asarray(vol.resolution, dtype=np.float32)
+      for label, pts in self.extra_targets.items():
+        skel = skels.get(int(label))
+        if skel is None or skel.empty:
+          continue
+        for x, y, z, swc_label in pts:
+          if not swc_label:
+            continue
+          phys = np.asarray([x, y, z], np.float32) * res_f
+          d = np.abs(skel.vertices - phys).max(axis=1)
+          hit = np.flatnonzero(d < 1e-3)
+          if len(hit):
+            skel.vertex_types[hit[0]] = np.uint8(swc_label)
 
     if self.cross_sectional_area:
       # per-vertex slice areas (xs3d capability, reference

@@ -255,3 +255,68 @@ def test_skeleton_task_csa_attribute(tmp_path):
   interior = csa[csa > 0]
   assert len(interior) > 0
   assert np.median(np.abs(interior - 192.0 * 192.0)) / (192.0**2) < 0.25
+
+
+def test_synapse_targets(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  # a synapse point on the tube surface, physical nm (res 16)
+  synapse_nm = [30 * 16, 11 * 16, 11 * 16]
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+    synapses={55: [synapse_nm]},
+  ))
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, dust_threshold=100, tick_threshold=0))  # keep the synapse twig
+  vol = Volume(path)
+  sdir = vol.info["skeletons"]
+  s = Skeleton.from_precomputed(vol.cf.get(f"{sdir}/55"))
+  d = np.linalg.norm(
+    s.vertices - np.asarray(synapse_nm, np.float32), axis=1
+  ).min()
+  assert d < 1e-3  # the synapse point is a skeleton vertex
+
+
+def test_spatial_index_sqlite(tmp_path):
+  from igneous_tpu.spatial_index import SpatialIndex
+  from igneous_tpu.lib import Bbox as B
+
+  path, data = make_tube_seg(tmp_path)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50}))
+  vol = Volume(path)
+  si = SpatialIndex(vol.cf, vol.info["skeletons"])
+  db = str(tmp_path / "index.db")
+  n = si.to_sqlite(db)
+  assert n >= 1
+  assert SpatialIndex.query_sqlite(db) == {55}
+  assert SpatialIndex.query_sqlite(db, B((0, 0, 0), (10, 10, 10))) == set()
+
+
+def test_synapse_reference_tuple_format(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  synapse_nm = (30 * 16, 11 * 16, 11 * 16)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+    synapses=[(synapse_nm, 55, 7)],  # ((x,y,z), label, swc_label)
+  ))
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, dust_threshold=100, tick_threshold=0))
+  vol = Volume(path)
+  s = Skeleton.from_precomputed(vol.cf.get(f"{vol.info['skeletons']}/55"))
+  d = np.abs(s.vertices - np.asarray(synapse_nm, np.float32)).max(axis=1)
+  hit = np.flatnonzero(d < 1e-3)
+  assert len(hit) == 1
+  assert s.vertex_types[hit[0]] == 7  # swc_label survives the merge
+
+
+def test_synapse_empty_list_is_harmless(tmp_path):
+  path, data = make_tube_seg(tmp_path)
+  tasks = list(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+    synapses={55: []},
+  ))
+  assert len(tasks) == 2
