@@ -700,8 +700,9 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
 
 
 @main.command("execute")
-@click.argument("queue_spec")
-@click.option("--lease-sec", default=600, show_default=True)
+@click.argument("queue_spec", required=False)
+@click.option("--lease-sec", default=None, type=int,
+              help="Visibility timeout [default: $LEASE_SECONDS or 600].")
 @click.option("-n", "num_tasks", default=None, type=int,
               help="Stop after N tasks.")
 @click.option("--exit-on-empty", is_flag=True)
@@ -713,7 +714,16 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
 def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
             timing):
   """Worker poll loop: lease → run → delete
-  (reference cli.py:888-964 semantics)."""
+  (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
+  QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
+  stay declarative (secrets.py)."""
+  from . import secrets
+
+  queue_spec = queue_spec or secrets.queue_url()
+  if not queue_spec:
+    raise click.UsageError("provide QUEUE_SPEC or set $QUEUE_URL")
+  if lease_sec is None:
+    lease_sec = secrets.lease_seconds()
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp

@@ -40,7 +40,12 @@ import numpy as np
 Factor3 = Tuple[int, int, int]
 
 
-def method_for_layer(layer_type: str, method: str = "auto") -> str:
+def method_for_layer(layer_type: str, method="auto") -> str:
+  """``method`` accepts the string names, a DownsampleMethods enum member,
+  or its integer value."""
+  from ..types import DownsampleMethods
+
+  method = DownsampleMethods.to_name(method)
   if method != "auto":
     return method
   return "mode" if layer_type == "segmentation" else "average"

@@ -1,0 +1,32 @@
+"""Environment configuration (reference igneous/secrets.py:13-16 parity).
+
+Workers read these so container CMDs stay declarative (Dockerfile /
+deployment.yaml set them): QUEUE_URL (the reference's SQS_URL analog),
+LEASE_SECONDS, and optional cloud credentials directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def queue_url() -> "str | None":
+  return os.environ.get("QUEUE_URL") or os.environ.get("SQS_URL")
+
+
+def sqs_region_name() -> "str | None":
+  return os.environ.get("SQS_REGION_NAME")
+
+
+def sqs_endpoint_url() -> "str | None":
+  return os.environ.get("SQS_ENDPOINT_URL")
+
+
+def lease_seconds() -> int:
+  return int(os.environ.get("LEASE_SECONDS", 600))
+
+
+def secrets_dir() -> str:
+  return os.environ.get(
+    "IGNEOUS_TPU_SECRETS", os.path.expanduser("~/.cloudfiles/secrets")
+  )

@@ -63,9 +63,10 @@ class SpatialIndex:
     conn = sqlite3.connect(db_path)
     cur = conn.cursor()
     cur.execute("DROP TABLE IF EXISTS spatial_index")
+    # labels are TEXT: uint64 segment ids >= 2^63 overflow sqlite INTEGER
     cur.execute(
       "CREATE TABLE spatial_index ("
-      " label INTEGER, cell TEXT,"
+      " label TEXT, cell TEXT,"
       " minx REAL, miny REAL, minz REAL,"
       " maxx REAL, maxy REAL, maxz REAL)"
     )
@@ -75,7 +76,7 @@ class SpatialIndex:
       if not doc:
         continue
       rows = [
-        (int(label), key, *map(float, mn), *map(float, mx))
+        (str(int(label)), key, *map(float, mn), *map(float, mx))
         for label, (mn, mx) in doc.items()
       ]
       cur.executemany(

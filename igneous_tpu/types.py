@@ -1,0 +1,33 @@
+"""Shared type aliases + enums (reference igneous/types.py:6-12 parity)."""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Sequence, Tuple, Union
+
+import numpy as np
+
+ShapeType = Union[Tuple[int, int, int], Sequence[int], np.ndarray]
+
+
+class DownsampleMethods(IntEnum):
+  AUTO = 0
+  AVERAGE = 1
+  MODE = 2
+  MIN = 3
+  MAX = 4
+  STRIDING = 5
+
+  @classmethod
+  def to_name(cls, method: "Union[DownsampleMethods, int, str]") -> str:
+    """Normalize to the string names ops.pooling understands."""
+    if isinstance(method, str):
+      return method.lower()
+    return {
+      cls.AUTO: "auto",
+      cls.AVERAGE: "average",
+      cls.MODE: "mode",
+      cls.MIN: "min",
+      cls.MAX: "max",
+      cls.STRIDING: "striding",
+    }[cls(method)]

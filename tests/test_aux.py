@@ -313,3 +313,51 @@ def test_cli_skeleton_convert(tmp_path, rng):
   assert r.exit_code == 0, r.output
   swc = (tmp_path / "swc" / "77.swc").read_text()
   assert swc.count("\n") > 5
+
+
+def test_execute_env_fallbacks(tmp_path, rng, monkeypatch):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  arr = rng.integers(0, 255, (64, 64, 64)).astype(np.uint8)
+  Volume.from_numpy(arr, f"file://{tmp_path}/vol")
+  q = f"fq://{tmp_path}/q"
+  runner = CliRunner()
+  r = runner.invoke(main, [
+    "image", "downsample", f"file://{tmp_path}/vol", "--queue", q,
+    "--num-mips", "1", "--memory", str(16 * 1024 * 1024)])
+  assert r.exit_code == 0, r.output
+  monkeypatch.setenv("QUEUE_URL", q)
+  monkeypatch.setenv("LEASE_SECONDS", "120")
+  r = runner.invoke(main, ["execute", "--exit-on-empty"])
+  assert r.exit_code == 0, r.output
+  assert "executed 1 tasks" in r.output
+  # no args and no env → usage error
+  monkeypatch.delenv("QUEUE_URL")
+  r = runner.invoke(main, ["execute", "--exit-on-empty"])
+  assert r.exit_code != 0
+
+
+def test_downsample_methods_enum():
+  from igneous_tpu.ops.pooling import method_for_layer
+  from igneous_tpu.types import DownsampleMethods
+
+  assert method_for_layer("image", DownsampleMethods.MODE) == "mode"
+  assert method_for_layer("segmentation", DownsampleMethods.AUTO) == "mode"
+  assert method_for_layer("image", 1) == "average"
+  assert method_for_layer("image", "STRIDING") == "striding"
+
+
+def test_sqlite_index_uint64_labels(tmp_path):
+  from igneous_tpu.lib import Bbox as B
+  from igneous_tpu.spatial_index import SpatialIndex
+  from igneous_tpu.storage import CloudFiles
+
+  cf = CloudFiles(f"file://{tmp_path}/layer")
+  si = SpatialIndex(cf, "idx")
+  big = 2**63 + 5
+  si.put(B((0, 0, 0), (100, 100, 100)), {big: B((1, 1, 1), (9, 9, 9))})
+  db = str(tmp_path / "i.db")
+  assert si.to_sqlite(db) == 1
+  assert SpatialIndex.query_sqlite(db) == {big}
