@@ -385,3 +385,23 @@ def test_cseg_corrupt_stream_raises(rng):
         cseg_mod.decompress(truncated, labels.shape, np.uint32)
     finally:
       os.environ.pop("IGNEOUS_TPU_NO_NATIVE", None)
+
+
+def test_transfer_nonaligned_fixture_geometry(tmp_path, rng):
+  """Reference transfer-suite geometry: non-chunk-aligned (600,600,200)
+  volume with an offset, full rechunk round trip
+  (test/test_transfer_tasks.py:20-42)."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+
+  data = rng.integers(0, 255, (600, 600, 200)).astype(np.uint8)
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dst"
+  Volume.from_numpy(data, src, voxel_offset=(3, 7, 11),
+                    chunk_size=(128, 128, 64))
+  LocalTaskQueue(progress=False).insert(tc.create_transfer_tasks(
+    src, dest, chunk_size=(64, 64, 64), shape=(256, 256, 128),
+    skip_downsamples=True))
+  out = Volume(dest)
+  assert out.meta.voxel_offset(0).tolist() == [3, 7, 11]
+  assert np.array_equal(out[out.bounds][..., 0], data)

@@ -1,0 +1,64 @@
+"""Planning-math tests mirroring the reference's downsample_scales_test.py
+and shard/memory-target units (SURVEY.md §4 pure-unit tests)."""
+
+import numpy as np
+import pytest
+
+from igneous_tpu.downsample_scales import (
+  axis_to_factor,
+  compute_factors,
+  downsample_shape_from_memory_target,
+  near_isotropic_factor_sequence,
+  num_mips_from_memory_target,
+  pyramid_memory_bytes,
+)
+
+
+def test_axis_to_factor():
+  assert axis_to_factor("z") == (2, 2, 1)
+  assert axis_to_factor("y") == (2, 1, 2)
+  assert axis_to_factor("x") == (1, 2, 2)
+
+
+def test_compute_factors_stops_at_odd():
+  assert compute_factors((256, 256, 64), (2, 2, 1), 10) == [(2, 2, 1)] * 8
+  assert compute_factors((96, 96, 64), (2, 2, 1), 10) == [
+    (2, 2, 1), (2, 2, 1), (2, 2, 1), (2, 2, 1), (2, 2, 1)
+  ]
+  assert compute_factors((100, 100, 64), (2, 2, 1), 10) == [(2, 2, 1), (2, 2, 1)]
+  assert compute_factors((63, 64, 64), (2, 2, 1), 10) == []
+
+
+def test_compute_factors_chunk_guard():
+  # outputs must stay chunk-writable
+  assert compute_factors((256, 256, 64), (2, 2, 1), 10,
+                         chunk_size=(64, 64, 64)) == [(2, 2, 1), (2, 2, 1)]
+
+
+def test_pyramid_memory_bytes():
+  # 64^3 uint8 with 2 mips of (2,2,1): 64^3 * (1 + 1/4 + 1/16)
+  got = pyramid_memory_bytes((64, 64, 64), 1, (2, 2, 1), 2)
+  assert got == int(np.ceil(64**3 * (1 + 0.25 + 0.0625)))
+
+
+def test_num_mips_from_memory_target():
+  # matches the reference's headline example scale: a 3.5GB budget fits a
+  # deep pyramid over 64^3 uint8 chunks
+  m = num_mips_from_memory_target(int(3.5e9), 1, (64, 64, 64), (2, 2, 1))
+  shape = np.array([64, 64, 64]) * np.array([2, 2, 1]) ** m
+  assert pyramid_memory_bytes(shape, 1, (2, 2, 1), m) <= 3.5e9
+  next_shape = np.array([64, 64, 64]) * np.array([2, 2, 1]) ** (m + 1)
+  assert pyramid_memory_bytes(next_shape, 1, (2, 2, 1), m + 1) > 3.5e9
+
+
+def test_downsample_shape_respects_max_mips():
+  shape = downsample_shape_from_memory_target(
+    1, 64, 64, 64, (2, 2, 1), int(3.5e9), max_mips=2)
+  assert shape.tolist() == [256, 256, 64]
+  with pytest.raises(ValueError):
+    downsample_shape_from_memory_target(1, 64, 64, 64, (2, 2, 1), 0)
+
+
+def test_near_isotropic_terminates_at_isotropy():
+  seq = near_isotropic_factor_sequence((40, 40, 40), 3)
+  assert seq == [(2, 2, 2)] * 3
