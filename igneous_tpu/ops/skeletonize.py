@@ -287,13 +287,16 @@ def skeletonize(
   object_ids: Optional[Sequence[int]] = None,
   dust_threshold: int = 0,
   extra_targets_per_label: Optional[Dict[int, np.ndarray]] = None,
+  parallel: int = 1,
   progress: bool = False,
 ) -> Dict[int, Skeleton]:
   """Skeletonize every label in a volume → {label: Skeleton}.
 
   The whole-cutout EDT runs as ONE device program; per-label tracing crops
   to each label's bounding box (the reference's per-label split,
-  tasks/skeleton.py:303-335)."""
+  tasks/skeleton.py:303-335). ``parallel`` threads the label loop (the
+  scipy/numpy hot paths release the GIL) — the reference forwards the
+  same knob to kimimaro (task_creation/skeleton.py:159-163)."""
   del progress
   params = params or TeasarParams()
   labels = np.asarray(labels)
@@ -307,17 +310,15 @@ def skeletonize(
   dense, mapping = _renumber(labels)
   slices = ndimage.find_objects(dense.astype(np.int32))
 
-  out: Dict[int, Skeleton] = {}
   wanted = set(int(v) for v in object_ids) if object_ids else None
-  for new_id, sl in enumerate(slices, start=1):
-    if sl is None:
-      continue
+
+  def trace(new_id: int, sl) -> Optional[tuple]:
     orig = mapping[new_id]
     if wanted is not None and orig not in wanted:
-      continue
+      return None
     mask = dense[sl] == new_id
     if dust_threshold and mask.sum() < dust_threshold:
-      continue
+      return None
     crop_edt = np.where(mask, whole_edt[sl], 0.0)
     crop_offset = np.asarray(offset, np.float32) + np.asarray(
       [s.start for s in sl], np.float32
@@ -334,6 +335,24 @@ def skeletonize(
       mask, anisotropy, params, offset=crop_offset, edt_field=crop_edt,
       extra_targets=targets,
     )
-    if not skel.empty:
-      out[int(orig)] = skel
+    return None if skel.empty else (int(orig), skel)
+
+  jobs = [
+    (new_id, sl)
+    for new_id, sl in enumerate(slices, start=1)
+    if sl is not None
+  ]
+  out: Dict[int, Skeleton] = {}
+  if parallel > 1 and len(jobs) > 1:
+    import concurrent.futures as cf
+
+    with cf.ThreadPoolExecutor(max_workers=int(parallel)) as pool:
+      for result in pool.map(lambda j: trace(*j), jobs):
+        if result is not None:
+          out[result[0]] = result[1]
+  else:
+    for job in jobs:
+      result = trace(*job)
+      if result is not None:
+        out[result[0]] = result[1]
   return out

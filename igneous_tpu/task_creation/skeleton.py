@@ -43,6 +43,7 @@ def create_skeletonizing_tasks(
   fill_holes: bool = False,
   cross_sectional_area: bool = False,
   synapses: Optional[dict] = None,
+  parallel: int = 1,
   bounds: Optional[Bbox] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
@@ -151,6 +152,7 @@ def create_skeletonizing_tasks(
       fill_holes=fill_holes,
       cross_sectional_area=cross_sectional_area,
       extra_targets=task_targets(offset, shape_),
+      parallel=parallel,
     )
 
   def finish():

@@ -100,6 +100,7 @@ class SkeletonTask(RegisteredTask):
     fill_holes: bool = False,
     cross_sectional_area: bool = False,
     extra_targets: Optional[Dict] = None,
+    parallel: int = 1,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -127,6 +128,7 @@ class SkeletonTask(RegisteredTask):
       ]
       for k, v in (extra_targets or {}).items()
     }
+    self.parallel = int(parallel)
 
   def execute(self):
     vol = Volume(
@@ -185,6 +187,7 @@ class SkeletonTask(RegisteredTask):
       offset=tuple(float(v) for v in cutout.minpt),
       dust_threshold=self.dust_threshold,
       extra_targets_per_label=targets,
+      parallel=self.parallel,
     )
 
     # type the synapse vertices for SWC export (reference swc_label)

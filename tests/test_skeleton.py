@@ -320,3 +320,16 @@ def test_synapse_empty_list_is_harmless(tmp_path):
     synapses={55: []},
   ))
   assert len(tasks) == 2
+
+
+def test_skeletonize_parallel_matches_serial(rng):
+  lab = np.zeros((60, 24, 24), np.uint64)
+  lab[2:28, 4:20, 4:20] = 3
+  lab[32:58, 4:20, 4:20] = 8
+  serial = skeletonize(lab, params=TeasarParams(scale=4, const=4))
+  threaded = skeletonize(lab, params=TeasarParams(scale=4, const=4),
+                         parallel=4)
+  assert sorted(serial) == sorted(threaded)
+  for k in serial:
+    assert np.array_equal(serial[k].vertices, threaded[k].vertices)
+    assert np.array_equal(serial[k].edges, threaded[k].edges)
