@@ -140,13 +140,22 @@ def _pool_mode(x, f: Factor3, sparse: bool):
       e = ee if e is None else (e & ee)
     return e
 
+  # pairwise equalities are symmetric: n*(n-1)/2 compares instead of n^2
+  pair = {}
+  for i in range(n):
+    for j in range(i + 1, n):
+      pair[(i, j)] = eq(vs[i], vs[j]).astype(jnp.int32)
+
   best_score = None
   best_val = None
   for i in range(n):
     counts = None
     for j in range(n):
-      e = eq(vs[i], vs[j]).astype(jnp.int32)
+      if i == j:
+        continue
+      e = pair[(min(i, j), max(i, j))]
       counts = e if counts is None else counts + e
+    counts = counts + 1  # self-match
     score = counts * n - i
     if sparse:
       zero = None
