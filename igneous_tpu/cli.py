@@ -517,6 +517,26 @@ def mesh_spatial_index_db(path, db_path, mesh_dir):
   click.echo(f"wrote {n} rows to {db_path}")
 
 
+@mesh.command("clean")
+@click.argument("path")
+@click.option("--mesh-dir", default=None)
+def mesh_clean(path, mesh_dir):
+  """Delete stage-1 intermediates (fragment files, .frags containers,
+  .spatial cells), keeping manifests and multires outputs."""
+  from .tasks.mesh import mesh_dir_for
+  from .volume import Volume
+
+  vol = Volume(path)
+  mdir = mesh_dir_for(vol, mesh_dir)
+  doomed = [
+    k for k in vol.cf.list(f"{mdir}/")
+    if k.endswith(".frags") or k.endswith(".spatial")
+    or len(k.split("/")[-1].split(":")) == 3  # label:0:bbox fragments
+  ]
+  vol.cf.delete(doomed)
+  click.echo(f"deleted {len(doomed)} intermediate files")
+
+
 @mesh.command("xfer")
 @click.argument("src")
 @click.argument("dest")
@@ -666,6 +686,25 @@ def skeleton_spatial_index(ctx, path, queue, mip, shape, skel_dir):
   enqueue(queue, tc.create_spatial_index_tasks(path, sdir, mip=mip,
                                                shape=shape),
           ctx.obj["parallel"])
+
+
+@skeleton.command("clean")
+@click.argument("path")
+@click.option("--skel-dir", default=None)
+def skeleton_clean(path, skel_dir):
+  """Delete stage-1 intermediates (.sk fragments, .frags containers,
+  .spatial cells), keeping the merged skeletons."""
+  from .tasks.skeleton import skel_dir_for
+  from .volume import Volume
+
+  vol = Volume(path)
+  sdir = skel_dir_for(vol, skel_dir)
+  doomed = [
+    k for k in vol.cf.list(f"{sdir}/")
+    if k.endswith(".sk") or k.endswith(".frags") or k.endswith(".spatial")
+  ]
+  vol.cf.delete(doomed)
+  click.echo(f"deleted {len(doomed)} intermediate files")
 
 
 @skeleton.command("xfer")

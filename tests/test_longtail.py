@@ -241,3 +241,37 @@ def test_skeleton_prefix_coverage():
   for label in (1, 9, 10, 99, 100, 54321):
     hits = [p for p in prefixes if f"{label}:x".startswith(p)]
     assert len(hits) == 1, (label, hits)
+
+
+def test_cli_mesh_and_skeleton_clean(tmp_path):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  data = np.zeros((64, 32, 32), np.uint64)
+  data[4:60, 10:22, 10:22] = 9
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, resolution=(16, 16, 16),
+                    layer_type="segmentation", chunk_size=(64, 32, 32))
+  run(tc.create_meshing_tasks(path, shape=(64, 32, 32), mesh_dir="mesh"))
+  run(tc.create_mesh_manifest_tasks(path, magnitude=1))
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50}))
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, dust_threshold=100, tick_threshold=100))
+
+  runner = CliRunner()
+  r = runner.invoke(main, ["mesh", "clean", path])
+  assert r.exit_code == 0, r.output
+  vol = Volume(path)
+  left = list(vol.cf.list("mesh/"))
+  assert all(":0:" not in k and not k.endswith(".spatial") for k in left)
+  assert "mesh/9:0" in left  # manifest survives
+
+  r = runner.invoke(main, ["skeleton", "clean", path])
+  assert r.exit_code == 0, r.output
+  sdir = vol.info["skeletons"]
+  left = list(vol.cf.list(f"{sdir}/"))
+  assert all(not k.endswith(".sk") for k in left)
+  assert f"{sdir}/9" in left  # merged skeleton survives
