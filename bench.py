@@ -15,56 +15,114 @@ reached through a tunnel measured at ~10-15 MB/s host↔device (see
 detail.transfer_MBps), which caps ANY e2e device pipeline below CPU numpy
 regardless of kernel speed; on a directly-attached TPU (PCIe/ICI ~100+
 GB/s) the e2e figure approaches the kernel figure.
+
+Supervision (round-2 fix): the TPU relay sometimes stalls for hours, and a
+stalled relay can hang ANY jax backend init in-process (the axon shim
+patches jax's backend resolution at interpreter start). Round 1's bench
+recorded 0 vox/s because of exactly that. This script therefore runs as a
+supervisor by default: it probes the tunnel in a disposable subprocess,
+runs the real bench as a supervised child with a deadline, and if the
+tunnel is stalled falls back to an XLA-CPU child in a scrubbed
+environment (shim disabled) so the driver always receives a real,
+clearly-labeled number instead of a watchdog zero.
 """
 
 import json
 import os
+import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
 
 QUICK = bool(int(os.environ.get("BENCH_QUICK", "0")))
-INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
-
-
-def _require_live_backend():
-  """The TPU here sits behind a relay that sometimes stalls indefinitely;
-  a hung backend init must produce a diagnosable JSON line, not a hung
-  bench process."""
-  ready = threading.Event()
-  state = {}
-
-  def probe():
-    try:
-      import jax
-
-      state["device"] = str(jax.devices()[0])
-      ready.set()
-    except Exception as e:  # records the failure for the JSON line
-      state["error"] = repr(e)
-      ready.set()
-
-  t = threading.Thread(target=probe, daemon=True)
-  t.start()
-  if not ready.wait(INIT_TIMEOUT_S) or "error" in state:
-    err = state.get(
-      "error", f"backend init exceeded {INIT_TIMEOUT_S}s (tunnel stalled?)"
-    )
-    print(json.dumps({
-      "metric": "downsample_kernel_mip0to4_voxels_per_sec",
-      "value": 0,
-      "unit": "vox/s",
-      "vs_baseline": 0,
-      "detail": {"error": err},
-    }))
-    sys.exit(0)
+INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
 IMG_SHAPE = (512, 512, 64) if QUICK else (1024, 1024, 128)
 SEG_SHAPE = (256, 256, 64) if QUICK else (512, 512, 256)
 NUM_MIPS = 4
 KERNEL_ITERS = 3 if QUICK else 10
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+
+
+def _scrubbed_cpu_env() -> dict:
+  from __graft_entry__ import _scrubbed_cpu_env as scrub
+
+  return scrub()
+
+
+def _probe_tpu(timeout_s: float) -> bool:
+  """Can a fresh interpreter reach an actual accelerator without hanging?
+  A fast axon-init failure falls back to the cpu platform with rc 0, so
+  rc alone is not evidence of a live device — check the platform name."""
+  try:
+    proc = subprocess.run(
+      [sys.executable, "-c",
+       "import jax; d = jax.devices(); print(d[0].platform)"],
+      capture_output=True, text=True, timeout=timeout_s, cwd=_REPO_DIR,
+    )
+    return proc.returncode == 0 and proc.stdout.strip() in ("axon", "tpu")
+  except subprocess.TimeoutExpired:
+    return False
+
+
+def _run_child(mode: str, env: dict, timeout_s: float):
+  """Run `bench.py --child <mode>`; return its JSON result dict or None."""
+  try:
+    proc = subprocess.run(
+      [sys.executable, os.path.abspath(__file__), "--child", mode],
+      env=env, capture_output=True, text=True, timeout=timeout_s,
+      cwd=_REPO_DIR,
+    )
+  except subprocess.TimeoutExpired:
+    return None
+  if proc.returncode != 0:
+    sys.stderr.write(proc.stderr)
+    return None
+  for line in reversed(proc.stdout.strip().splitlines()):
+    try:
+      return json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+      continue
+  return None
+
+
+def supervise():
+  deadline = time.time() + INIT_TIMEOUT_S
+  tpu_ok = False
+  while time.time() < deadline:
+    if _probe_tpu(min(45, max(5, deadline - time.time()))):
+      tpu_ok = True
+      break
+    time.sleep(5)
+
+  result = None
+  if tpu_ok:
+    result = _run_child("tpu", dict(os.environ), CHILD_TIMEOUT_S)
+  if result is None:
+    fb = _run_child("cpu", _scrubbed_cpu_env(), CHILD_TIMEOUT_S)
+    if fb is not None:
+      fb.setdefault("detail", {})["platform"] = (
+        "cpu-fallback (TPU tunnel stalled)" if not tpu_ok
+        else "cpu-fallback (TPU child failed)"
+      )
+      result = fb
+  if result is None:
+    result = {
+      "metric": "downsample_kernel_mip0to4_voxels_per_sec",
+      "value": 0, "unit": "vox/s", "vs_baseline": 0,
+      "detail": {"error": "both TPU and CPU bench children failed"},
+    }
+  print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# data
 
 
 def make_data():
@@ -81,7 +139,7 @@ def make_data():
 # kernel-level (device-resident)
 
 
-def bench_tpu_kernels(img, seg):
+def bench_device_kernels(img, seg):
   import jax
   import jax.numpy as jnp
   from functools import partial
@@ -187,7 +245,6 @@ def measure_transfer_MBps():
 def bench_mesh_kernel():
   """BASELINE config 3: marching-tetrahedra count pass on a 256^3 mask
   (the per-voxel device stage; emission is O(surface) host work)."""
-  import jax
   import jax.numpy as jnp
 
   from igneous_tpu.ops.mesh import _count_kernel
@@ -238,10 +295,16 @@ def bench_edt_kernel():
   return lab.size / dt
 
 
-def main():
-  _require_live_backend()
+def run_bench(platform: str):
+  if platform == "tpu":
+    # Never report CPU numbers as TPU: a fast axon-init failure silently
+    # falls back to cpu ("axon,cpu" platform list), rc stays 0.
+    import jax
+
+    backend = jax.default_backend()
+    assert backend in ("axon", "tpu"), f"tpu child got backend {backend!r}"
   img, seg = make_data()
-  tpu_kernel = bench_tpu_kernels(img, seg)
+  dev_kernel = bench_device_kernels(img, seg)
   cpu1 = bench_cpu_kernels(img, seg)
   cpu8 = cpu1 * 8.0
   e2e = bench_e2e(img, seg)
@@ -252,9 +315,9 @@ def main():
 
   result = {
     "metric": "downsample_kernel_mip0to4_voxels_per_sec",
-    "value": round(tpu_kernel, 1),
+    "value": round(dev_kernel, 1),
     "unit": "vox/s",
-    "vs_baseline": round(tpu_kernel / cpu8, 3),
+    "vs_baseline": round(dev_kernel / cpu8, 3),
     "detail": {
       "img_shape": list(IMG_SHAPE),
       "seg_shape": list(SEG_SHAPE),
@@ -267,6 +330,7 @@ def main():
       "edt_kernel_voxps": round(edt_rate, 1),
       "baseline": "numpy-oracle kernels x8-core credit "
                   "(reference stack not installed in this image)",
+      "platform": platform,
       "device": _device_name(),
     },
   }
@@ -283,4 +347,7 @@ def _device_name():
 
 
 if __name__ == "__main__":
-  main()
+  if "--child" in sys.argv:
+    run_bench(sys.argv[sys.argv.index("--child") + 1])
+  else:
+    supervise()

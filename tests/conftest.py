@@ -2,14 +2,34 @@ import os
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 # exercised without TPU hardware. bench.py (run separately) uses the real
-# chip. Force (not setdefault): the ambient environment points JAX at the
-# tunneled TPU, which would make every kernel test pay tunnel latency.
+# chip.
+#
+# Hermeticity: the ambient environment boots every interpreter with the
+# axon sitecustomize shim (PALLAS_AXON_POOL_IPS non-empty), which imports
+# jax at interpreter start and explicitly sets the `jax_platforms` CONFIG
+# to "axon,cpu" — so by the time this conftest runs, setting the
+# JAX_PLATFORMS env var alone is too late (the config was already
+# materialized), and a stalled TPU relay would hang the first backend
+# init even for "CPU" tests (round-1 failure mode). The fix is to also
+# override the live jax config before any backend is initialized; backend
+# init is lazy, so this reliably prevents the tunnel dial. The env vars
+# still matter for subprocesses (LocalTaskQueue spawn workers).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Spawned worker interpreters (LocalTaskQueue parallel=N) re-run the
+# sitecustomize at boot; an env var alone would be overridden by the shim's
+# explicit config set, so the shim must be disabled outright for children.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ.pop("AXON_POOL_SVC_OVERRIDE", None)
+os.environ.pop("AXON_LOOPBACK_RELAY", None)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
   os.environ["XLA_FLAGS"] = (
     xla_flags + " --xla_force_host_platform_device_count=8"
   ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
