@@ -2,10 +2,10 @@
 
 Reference equivalents: zmesh's Mesh type + cloud-volume's mesh IO
 (/root/reference/igneous/tasks/mesh/mesh.py:385-450) and the mapbuffer
-``.frags`` container (SURVEY.md §2.3 mapbuffer). Draco encoding is a
-pluggable hook (register_draco_codec): no draco codec ships in this
-environment, and the default interchange format is Precomputed legacy
-(raw little-endian), which Neuroglancer reads natively.
+``.frags`` container (SURVEY.md §2.3 mapbuffer). Draco encoding defaults
+to the built-in pure-numpy bitstream codec (igneous_tpu.draco) and can be
+overridden via register_draco_codec; the legacy interchange format is
+Precomputed (raw little-endian), which Neuroglancer also reads natively.
 """
 
 from __future__ import annotations
@@ -86,7 +86,9 @@ class Mesh:
     return cls(vertices.copy(), faces.copy())
 
 
-# draco hook: a deployment with a draco codec registers (encode, decode)
+# draco hook: defaults to the built-in pure-numpy bitstream codec
+# (igneous_tpu.draco); a deployment with a native draco library can
+# override it by registering its own (encode, decode) pair.
 _DRACO_CODEC = None
 
 
@@ -95,16 +97,20 @@ def register_draco_codec(encode_fn, decode_fn):
   _DRACO_CODEC = (encode_fn, decode_fn)
 
 
+def _draco_codec():
+  global _DRACO_CODEC
+  if _DRACO_CODEC is None:
+    from . import draco
+
+    _DRACO_CODEC = (draco.encode_to_bytes, draco.decode_to_mesh)
+  return _DRACO_CODEC
+
+
 def encode_mesh(mesh: Mesh, encoding: str = "precomputed", **kw) -> bytes:
   if encoding == "precomputed":
     return mesh.to_precomputed()
   if encoding == "draco":
-    if _DRACO_CODEC is None:
-      raise NotImplementedError(
-        "No draco codec in this environment; register one with "
-        "mesh_io.register_draco_codec or use encoding='precomputed'."
-      )
-    return _DRACO_CODEC[0](mesh, **kw)
+    return _draco_codec()[0](mesh, **kw)
   raise ValueError(f"Unknown mesh encoding: {encoding}")
 
 
@@ -112,9 +118,7 @@ def decode_mesh(data: bytes, encoding: str = "precomputed") -> Mesh:
   if encoding == "precomputed":
     return Mesh.from_precomputed(data)
   if encoding == "draco":
-    if _DRACO_CODEC is None:
-      raise NotImplementedError("No draco codec registered")
-    return _DRACO_CODEC[1](data)
+    return _draco_codec()[1](data)
   raise ValueError(f"Unknown mesh encoding: {encoding}")
 
 

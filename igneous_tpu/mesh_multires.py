@@ -7,10 +7,10 @@ Reference parity: /root/reference/igneous/tasks/mesh/multires.py
 
 Produces the Neuroglancer ``neuroglancer_multilod_draco`` structures:
 per-label manifest (chunk grid, lod scales, fragment positions/sizes) and
-per-LOD octree fragments. Fragment payload encoding goes through the
-pluggable draco hook (mesh_io.register_draco_codec) — no draco library
-ships in this environment, so consumers must register one (tests register
-a stand-in codec to exercise the full structure).
+per-LOD octree fragments. Fragment payloads are draco bitstreams from the
+built-in codec (igneous_tpu.draco) by default, quantized per fragment so
+the lattice spans the fragment's octree cell — the contract Neuroglancer's
+multires renderer consumes (reference multires.py:144-177).
 """
 
 from __future__ import annotations
@@ -25,31 +25,36 @@ from .mesh_io import Mesh, encode_mesh, simplify
 from .sharding import compressed_morton_code
 
 
-def draco_quantization_settings(
-  chunk_size: Sequence[float],
-  grid_origin: Sequence[float],
-  mesh_bbox: Bbox,
-  quantization_bits: int = 16,
-) -> dict:
-  """Quantization origin/range/bits such that the draco grid aligns with
-  chunk boundaries (fresh derivation of reference draco.py:7-59: the
-  quantization step must evenly divide the chunk so fragment borders land
-  on representable positions and adjacent fragments stitch exactly)."""
-  chunk_size = np.asarray(chunk_size, dtype=np.float64)
-  grid_origin = np.asarray(grid_origin, dtype=np.float64)
-  span = np.asarray(mesh_bbox.maxpt, np.float64) - grid_origin
-  n_chunks = np.maximum(np.ceil(span / chunk_size), 1)
-  full_range = float(np.max(n_chunks * chunk_size))
-  # steps per chunk must be a power of two so every chunk boundary is a
-  # lattice point; choose the largest bits that keeps that true
-  steps = (1 << quantization_bits) - 1
-  steps_per_chunk = steps * chunk_size.max() / full_range
-  bits_per_chunk = int(np.floor(np.log2(max(steps_per_chunk, 1))))
+def to_stored_lattice(
+  vertices: np.ndarray,
+  cell_origin: np.ndarray,
+  cell_size: np.ndarray,
+  vertex_quantization_bits: int,
+) -> np.ndarray:
+  """Transform model-space vertices into Neuroglancer's stored-model
+  lattice for one multires fragment: per-axis, the fragment's octree cell
+  maps onto [0, 2**vertex_quantization_bits]. This is the coordinate
+  system the multires renderer consumes (reference equivalent:
+  to_stored_model_space before DracoPy.encode, multires.py:144-177)."""
+  scale = float(1 << vertex_quantization_bits) / np.asarray(cell_size, np.float64)
+  return (np.asarray(vertices, np.float64) - cell_origin) * scale
+
+
+def fragment_draco_settings(vertex_quantization_bits: int = 16) -> dict:
+  """Draco encode settings for a stored-lattice fragment: one more bit
+  than the lattice and range 2**(bits+1)-1 makes the draco bin size
+  exactly 1 lattice unit, so lattice integers 0..2**bits round-trip
+  bit-exactly and adjacent fragments stitch on shared wall points (fresh
+  derivation of the reference draco.py:7-59 alignment contract — with the
+  lattice transform applied first, the general solver reduces to this
+  closed form)."""
+  bits = vertex_quantization_bits + 1
+  if bits > 30:
+    raise ValueError(f"vertex_quantization_bits too large: {bits - 1}")
   return {
-    "quantization_origin": [float(v) for v in grid_origin],
-    "quantization_range": full_range,
-    "quantization_bits": quantization_bits,
-    "steps_per_chunk": 1 << max(bits_per_chunk, 0),
+    "quantization_bits": bits,
+    "quantization_origin": (0.0, 0.0, 0.0),
+    "quantization_range": float((1 << bits) - 1),
   }
 
 
@@ -63,25 +68,160 @@ def _zorder(positions: np.ndarray) -> np.ndarray:
   return np.argsort(np.asarray(codes), kind="stable")
 
 
+def _clip_polygons(
+  verts: np.ndarray, counts: np.ndarray, axis: int, sign: float, bound: float
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Sutherland-Hodgman clip of padded polygons against one axis plane.
+
+  verts (P, K, 3) float64 with per-polygon vertex counts; keeps the
+  half-space ``sign * (x[axis] - bound) <= 0``. Vectorized over polygons —
+  the per-edge loop runs K (≤ 9) times regardless of P.
+  """
+  P, K, _ = verts.shape
+  out = np.zeros((P, K + 1, 3), dtype=np.float64)
+  outc = np.zeros(P, dtype=np.int64)
+  d = sign * (verts[:, :, axis] - bound)  # signed distance, (P, K)
+  inside = d <= 1e-9
+  rows = np.arange(P)
+  for k in range(K):
+    valid = k < counts
+    j = np.where(k + 1 < counts, k + 1, 0)
+    vi, vj = verts[rows, k], verts[rows, j]
+    di, dj = d[rows, k], d[rows, j]
+    ini, inj = inside[rows, k], inside[rows, j]
+    # emit current vertex if inside
+    emit = valid & ini
+    out[rows[emit], outc[emit]] = vi[emit]
+    outc[emit] += 1
+    # emit intersection if the edge crosses the plane; clamp t — the
+    # inside tolerance admits points marginally past the plane, and an
+    # unclamped near-parallel edge would extrapolate a spike far outside
+    cross = valid & (ini != inj)
+    if cross.any():
+      t = np.clip(di[cross] / (di[cross] - dj[cross]), 0.0, 1.0)
+      pt = vi[cross] + t[:, None] * (vj[cross] - vi[cross])
+      pt[:, axis] = bound  # exact landing on the wall (lattice-stitchable)
+      out[rows[cross], outc[cross]] = pt
+      outc[cross] += 1
+  return out, outc
+
+
+def _triangulate_fans(verts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+  """Fan-triangulate padded convex polygons → (T, 3, 3) triangles."""
+  tris = []
+  for c in range(3, int(counts.max()) + 1 if len(counts) else 3):
+    sel = counts >= c
+    if not sel.any():
+      continue
+    v = verts[sel]
+    tris.append(np.stack([v[:, 0], v[:, c - 2], v[:, c - 1]], axis=1))
+  if not tris:
+    return np.zeros((0, 3, 3), dtype=np.float64)
+  return np.concatenate(tris, axis=0)
+
+
+def clip_triangles_to_box(
+  tri: np.ndarray, lo: np.ndarray, hi: np.ndarray
+) -> np.ndarray:
+  """Clip triangles (T, 3, 3) to an axis box; returns retriangulated
+  (T', 3, 3). Capability equivalent of zmesh.chunk_mesh (reference
+  multires.py:542-552): fragment geometry ends exactly at cell walls so
+  per-cell quantization never clamps, and adjacent fragments stitch."""
+  if len(tri) == 0:
+    return np.zeros((0, 3, 3), dtype=np.float64)
+  K = 3
+  verts = np.zeros((len(tri), K, 3), dtype=np.float64)
+  verts[:, :3] = tri
+  counts = np.full(len(tri), 3, dtype=np.int64)
+  for axis in range(3):
+    for sign, bound in ((-1.0, float(lo[axis])), (1.0, float(hi[axis]))):
+      verts, counts = _clip_polygons(verts, counts, axis, sign, bound)
+      keep = counts >= 3
+      verts, counts = verts[keep], counts[keep]
+      if len(verts) == 0:
+        return np.zeros((0, 3, 3), dtype=np.float64)
+  return _triangulate_fans(verts, counts)
+
+
 def octree_fragments(
   mesh: Mesh, chunk_size: np.ndarray, grid_origin: np.ndarray
 ) -> Dict[Tuple[int, int, int], Mesh]:
-  """Split a mesh into octree cells; each triangle goes to the cell
-  containing its centroid (the reference retriangulates at cell walls via
-  zmesh.chunk_mesh; centroid assignment keeps geometry identical while
-  letting fragments slightly overhang their cells)."""
+  """Split a mesh into octree cells, retriangulating triangles at cell
+  walls (reference: zmesh.chunk_mesh via retriangulate_mesh,
+  multires.py:542-552). Triangles fully inside a cell pass through
+  untouched; spanning triangles are clipped into every cell they touch so
+  fragment geometry lies exactly within its cell — required for the
+  per-cell draco quantization lattice."""
   if len(mesh.faces) == 0:
     return {}
-  tri = mesh.vertices[mesh.faces.astype(np.int64)]  # (F, 3, 3)
-  centroids = tri.mean(axis=1)
-  cells = np.floor((centroids - grid_origin) / chunk_size).astype(np.int64)
-  cells = np.maximum(cells, 0)
+  chunk_size = np.asarray(chunk_size, dtype=np.float64)
+  grid_origin = np.asarray(grid_origin, dtype=np.float64)
+  tri = mesh.vertices[mesh.faces.astype(np.int64)].astype(np.float64)
+  eps = 1e-9
+  clo = np.floor((tri.min(axis=1) - grid_origin) / chunk_size - eps)
+  chi = np.floor((tri.max(axis=1) - grid_origin) / chunk_size + eps)
+  clo = np.maximum(clo.astype(np.int64), 0)
+  chi = np.maximum(chi.astype(np.int64), clo)
+  # a triangle flat along an axis and sitting exactly on a cell wall would
+  # satisfy the inclusive clip of BOTH adjacent cells and be emitted twice;
+  # pin such axes to the centroid's cell (the old centroid convention)
+  flat = (tri.max(axis=1) - tri.min(axis=1)) <= eps * np.maximum(chunk_size, 1)
+  if flat.any():
+    cen = np.floor(
+      (tri.mean(axis=1) - grid_origin) / chunk_size
+    ).astype(np.int64)
+    cen = np.maximum(cen, 0)
+    clo = np.where(flat, cen, clo)
+    chi = np.where(flat, cen, chi)
+
+  spanning = (chi != clo).any(axis=1)
+  out_tris: Dict[Tuple[int, int, int], List[np.ndarray]] = {}
+
+  # bulk path: triangles entirely inside one cell
+  interior = ~spanning
+  if interior.any():
+    keys, inverse = np.unique(clo[interior], axis=0, return_inverse=True)
+    idx = np.flatnonzero(interior)
+    for i, key in enumerate(keys):
+      out_tris.setdefault(tuple(int(v) for v in key), []).append(
+        tri[idx[inverse == i]]
+      )
+
+  # clip path: the minority of triangles that cross cell walls
+  if spanning.any():
+    span_cells: Dict[Tuple[int, int, int], List[int]] = {}
+    for t in np.flatnonzero(spanning):
+      for cx in range(clo[t, 0], chi[t, 0] + 1):
+        for cy in range(clo[t, 1], chi[t, 1] + 1):
+          for cz in range(clo[t, 2], chi[t, 2] + 1):
+            span_cells.setdefault((cx, cy, cz), []).append(t)
+    for key, tids in span_cells.items():
+      lo = grid_origin + np.asarray(key, np.float64) * chunk_size
+      hi = lo + chunk_size
+      clipped = clip_triangles_to_box(tri[tids], lo, hi)
+      if len(clipped):
+        # drop zero-area slivers (e.g. an edge lying in this cell's wall
+        # whose triangle body is in the neighbor): they render nothing
+        # and would duplicate wall geometry across cells
+        n = np.cross(
+          clipped[:, 1] - clipped[:, 0], clipped[:, 2] - clipped[:, 0]
+        )
+        area2 = np.linalg.norm(n, axis=1)
+        min_area2 = (1e-6 * float(chunk_size.max())) ** 2
+        clipped = clipped[area2 > min_area2]
+      if len(clipped):
+        out_tris.setdefault(key, []).append(clipped)
+
   out: Dict[Tuple[int, int, int], Mesh] = {}
-  keys, inverse = np.unique(cells, axis=0, return_inverse=True)
-  for i, key in enumerate(keys):
-    faces = mesh.faces[inverse == i]
-    sub = Mesh(mesh.vertices, faces).consolidate()
-    out[tuple(int(v) for v in key)] = sub
+  for key, pieces in out_tris.items():
+    tris = np.concatenate(pieces, axis=0)
+    nverts = 3 * len(tris)
+    sub = Mesh(
+      tris.reshape(-1, 3).astype(np.float32),
+      np.arange(nverts, dtype=np.uint32).reshape(-1, 3),
+    ).consolidate()
+    if len(sub.faces):
+      out[key] = sub
   return out
 
 
@@ -139,7 +279,21 @@ def process_mesh(
     positions = positions[order]
     sizes = []
     for pos in positions:
-      payload = encode_mesh(frags[tuple(int(v) for v in pos)], encoding)
+      frag = frags[tuple(int(v) for v in pos)]
+      kw = {}
+      if encoding == "draco":
+        # per-axis stored-lattice transform + 1-lattice-unit draco bins:
+        # the renderer maps stored integers onto the fragment cell, so
+        # anisotropic cells need per-axis normalization, not a scalar
+        # range (reference multires.py:144-177 contract)
+        frag = Mesh(
+          to_stored_lattice(
+            frag.vertices, grid_origin + pos * cell, cell, quantization_bits
+          ).astype(np.float32),
+          frag.faces,
+        )
+        kw = fragment_draco_settings(quantization_bits)
+      payload = encode_mesh(frag, encoding, **kw)
       frag_payloads.append(payload)
       sizes.append(len(payload))
     lod_positions.append(positions.astype(np.uint32))

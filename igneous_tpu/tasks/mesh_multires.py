@@ -5,10 +5,11 @@ Reference parity: /root/reference/igneous/tasks/mesh/multires.py
   MultiResShardedMeshMergeTask (:206-260)
   MultiResShardedFromUnshardedMeshMergeTask (:262-306)
 
-Fragment payloads are encoded via the pluggable draco hook
-(mesh_io.register_draco_codec); everything structural — LOD pyramid,
-octree fragments, z-ordering, multilod manifests, shard synthesis with
-fragment-before-manifest layout — is format-complete.
+Fragment payloads are draco bitstreams from the built-in codec
+(igneous_tpu.draco; override via mesh_io.register_draco_codec), in
+stored-lattice space per fragment cell; everything structural — LOD
+pyramid, octree fragments, z-ordering, multilod manifests, shard
+synthesis with fragment-before-manifest layout — is format-complete.
 """
 
 from __future__ import annotations

@@ -1,0 +1,223 @@
+"""Draco bitstream codec tests.
+
+No independent draco library exists in this image (DracoPy absent), so
+validation is three-legged:
+  1. byte-level golden checks of every section against the published
+     Draco 2.2 bitstream layout (hand-decoded offsets, not the codec's
+     own reader);
+  2. encoder→decoder round trips across the connectivity-width branches
+     and quantization settings;
+  3. quantization-lattice semantics (exact lattice points round-trip
+     bit-identically; settings match the multires grid-alignment solver).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from igneous_tpu import draco
+from igneous_tpu.mesh_io import Mesh
+
+
+def tri_mesh():
+  verts = np.array(
+    [[0.0, 0.0, 0.0], [1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], np.float32
+  )
+  faces = np.array([[0, 1, 2]], np.uint32)
+  return verts, faces
+
+
+def sphere(n=24, r=9.0):
+  g = np.indices((n, n, n)).astype(np.float32) - (n - 1) / 2
+  mask = (np.sqrt((g**2).sum(0)) < r).astype(np.uint8)
+  from igneous_tpu.ops.mesh import marching_tetrahedra
+
+  return marching_tetrahedra(mask, anisotropy=(4, 4, 40))
+
+
+# -- 1. golden byte-level layout ---------------------------------------------
+
+
+def test_header_bytes():
+  v, f = tri_mesh()
+  data = draco.encode(v, f, quantization_bits=11)
+  assert data[0:5] == b"DRACO"
+  assert data[5] == 2 and data[6] == 2          # bitstream 2.2
+  assert data[7] == 1                           # TRIANGULAR_MESH
+  assert data[8] == 0                           # MESH_SEQUENTIAL_ENCODING
+  assert struct.unpack_from("<H", data, 9)[0] == 0  # flags
+
+
+def test_section_layout_hand_decoded():
+  """Walk every byte of a 1-triangle stream with independent offsets."""
+  v, f = tri_mesh()
+  data = draco.encode(
+    v, f, quantization_bits=10, quantization_origin=(0, 0, 0),
+    quantization_range=1.0,
+  )
+  pos = 11
+  assert data[pos] == 1; pos += 1               # varint num_faces = 1
+  assert data[pos] == 3; pos += 1               # varint num_points = 3
+  assert data[pos] == 1; pos += 1               # plain connectivity
+  assert list(data[pos:pos + 3]) == [0, 1, 2]; pos += 3  # u8 indices
+  assert data[pos] == 1; pos += 1               # num_attributes_decoders
+  assert data[pos] == 1; pos += 1               # varint num_attributes
+  assert data[pos] == 0; pos += 1               # POSITION
+  assert data[pos] == 9; pos += 1               # DT_FLOAT32
+  assert data[pos] == 3; pos += 1               # components
+  assert data[pos] == 0; pos += 1               # normalized
+  assert data[pos] == 0; pos += 1               # varint unique_id
+  assert data[pos] == 2; pos += 1               # SEQ_QUANTIZATION
+  assert struct.unpack_from("<b", data, pos)[0] == -2; pos += 1  # PRED_NONE
+  assert data[pos] == 0; pos += 1               # uncompressed
+  assert data[pos] == 4; pos += 1               # 4 bytes/value
+  sym = np.frombuffer(data, "<u4", 9, pos); pos += 36
+  # zigzag symbols of quantized values: q=(0,0,0),(1023,0,0),(0,1023,0)
+  assert list(sym) == [0, 0, 0, 2046, 0, 0, 0, 2046, 0]
+  mins = np.frombuffer(data, "<f4", 3, pos); pos += 12
+  assert np.allclose(mins, 0)
+  assert struct.unpack_from("<f", data, pos)[0] == 1.0; pos += 4
+  assert data[pos] == 10; pos += 1              # quantization_bits
+  assert pos == len(data)                       # nothing else in stream
+
+
+@pytest.mark.parametrize("npoints,width", [
+  (200, 1), (60000, 2), (70000, "varint"),
+])
+def test_connectivity_width_branches(npoints, width):
+  rng = np.random.default_rng(npoints)
+  verts = rng.random((npoints, 3)).astype(np.float32) * 100
+  faces = rng.integers(0, npoints, (npoints // 2, 3)).astype(np.uint32)
+  data = draco.encode(verts, faces, quantization_bits=14)
+  dec = draco.decode(data)
+  assert np.array_equal(dec.faces, faces)
+  # confirm the width branch actually taken by hand-reading the stream
+  pos = 11
+  nf, pos = draco._read_varint(data, pos)
+  npts, pos = draco._read_varint(data, pos)
+  assert (nf, npts) == (len(faces), npoints)
+  pos += 1  # method
+  if width == 1:
+    assert np.array_equal(
+      np.frombuffer(data, "<u1", nf * 3, pos), faces.reshape(-1)
+    )
+  elif width == 2:
+    assert np.array_equal(
+      np.frombuffer(data, "<u2", nf * 3, pos), faces.reshape(-1)
+    )
+  else:
+    first, _ = draco._read_varint(data, pos)
+    assert first == int(faces[0, 0])
+
+
+# -- 2. round trips -----------------------------------------------------------
+
+
+def test_roundtrip_sphere_accuracy():
+  v, f = sphere()
+  ext = float((v.max(0) - v.min(0)).max())
+  for bits in (10, 14, 16):
+    data = draco.encode(v, f, quantization_bits=bits)
+    dec = draco.decode(data)
+    assert np.array_equal(dec.faces, f)
+    step = ext / ((1 << bits) - 1)
+    # step/2 plus float32 rounding headroom (origin/range are stored f32)
+    assert np.abs(dec.vertices - v).max() <= step / 2 * (1 + 1e-3) + 1e-4
+    assert dec.quantization_bits == bits
+
+
+def test_roundtrip_via_mesh_io_hook():
+  from igneous_tpu.mesh_io import decode_mesh, encode_mesh
+
+  v, f = sphere()
+  m = Mesh(v, f)
+  out = decode_mesh(encode_mesh(m, "draco", quantization_bits=16), "draco")
+  assert np.array_equal(out.faces, m.faces)
+
+
+def test_empty_and_degenerate():
+  data = draco.encode(np.zeros((0, 3), np.float32), np.zeros((0, 3), np.uint32))
+  dec = draco.decode(data)
+  assert len(dec.vertices) == 0 and len(dec.faces) == 0
+  # single point: zero extent needs a synthetic positive range
+  data = draco.encode(np.ones((1, 3), np.float32), np.zeros((0, 3), np.uint32))
+  dec = draco.decode(data)
+  assert np.allclose(dec.vertices, 1.0, atol=1e-4)
+
+
+def test_unsupported_features_fail_loudly():
+  v, f = tri_mesh()
+  data = bytearray(draco.encode(v, f))
+  data[8] = 1  # claim edgebreaker
+  with pytest.raises(NotImplementedError, match="edgebreaker"):
+    draco.decode(bytes(data))
+  with pytest.raises(ValueError, match="magic"):
+    draco.decode(b"NOTDRACO" + bytes(16))
+
+
+# -- 3. quantization-lattice semantics ---------------------------------------
+
+
+def test_lattice_points_roundtrip_exact():
+  """Vertices on the quantization lattice must survive bit-identically —
+  this is what makes adjacent multires fragments stitch."""
+  bits = 12
+  origin = np.array([10.0, 20.0, 30.0], np.float32)
+  qrange = 512.0
+  step = qrange / ((1 << bits) - 1)
+  rng = np.random.default_rng(7)
+  lattice = rng.integers(0, 1 << bits, (500, 3)).astype(np.float64)
+  verts = (origin + lattice * step).astype(np.float32)
+  faces = rng.integers(0, 500, (300, 3)).astype(np.uint32)
+  data = draco.encode(
+    verts, faces, quantization_bits=bits, quantization_origin=origin,
+    quantization_range=qrange,
+  )
+  dec = draco.decode(data)
+  assert np.array_equal(dec.quantized, lattice.astype(np.uint32))
+  assert dec.quantization_range == pytest.approx(qrange)
+  assert np.allclose(dec.quantization_origin, origin)
+
+
+def test_multires_fragments_are_draco():
+  """process_mesh fragments parse as draco: stored-lattice coordinates in
+  [0, 2^16] carried with 1-unit bins at 17 draco bits."""
+  from igneous_tpu.mesh_multires import process_mesh
+  import struct as _s
+
+  v, f = sphere()
+  manifest, frags = process_mesh(Mesh(v, f), num_lods=2, encoding="draco")
+  # walk manifest for fragment sizes
+  (num_lods,) = _s.unpack_from("<I", manifest, 24)
+  pos = 28 + 4 * num_lods + 12 * num_lods
+  nfrags = np.frombuffer(manifest, "<u4", num_lods, pos)
+  pos += 4 * num_lods
+  sizes = []
+  for n in nfrags:
+    pos += 12 * int(n)
+    sizes.extend(np.frombuffer(manifest, "<u4", int(n), pos))
+    pos += 4 * int(n)
+  off = 0
+  assert sum(int(s) for s in sizes) == len(frags)
+  for s in sizes:
+    dec = draco.decode(frags[off:off + int(s)])
+    off += int(s)
+    assert dec.quantization_bits == 17
+    assert dec.quantization_range == (1 << 17) - 1  # bin size == 1
+    assert dec.quantized.max() <= (1 << 16)  # lattice bounded by cell
+    assert len(dec.faces) > 0
+
+
+def test_varint_array_roundtrip():
+  rng = np.random.default_rng(3)
+  vals = np.concatenate([
+    rng.integers(0, 1 << 7, 100), rng.integers(0, 1 << 14, 100),
+    rng.integers(0, 1 << 21, 100), rng.integers(0, 1 << 32, 100),
+  ]).astype(np.uint64)
+  blob = draco._varint_array(vals)
+  # cross-check against the scalar encoder
+  assert blob == b"".join(draco._varint(int(v)) for v in vals)
+  out, pos = draco._read_varint_array(blob + b"\xff", 0, len(vals))
+  assert np.array_equal(out, vals.astype(np.uint32))
+  assert pos == len(blob)

@@ -89,10 +89,15 @@ def test_concatenate_consolidate():
   assert len(c.faces) == 2
 
 
-def test_draco_gated():
+def test_draco_default_codec():
   m = Mesh([[0, 0, 0], [1, 0, 0], [0, 1, 0]], [[0, 1, 2]])
-  with pytest.raises(NotImplementedError):
-    encode_mesh(m, "draco")
+  data = encode_mesh(m, "draco", quantization_bits=16)
+  assert data[:5] == b"DRACO"
+  from igneous_tpu.mesh_io import decode_mesh
+
+  out = decode_mesh(data, "draco")
+  assert np.array_equal(out.faces, m.faces)
+  assert np.allclose(out.vertices, m.vertices, atol=1.0 / 65535 + 1e-6)
 
 
 def test_simplify_reduces():

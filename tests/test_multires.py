@@ -1,37 +1,27 @@
-"""Multires mesh format tests with a stand-in draco codec.
+"""Multires mesh format tests over the real built-in draco codec.
 
 The structural pipeline (LOD pyramid, octree fragments, z-order,
 manifests, fragment-before-manifest shard layout) is exercised end to end;
-the stand-in codec stores Precomputed bytes under the draco hook, exactly
-as a real draco codec would plug in.
+fragment payloads are actual draco bitstreams (igneous_tpu.draco).
 """
 
 import struct
 
 import numpy as np
-import pytest
 
 from igneous_tpu import mesh_io
 from igneous_tpu import task_creation as tc
 from igneous_tpu.mesh_io import Mesh
 from igneous_tpu.mesh_multires import (
-  draco_quantization_settings,
+  clip_triangles_to_box,
+  fragment_draco_settings,
+  octree_fragments,
   process_mesh,
+  to_stored_lattice,
 )
-from igneous_tpu.lib import Bbox
 from igneous_tpu.ops.mesh import marching_tetrahedra
 from igneous_tpu.queues import LocalTaskQueue
 from igneous_tpu.volume import Volume
-
-
-@pytest.fixture(autouse=True)
-def standin_draco():
-  mesh_io.register_draco_codec(
-    lambda mesh, **kw: b"DRC0" + mesh.to_precomputed(),
-    lambda data: Mesh.from_precomputed(data[4:]),
-  )
-  yield
-  mesh_io._DRACO_CODEC = None
 
 
 def run(tasks):
@@ -64,6 +54,13 @@ def parse_manifest(data: bytes):
   return chunk_shape, grid_origin, num_lods, lod_scales, lods
 
 
+def signed_volume(verts, faces):
+  p = verts[faces.astype(np.int64)]
+  return float(
+    np.sum(np.einsum("ij,ij->i", p[:, 0], np.cross(p[:, 1], p[:, 2]))) / 6
+  )
+
+
 def test_process_mesh_manifest_and_fragments():
   mesh = sphere_mesh()
   manifest, frags = process_mesh(mesh, num_lods=3)
@@ -73,31 +70,77 @@ def test_process_mesh_manifest_and_fragments():
   # fragment sizes in the manifest tile the payload exactly
   total = sum(int(s) for _, sizes in lods for s in sizes)
   assert total == len(frags)
-  # every fragment decodes through the codec hook and geometry survives
+  # every lod-0 fragment decodes as draco in stored-lattice space; map it
+  # back to model space through the manifest cell (what the renderer does)
   off = 0
   vol_sum = 0.0
+  bits = 16
   for positions, sizes in lods[:1]:  # lod 0 = full resolution
-    for s in sizes:
+    for pos, s in zip(positions, sizes):
       m = mesh_io.decode_mesh(frags[off : off + int(s)], "draco")
       off += int(s)
-      p = m.vertices[m.faces.astype(np.int64)]
-      vol_sum += float(
-        np.sum(np.einsum("ij,ij->i", p[:, 0], np.cross(p[:, 1], p[:, 2]))) / 6
-      )
-  full = mesh.vertices[mesh.faces.astype(np.int64)]
-  full_vol = float(
-    np.sum(np.einsum("ij,ij->i", full[:, 0], np.cross(full[:, 1], full[:, 2]))) / 6
-  )
-  # centroid-assigned fragments preserve total signed volume of lod 0
+      lattice = m.vertices.astype(np.float64)
+      assert lattice.min() >= -1e-3
+      assert lattice.max() <= (1 << bits) + 1e-3
+      model = grid_origin + (pos + lattice / (1 << bits)) * chunk_shape
+      vol_sum += signed_volume(model.astype(np.float32), m.faces)
+  full_vol = signed_volume(mesh.vertices, mesh.faces)
+  # wall-clipped fragments preserve total signed volume of lod 0 up to
+  # quantization (bin size = cell/2^16)
   assert abs(vol_sum - full_vol) / abs(full_vol) < 1e-3
 
 
-def test_draco_quantization_settings():
-  bbox = Bbox((0, 0, 0), (1024, 1024, 512))
-  s = draco_quantization_settings((256, 256, 256), (0, 0, 0), bbox)
-  assert s["quantization_bits"] == 16
-  assert s["quantization_range"] >= 1024
-  assert s["steps_per_chunk"] & (s["steps_per_chunk"] - 1) == 0  # pow2
+def test_fragment_draco_settings():
+  s = fragment_draco_settings(16)
+  assert s["quantization_bits"] == 17
+  # bin size exactly one lattice unit: range/(2^bits-1) == 1
+  assert s["quantization_range"] == (1 << 17) - 1
+  lattice = to_stored_lattice(
+    np.array([[10.0, 20.0, 30.0]]), np.array([10.0, 20.0, 30.0]),
+    np.array([40.0, 20.0, 10.0]), 16,
+  )
+  assert np.allclose(lattice, 0)
+
+
+def test_clip_no_spike_on_near_parallel_edge():
+  """Regression: an edge straddling the inside tolerance must not
+  extrapolate an intersection outside the box (t must be clamped)."""
+  tri = np.array([[
+    [0.5, 0.5, 1.0 + 0.9e-9],
+    [4.5, 0.5, 1.0 + 1.1e-9],
+    [0.5, 0.6, 0.5],
+  ]])
+  out = clip_triangles_to_box(tri, np.zeros(3), np.ones(3))
+  assert len(out)
+  assert out.reshape(-1, 3).max() <= 1.0 + 1e-6
+
+
+def test_wall_triangle_assigned_once():
+  """Regression: a triangle lying exactly in a cell-wall plane must land
+  in exactly one cell, not both neighbors."""
+  m = Mesh(
+    np.array([[1.0, 0.2, 0.2], [1.0, 0.8, 0.2], [1.0, 0.2, 0.8]], np.float32),
+    np.array([[0, 1, 2]], np.uint32),
+  )
+  frags = octree_fragments(m, np.ones(3), np.zeros(3))
+  total = sum(len(f.faces) for f in frags.values())
+  assert total == 1
+
+
+def test_octree_fragments_conserve_clipped_volume():
+  """Spanning triangles are retriangulated at walls: per-fragment
+  vertices stay in-cell and total volume is preserved exactly."""
+  mesh = sphere_mesh()
+  cell = (mesh.vertices.max(0) - mesh.vertices.min(0)) / 3.0
+  origin = mesh.vertices.min(0)
+  frags = octree_fragments(mesh, cell, origin)
+  vol = sum(signed_volume(f.vertices, f.faces) for f in frags.values())
+  full = signed_volume(mesh.vertices, mesh.faces)
+  assert abs(vol - full) / abs(full) < 1e-5
+  for key, f in frags.items():
+    lo = origin + np.asarray(key) * cell
+    hi = lo + cell
+    assert (f.vertices >= lo - 1e-3).all() and (f.vertices <= hi + 1e-3).all()
 
 
 def make_forged_layer(tmp_path, sharded):
