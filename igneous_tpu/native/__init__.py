@@ -36,7 +36,10 @@ def _build_lib(name: str) -> Optional[str]:
     return out
   os.makedirs(_BUILD, exist_ok=True)
   tmp = out + f".tmp{os.getpid()}"
-  cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+  cmd = [
+    "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+    "-o", tmp, src,
+  ]
   try:
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
   except Exception:
@@ -65,6 +68,23 @@ def load(name: str) -> Optional[ctypes.CDLL]:
       return None
     _libs[name] = lib
     return lib
+
+
+def edt_lib() -> Optional[ctypes.CDLL]:
+  lib = load("edt")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    for fn in (lib.edt_ml_sq32, lib.edt_ml_sq64):
+      fn.restype = None
+      fn.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_int,
+      ]
+    lib._configured = True
+  return lib
 
 
 def cseg_lib() -> Optional[ctypes.CDLL]:

@@ -35,15 +35,45 @@ def scipy_multilabel_edt(labels, anisotropy):
   return out
 
 
+def _require_native(backend):
+  """'native' must actually test the C++ lib — silent numpy fallback would
+  report green coverage for code that never ran."""
+  if backend == "native":
+    from igneous_tpu.native import edt_lib
+
+    if edt_lib() is None:
+      pytest.fail("native EDT lib failed to build (toolchain present?)")
+
+
+@pytest.mark.parametrize("backend", ["device", "native", "numpy"])
 @pytest.mark.parametrize("anisotropy", [(1, 1, 1), (4, 4, 40)])
-def test_edt_multilabel_vs_scipy(rng, anisotropy):
+def test_edt_multilabel_vs_scipy(rng, anisotropy, backend, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_EDT_BACKEND", backend)
+  _require_native(backend)
   lab = (rng.integers(0, 3, (22, 18, 14)) * 9).astype(np.uint64)
   got = edt(lab, anisotropy)
   exp = scipy_multilabel_edt(lab, anisotropy)
   assert np.allclose(got, exp, atol=1e-3)
 
 
-def test_edt_black_border():
+@pytest.mark.parametrize("backend", ["device", "native", "numpy"])
+def test_edt_backends_agree_on_adversarial_runs(rng, backend, monkeypatch):
+  """Alternating thin runs + solid regions stress envelope resets."""
+  monkeypatch.setenv("IGNEOUS_EDT_BACKEND", backend)
+  _require_native(backend)
+  lab = np.zeros((40, 17, 13), np.uint32)
+  lab[::2] = 5          # 1-thick x slabs
+  lab[:, :8] += 7       # label change wall mid-y
+  lab[10:30, 4:12, 3:9] = 11
+  got = edt(lab, (2, 3, 5))
+  exp = scipy_multilabel_edt(lab, (2, 3, 5))
+  assert np.allclose(got, exp, atol=1e-3)
+
+
+@pytest.mark.parametrize("backend", ["device", "native", "numpy"])
+def test_edt_black_border(backend, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_EDT_BACKEND", backend)
+  _require_native(backend)
   mask = np.ones((10, 10, 10), np.uint8)
   d = edt(mask, (1, 1, 1), black_border=True)
   assert d[0, 0, 0] == 1.0
