@@ -1,20 +1,28 @@
 """Chunk encodings for Precomputed volumes.
 
 Byte-format parity targets (so Neuroglancer / the reference stack can read
-outputs): ``raw`` and ``compressed_segmentation``. The reference gets these
-from cloud-volume (see /root/reference/igneous/task_creation/common.py:215-236
-for the encodings it routes).
+outputs): ``raw``, ``compressed_segmentation``, ``jpeg``, ``png``. The
+reference gets these from cloud-volume (see
+/root/reference/igneous/task_creation/common.py:215-236 for the encodings
+it routes); real EM image datasets are predominantly jpeg.
 
-Layout convention: in-memory chunks are numpy arrays with shape (x, y, z, c).
-``raw`` stores them Fortran-ordered, i.e. x varies fastest in the byte stream
-and channel slowest — exactly the Precomputed "raw" spec.
+Layout conventions: in-memory chunks are numpy arrays with shape
+(x, y, z, c). ``raw`` stores them Fortran-ordered, i.e. x varies fastest
+in the byte stream and channel slowest — exactly the Precomputed "raw"
+spec. ``jpeg``/``png`` store one 2D image of width x and height y*z (the
+z slices stacked vertically), grayscale for 1 channel and RGB(A) for 3(4)
+— the Precomputed image-codec layout Neuroglancer decodes.
 """
 
 from __future__ import annotations
 
+import io
+
 import numpy as np
 
 from .cseg import compress as cseg_compress, decompress as cseg_decompress
+
+JPEG_DEFAULT_QUALITY = 85
 
 
 def encode_raw(img: np.ndarray) -> bytes:
@@ -26,13 +34,89 @@ def decode_raw(data: bytes, shape, dtype) -> np.ndarray:
   return arr.reshape(shape, order="F")
 
 
-def encode(img: np.ndarray, encoding: str, block_size=(8, 8, 8)) -> bytes:
+def _to_image_plane(img: np.ndarray) -> np.ndarray:
+  """(x, y, z, c) -> stacked 2D plane (y*z, x, c): z slices vertically."""
+  x, y, z, c = img.shape
+  return np.ascontiguousarray(img.transpose(2, 1, 0, 3)).reshape(z * y, x, c)
+
+
+def _from_image_plane(plane: np.ndarray, shape) -> np.ndarray:
+  x, y, z, c = shape
+  if plane.ndim == 2:
+    plane = plane[..., np.newaxis]
+  if plane.shape[0] != z * y or plane.shape[1] != x:
+    raise ValueError(
+      f"decoded image plane {plane.shape} does not match chunk {shape}"
+    )
+  return np.asfortranarray(plane.reshape(z, y, x, c).transpose(2, 1, 0, 3))
+
+
+def encode_jpeg(img: np.ndarray, quality: int = JPEG_DEFAULT_QUALITY) -> bytes:
+  from PIL import Image
+
+  if img.dtype != np.uint8:
+    raise ValueError(f"jpeg requires uint8 chunks, got {img.dtype}")
+  if img.shape[3] not in (1, 3):
+    raise ValueError(f"jpeg supports 1 or 3 channels, got {img.shape[3]}")
+  plane = _to_image_plane(img)
+  pil = Image.fromarray(plane[..., 0] if plane.shape[2] == 1 else plane)
+  bio = io.BytesIO()
+  pil.save(bio, format="JPEG", quality=int(quality))
+  return bio.getvalue()
+
+
+def decode_jpeg(data: bytes, shape, dtype) -> np.ndarray:
+  from PIL import Image
+
+  plane = np.asarray(Image.open(io.BytesIO(data)))
+  return _from_image_plane(plane, shape).astype(dtype, copy=False)
+
+
+def encode_png(img: np.ndarray, compress_level: int = 6) -> bytes:
+  from PIL import Image
+
+  c = img.shape[3]
+  if img.dtype == np.uint8:
+    if c not in (1, 3, 4):
+      raise ValueError(f"png supports 1/3/4 uint8 channels, got {c}")
+    plane = _to_image_plane(img)
+    pil = Image.fromarray(plane[..., 0] if c == 1 else plane)
+  elif img.dtype == np.uint16:
+    if c != 1:
+      raise ValueError(f"png uint16 supports 1 channel, got {c}")
+    pil = Image.fromarray(_to_image_plane(img)[..., 0])  # mode I;16
+  else:
+    raise ValueError(f"png requires uint8/uint16 chunks, got {img.dtype}")
+  bio = io.BytesIO()
+  pil.save(bio, format="PNG", compress_level=int(compress_level))
+  return bio.getvalue()
+
+
+def decode_png(data: bytes, shape, dtype) -> np.ndarray:
+  from PIL import Image
+
+  pil = Image.open(io.BytesIO(data))
+  if np.dtype(dtype) == np.uint16 and pil.mode == "I":
+    plane = np.asarray(pil).astype(np.uint16)
+  else:
+    plane = np.asarray(pil)
+  return _from_image_plane(plane, shape).astype(dtype, copy=False)
+
+
+def encode(
+  img: np.ndarray, encoding: str, block_size=(8, 8, 8),
+  jpeg_quality: int = JPEG_DEFAULT_QUALITY,
+) -> bytes:
   if img.ndim == 3:
     img = img[..., np.newaxis]
   if encoding == "raw":
     return encode_raw(img)
   if encoding == "compressed_segmentation":
     return cseg_compress(img, block_size=block_size)
+  if encoding == "jpeg":
+    return encode_jpeg(img, quality=jpeg_quality)
+  if encoding == "png":
+    return encode_png(img)
   raise NotImplementedError(f"Encoding not supported: {encoding}")
 
 
@@ -44,4 +128,8 @@ def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8)) -> np
     return decode_raw(data, shape, dtype)
   if encoding == "compressed_segmentation":
     return cseg_decompress(data, shape, dtype, block_size=block_size)
+  if encoding == "jpeg":
+    return decode_jpeg(data, shape, dtype)
+  if encoding == "png":
+    return decode_png(data, shape, dtype)
   raise NotImplementedError(f"Encoding not supported: {encoding}")

@@ -186,7 +186,9 @@ def _envelope_pass(val: jnp.ndarray, lab: jnp.ndarray, w: float) -> jnp.ndarray:
 
   xs_q = (bases, chg.T, jnp.broadcast_to(qs[:, None], (n, B)))
   _, outs = jax.lax.scan(query, jnp.zeros(B, jnp.int32), xs_q)
-  out = outs.T * w2  # (B, n), rescale normalized heights
+  # threshold the INF sentinel BEFORE the w2 rescale (matching the numpy
+  # twin): for w < 1 a scaled sentinel would drop below INF/2 and leak
+  out = jnp.where(outs.T >= INF / 2, INF, outs.T * w2)
   return jnp.where(out >= INF / 2, INF, out)
 
 
@@ -278,7 +280,7 @@ def _envelope_pass_np(val: np.ndarray, lab: np.ndarray, w: float) -> np.ndarray:
   k = np.full(B, -1, np.int64)
   base = np.zeros(B, np.int64)
   rows = np.arange(B)
-  bases = np.empty((n, B), np.int64)
+  bases = np.empty((n, B), np.int32)  # S < 2^31 always
 
   def intersect(fq, q, hk, vk):
     den = 2.0 * (q - vk)
@@ -333,6 +335,11 @@ def _envelope_pass_np(val: np.ndarray, lab: np.ndarray, w: float) -> np.ndarray:
   return np.ascontiguousarray(res.T)
 
 
+# line-batch size for the numpy fallback: bounds transient stack memory
+# (the (S, B) stacks would be ~GBs at 512^3 if all lines ran at once)
+_NP_LINE_BATCH = 1 << 14
+
+
 def _axis_pass_np(
   val: np.ndarray, lab: np.ndarray, w: float, first: bool
 ) -> np.ndarray:
@@ -343,7 +350,11 @@ def _axis_pass_np(
   l = np.ascontiguousarray(lab).reshape(B, n)
   out = _edge_term_np(l, w)
   if not first:
-    out = np.minimum(out, _envelope_pass_np(v, l, w))
+    for lo in range(0, B, _NP_LINE_BATCH):
+      hi = min(B, lo + _NP_LINE_BATCH)
+      out[lo:hi] = np.minimum(
+        out[lo:hi], _envelope_pass_np(v[lo:hi], l[lo:hi], w)
+      )
   return out.reshape(*lead, n)
 
 

@@ -1,0 +1,133 @@
+"""jpeg/png chunk codecs: Precomputed stacked-slice layout + e2e transfer.
+
+Independence check: the stacked 2D plane (width x, height y*z) is built
+and parsed with PIL directly in the tests — a separate code path from
+codecs.py's own transpose helpers — so a layout bug in the codec cannot
+cancel itself out.
+"""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from igneous_tpu import codecs
+from igneous_tpu import task_creation as tc
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.volume import Volume
+
+
+def smooth_volume(shape, channels=1):
+  """Smooth gradient image — stresses lossy codecs without jpeg blocking
+  artifacts dominating (mirrors the reference transfer suite's fixture)."""
+  x, y, z = shape
+  gx, gy, gz = np.meshgrid(
+    np.linspace(0, 1, x), np.linspace(0, 1, y), np.linspace(0, 1, z),
+    indexing="ij",
+  )
+  base = (96 + 64 * np.sin(6 * gx) * np.cos(5 * gy) + 48 * gz)
+  out = np.stack(
+    [np.clip(base + 10 * i, 0, 255) for i in range(channels)], axis=-1
+  )
+  return out.astype(np.uint8)
+
+
+def test_png_roundtrip_exact_uint8():
+  img = smooth_volume((17, 13, 5))
+  data = codecs.encode(img, "png")
+  out = codecs.decode(data, "png", img.shape, np.uint8)
+  assert np.array_equal(out, img)
+
+
+def test_png_roundtrip_exact_rgb():
+  img = smooth_volume((9, 8, 3), channels=3)
+  data = codecs.encode(img, "png")
+  out = codecs.decode(data, "png", img.shape, np.uint8)
+  assert np.array_equal(out, img)
+
+
+def test_png_roundtrip_exact_uint16():
+  rng = np.random.default_rng(0)
+  img = rng.integers(0, 2**16, (11, 7, 4, 1)).astype(np.uint16)
+  data = codecs.encode(img, "png")
+  out = codecs.decode(data, "png", img.shape, np.uint16)
+  assert np.array_equal(out, img)
+
+
+def test_jpeg_roundtrip_tolerance():
+  img = smooth_volume((32, 24, 6))
+  data = codecs.encode(img, "jpeg")
+  out = codecs.decode(data, "jpeg", img.shape, np.uint8)
+  err = np.abs(out.astype(int) - img.astype(int))
+  assert err.mean() < 2.0 and err.max() < 32
+
+
+def test_layout_matches_independent_pil_encoder():
+  """A PNG built directly with PIL in the documented stacked layout must
+  decode to the original chunk through codecs.decode."""
+  img = smooth_volume((10, 6, 4))
+  x, y, z, _ = img.shape
+  plane = np.zeros((z * y, x), np.uint8)
+  for zi in range(z):
+    for yi in range(y):
+      for xi in range(x):
+        plane[zi * y + yi, xi] = img[xi, yi, zi, 0]
+  bio = io.BytesIO()
+  Image.fromarray(plane).save(bio, format="PNG")
+  out = codecs.decode(bio.getvalue(), "png", img.shape, np.uint8)
+  assert np.array_equal(out, img)
+
+
+def test_layout_parses_with_independent_pil_decoder():
+  img = smooth_volume((10, 6, 4))
+  data = codecs.encode(img, "png")
+  plane = np.asarray(Image.open(io.BytesIO(data)))
+  x, y, z, _ = img.shape
+  assert plane.shape == (z * y, x)
+  assert plane[2 * y + 3, 7] == img[7, 3, 2, 0]
+
+
+def test_jpeg_rejects_bad_dtype_and_channels():
+  with pytest.raises(ValueError, match="uint8"):
+    codecs.encode(np.zeros((4, 4, 4, 1), np.uint16), "jpeg")
+  with pytest.raises(ValueError, match="channels"):
+    codecs.encode(np.zeros((4, 4, 4, 2), np.uint8), "jpeg")
+
+
+def run(tasks):
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+
+
+def test_raw_to_jpeg_transfer_e2e(tmp_path):
+  """VERDICT item 5 'done' bar: a raw volume transfers into a jpeg-encoded
+  destination and reads back within jpeg tolerance."""
+  img = smooth_volume((128, 96, 32))[..., 0]
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dest"
+  Volume.from_numpy(img, src, resolution=(8, 8, 40), chunk_size=(64, 64, 32))
+  run(tc.create_transfer_tasks(
+    src, dest, chunk_size=(64, 64, 32), encoding="jpeg", compress=None,
+  ))
+  vol = Volume(dest)
+  assert vol.meta.encoding(0) == "jpeg"
+  out = vol.download(vol.bounds)[..., 0]
+  err = np.abs(out.astype(int) - img.astype(int))
+  assert err.mean() < 2.0
+  # the stored chunk really is a JFIF/JPEG stream
+  chunks = [k for k in vol.cf.list("8_8_40/")]
+  raw = vol.cf.get(chunks[0])
+  assert raw[:2] == b"\xff\xd8"  # JPEG SOI marker
+
+
+def test_png_create_and_downsample_e2e(tmp_path):
+  img = smooth_volume((64, 64, 16))[..., 0]
+  path = f"file://{tmp_path}/png"
+  Volume.from_numpy(
+    img, path, resolution=(4, 4, 40), chunk_size=(32, 32, 16),
+    encoding="png",
+  )
+  run(tc.create_downsampling_tasks(path, mip=0, num_mips=1, compress=None))
+  vol = Volume(path, mip=1)
+  assert vol.meta.encoding(1) == "png"
+  assert vol.download(vol.bounds).shape[0] == 32
