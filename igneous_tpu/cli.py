@@ -581,19 +581,22 @@ def skeleton():
 @click.option("--scale", default=4.0, show_default=True, help="TEASAR scale")
 @click.option("--const", default=500.0, show_default=True, help="TEASAR const (nm)")
 @click.option("--dust-threshold", default=1000, show_default=True)
+@click.option("--dust-global/--no-dust-global", default=False, show_default=True,
+              help="dust by global voxel counts (requires a voxels census)")
 @click.option("--fill-missing", is_flag=True)
 @click.option("--sharded", is_flag=True)
 @click.option("--skel-dir", default=None)
 @click.option("--fix-borders/--no-fix-borders", default=True, show_default=True)
 @click.pass_context
 def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
-                   fill_missing, sharded, skel_dir, fix_borders):
+                   dust_global, fill_missing, sharded, skel_dir, fix_borders):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_skeletonizing_tasks(
     path, mip=mip, shape=shape,
     teasar_params={"scale": scale, "const": const},
-    dust_threshold=dust_threshold, fill_missing=fill_missing,
+    dust_threshold=dust_threshold, dust_global=dust_global,
+    fill_missing=fill_missing,
     sharded=sharded, skel_dir=skel_dir, fix_borders=fix_borders,
   ), ctx.obj["parallel"])
 

@@ -28,25 +28,53 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _neighbor_min(L: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-  """One 6-connected min-propagation step. L, labels: (z, y, x)."""
+def neighbor_offsets(connectivity: int):
+  """cc3d-style neighborhoods: 6 = faces, 18 = +edges, 26 = +corners."""
+  if connectivity not in (6, 18, 26):
+    raise ValueError(f"connectivity must be 6, 18 or 26: {connectivity}")
+  offs = []
+  for dz in (-1, 0, 1):
+    for dy in (-1, 0, 1):
+      for dx in (-1, 0, 1):
+        if (dx, dy, dz) == (0, 0, 0):
+          continue
+        degree = abs(dx) + abs(dy) + abs(dz)
+        if connectivity == 6 and degree > 1:
+          continue
+        if connectivity == 18 and degree > 2:
+          continue
+        offs.append((dz, dy, dx))
+  return offs
+
+
+def _neighbor_min(
+  L: jnp.ndarray, labels: jnp.ndarray, connectivity: int = 6
+) -> jnp.ndarray:
+  """One min-propagation step over the connectivity neighborhood.
+  L, labels: (z, y, x)."""
   big = jnp.iinfo(jnp.int32).max
 
-  def shifted_min(L, axis, direction):
-    # neighbor along +axis or -axis; out-of-range neighbors are background
-    nb_L = jnp.roll(L, direction, axis=axis)
-    nb_lab = jnp.roll(labels, direction, axis=axis)
-    # kill the wrapped plane
-    size = labels.shape[axis]
-    coord = jax.lax.broadcasted_iota(jnp.int32, labels.shape, axis)
-    valid = coord != (0 if direction == 1 else size - 1)
+  def shifted_min(L, off):
+    # neighbor at -off (roll by +off moves neighbor data onto the voxel);
+    # wrapped planes are invalidated per axis
+    nb_L = L
+    nb_lab = labels
+    valid = None
+    for axis, d in enumerate(off):
+      if d == 0:
+        continue
+      nb_L = jnp.roll(nb_L, d, axis=axis)
+      nb_lab = jnp.roll(nb_lab, d, axis=axis)
+      size = labels.shape[axis]
+      coord = jax.lax.broadcasted_iota(jnp.int32, labels.shape, axis)
+      v = coord != (0 if d == 1 else size - 1)
+      valid = v if valid is None else (valid & v)
     same = valid & (nb_lab == labels)
     return jnp.where(same, nb_L, big)
 
   m = L
-  for axis in (0, 1, 2):
-    for direction in (1, -1):
-      m = jnp.minimum(m, shifted_min(L, axis, direction))
+  for off in neighbor_offsets(connectivity):
+    m = jnp.minimum(m, shifted_min(L, off))
   return m
 
 
@@ -57,8 +85,8 @@ def _compress(L: jnp.ndarray, iters: int = 2) -> jnp.ndarray:
   return flat.reshape(L.shape)
 
 
-@jax.jit
-def _ccl_kernel(labels: jnp.ndarray) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("connectivity",))
+def _ccl_kernel(labels: jnp.ndarray, connectivity: int = 6) -> jnp.ndarray:
   """labels: (z, y, x) int32 (0 = background) → component roots (flat
   min-index per component; background stays huge sentinel)."""
   n = labels.size
@@ -73,7 +101,7 @@ def _ccl_kernel(labels: jnp.ndarray) -> jnp.ndarray:
 
   def body(state):
     L, _ = state
-    Lp = _neighbor_min(L, labels)
+    Lp = _neighbor_min(L, labels, connectivity)
     Lp = jnp.where(fg, jnp.minimum(L, Lp), L)
     Lp = _compress(Lp, iters=2)
     changed = jnp.any(Lp != L)
@@ -92,8 +120,6 @@ def connected_components(
   voxel in Fortran (x-fastest) scan order; 0 stays background. Deterministic
   across recomputation.
   """
-  if connectivity != 6:
-    raise NotImplementedError("only 6-connectivity is implemented")
   if labels.ndim != 3:
     raise ValueError("labels must be (x, y, z)")
 
@@ -106,7 +132,9 @@ def connected_components(
 
   # device layout (z, y, x): x innermost on lanes
   dev = jnp.asarray(np.ascontiguousarray(lab32.transpose(2, 1, 0)))
-  roots = np.asarray(_ccl_kernel(dev)).transpose(2, 1, 0)  # (x, y, z)
+  roots = np.asarray(
+    _ccl_kernel(dev, connectivity)
+  ).transpose(2, 1, 0)  # (x, y, z)
 
   big = np.iinfo(np.int32).max
   fg = roots != big
@@ -185,3 +213,86 @@ class DisjointSet:
         counter += 1
       out[x] = next_id[r]
     return out, counter - 1
+
+
+# ---------------------------------------------------------------------------
+# cc3d feature parity: voxel connectivity graph + statistics
+
+
+def graph_bit(off) -> int:
+  """Bit index for neighbor offset (dx, dy, dz) in the voxel connectivity
+  graph: linear index over (dz, dy, dx) in {-1,0,1}^3 with the center
+  skipped. Documented layout — consumers (skeletonize voxel_graph) use
+  these helpers rather than assuming cc3d's internal ordering."""
+  dx, dy, dz = off
+  lin = (dz + 1) * 9 + (dy + 1) * 3 + (dx + 1)
+  if lin == 13:
+    raise ValueError("no bit for the center offset")
+  return lin if lin < 13 else lin - 1
+
+
+def voxel_connectivity_graph(
+  labels: np.ndarray, connectivity: int = 26
+) -> np.ndarray:
+  """Per-voxel uint32 bitfield: bit set when the neighbor in that
+  direction is in-bounds and holds the same nonzero label.
+
+  Capability parity with cc3d.voxel_connectivity_graph (used by the
+  reference's graphene autapse fix, /root/reference/igneous/tasks/
+  skeleton.py:368-377, to confine skeleton traces within proofread
+  boundaries); kimimaro consumes it as a movement constraint, which
+  ops.skeletonize mirrors via its voxel_graph parameter.
+  labels: (x, y, z). Pure numpy — consumers are host-side graph builders.
+  """
+  if labels.ndim != 3:
+    raise ValueError("labels must be (x, y, z)")
+  out = np.zeros(labels.shape, dtype=np.uint32)
+  fg = labels != 0
+  for dz, dy, dx in neighbor_offsets(connectivity):
+    off = (dx, dy, dz)
+    src = tuple(
+      slice(max(0, -d), labels.shape[a] - max(0, d))
+      for a, d in enumerate(off)
+    )
+    dst = tuple(
+      slice(max(0, d), labels.shape[a] - max(0, -d))
+      for a, d in enumerate(off)
+    )
+    same = fg[src] & (labels[src] == labels[dst])
+    out[src] |= same.astype(np.uint32) << np.uint32(graph_bit(off))
+  return out
+
+
+def statistics(labels: np.ndarray) -> dict:
+  """cc3d.statistics parity: per-component voxel counts, bounding boxes,
+  and centroids for a 1..N-labeled volume (0 = background).
+
+  Returns {"voxel_counts": (N+1,), "bounding_boxes": [(slice,)*3]*(N+1),
+  "centroids": (N+1, 3)} indexed by label; entry 0 (background) and labels
+  absent from the volume have NaN centroids, matching cc3d.
+  Reference call sites: cc3d.statistics at
+  /root/reference/igneous/task_creation/image.py:2074-2076 (ROI detection).
+  """
+  from scipy import ndimage
+
+  labels = np.asarray(labels)
+  N = int(labels.max()) if labels.size else 0
+  counts = np.bincount(labels.reshape(-1), minlength=N + 1).astype(np.uint64)
+  objs = ndimage.find_objects(labels.astype(np.int64, copy=False))
+  boxes = [
+    tuple(slice(0, s) for s in labels.shape)
+  ] + [o for o in objs]
+  centroids = np.full((N + 1, 3), np.nan, dtype=np.float64)
+  if N:
+    # center_of_mass needs only a bool weight volume — no float64
+    # coordinate volumes; absent labels come back NaN
+    with np.errstate(invalid="ignore"):
+      cent = ndimage.center_of_mass(
+        labels != 0, labels, np.arange(1, N + 1)
+      )
+    centroids[1:] = np.asarray(cent, dtype=np.float64).reshape(N, 3)
+  return {
+    "voxel_counts": counts,
+    "bounding_boxes": boxes,
+    "centroids": centroids,
+  }

@@ -70,13 +70,21 @@ class TeasarParams:
     return cls(**{k: v for k, v in d.items() if k in cls.KNOWN})
 
 
-def _foreground_graph(mask: np.ndarray, pdrf: np.ndarray, anisotropy):
+def _foreground_graph(
+  mask: np.ndarray, pdrf: np.ndarray, anisotropy, voxel_graph=None
+):
   """26-connected sparse graph over foreground voxels; edge weight =
-  mean endpoint penalty * physical step length."""
+  mean endpoint penalty * physical step length. ``voxel_graph`` (uint32
+  bitfields from ops.ccl.voxel_connectivity_graph) removes edges whose
+  direction bit is unset at the source voxel — the movement constraint
+  kimimaro applies for the graphene autapse fix (reference
+  tasks/skeleton.py:368-377)."""
   idx = np.full(mask.shape, -1, dtype=np.int64)
   fg = np.flatnonzero(mask.reshape(-1))
   idx.reshape(-1)[fg] = np.arange(len(fg))
   w = np.asarray(anisotropy, dtype=np.float32)
+  if voxel_graph is not None:
+    from .ccl import graph_bit  # local import: ccl pulls in jax
 
   rows, cols, vals = [], [], []
   for dx in (-1, 0, 1):
@@ -93,6 +101,9 @@ def _foreground_graph(mask: np.ndarray, pdrf: np.ndarray, anisotropy):
           for a, d in enumerate((dx, dy, dz))
         )
         both = mask[src] & mask[dst]
+        if voxel_graph is not None:
+          bit = np.uint32(graph_bit((dx, dy, dz)))
+          both &= (voxel_graph[src] >> bit) & np.uint32(1) != 0
         if not both.any():
           continue
         a_idx = idx[src][both]
@@ -119,6 +130,7 @@ def skeletonize_mask(
   offset: Sequence[float] = (0.0, 0.0, 0.0),
   edt_field: Optional[np.ndarray] = None,
   extra_targets: Optional[np.ndarray] = None,
+  voxel_graph: Optional[np.ndarray] = None,
 ) -> Skeleton:
   """Skeletonize one binary object. Vertices come out in physical units:
   (voxel + offset) * anisotropy. ``edt_field`` lets callers supply a
@@ -146,7 +158,8 @@ def skeletonize_mask(
     pieces = []
     for ci in range(1, ncomp + 1):
       piece = _skeletonize_component(
-        comps == ci, dt, anisotropy, params, offset, extra_targets
+        comps == ci, dt, anisotropy, params, offset, extra_targets,
+        voxel_graph,
       )
       if not piece.empty:
         pieces.append(piece)
@@ -154,7 +167,7 @@ def skeletonize_mask(
       return Skeleton()
     return Skeleton.simple_merge(pieces).consolidate()
   return _skeletonize_component(
-    mask, dt, anisotropy, params, offset, extra_targets
+    mask, dt, anisotropy, params, offset, extra_targets, voxel_graph
   )
 
 
@@ -165,6 +178,7 @@ def _skeletonize_component(
   params: TeasarParams,
   offset,
   extra_targets,
+  voxel_graph=None,
 ) -> Skeleton:
   dt = np.where(mask, dt, 0.0)
   dmax = float(dt.max())
@@ -176,7 +190,7 @@ def _skeletonize_component(
   ).astype(np.float32) + 1e-5
   pdrf[~mask] = np.float32(np.inf)
 
-  graph, fg = _foreground_graph(mask, pdrf, anisotropy)
+  graph, fg = _foreground_graph(mask, pdrf, anisotropy, voxel_graph)
   n = len(fg)
   if graph is None or n == 1:
     # a single voxel: degenerate one-vertex skeleton
@@ -190,74 +204,90 @@ def _skeletonize_component(
   coords = np.array(np.unravel_index(fg, mask.shape)).T  # (n, 3) voxel
   phys = coords.astype(np.float32) * np.asarray(anisotropy, np.float32)
 
-  # root: farthest voxel (unweighted hops) from an arbitrary start
-  d0 = dijkstra(graph, indices=0, unweighted=True)
-  root = int(np.argmax(np.where(np.isfinite(d0), d0, -1)))
-
-  # penalized distances + shortest-path tree from the root
-  dist, pred = dijkstra(graph, indices=root, return_predecessors=True)
-  reachable = np.isfinite(dist)
-
-  captured = np.zeros(n, dtype=bool)
-  captured[~reachable] = True  # disconnected bits: other CCL components
-  captured[root] = True
-
   edt_flat = dt.reshape(-1)[fg]
   inval_radius = params.scale * edt_flat + params.const
 
-  paths = []
-  max_paths = params.max_paths or n
-  for _ in range(max_paths):
-    remaining = np.flatnonzero(~captured)
-    if len(remaining) == 0:
-      break
-    target = int(remaining[np.argmax(dist[remaining])])
-    # walk the predecessor tree from target back to a captured vertex
-    path = [target]
-    cur = target
-    while pred[cur] >= 0 and not captured[cur]:
-      cur = int(pred[cur])
-      path.append(cur)
-    path = np.asarray(path, dtype=np.int64)
-    paths.append(path)
-    # rolling invalidation ball: capture voxels near the new centerline
-    ball = inval_radius[path]  # (p,)
-    # chunk to bound memory: |remaining| x |path| distances
-    rem = np.flatnonzero(~captured)
-    for start in range(0, len(path), 512):
-      seg = path[start : start + 512]
-      d2 = (
-        (phys[rem, None, :] - phys[None, seg, :]) ** 2
-      ).sum(-1)  # (r, p)
-      hit = (d2 <= (ball[None, start : start + 512] ** 2)).any(axis=1)
-      captured[rem[hit]] = True
-      rem = rem[~hit]
-      if len(rem) == 0:
-        break
-    captured[path] = True
-
-  # forced targets: path each one into the tree regardless of invalidation
+  flat_targets = None
   if extra_targets is not None and len(extra_targets):
     flat_targets = np.ravel_multi_index(
       np.asarray(extra_targets, dtype=np.int64).T, mask.shape
     )
-    on_tree = np.zeros(n, dtype=bool)
-    if paths:
-      on_tree[np.concatenate(paths).reshape(-1)] = True
-    on_tree[root] = True
-    pos = np.searchsorted(fg, flat_targets)
-    for p, t in zip(pos, flat_targets):
-      if p >= n or fg[p] != t or not reachable[p]:
-        continue
-      path = [int(p)]
-      cur = int(p)
-      while pred[cur] >= 0 and not on_tree[cur]:
+
+  # a voxel_graph can sever a geometrically-connected mask into several
+  # graph components (the autapse-fix mechanism); every component must be
+  # traced, not just the one containing the first root — kimimaro
+  # skeletonizes each graph-connected piece
+  from scipy.sparse.csgraph import connected_components as graph_components
+
+  ncomp_g, comp_ids = graph_components(graph, directed=False)
+
+  paths = []
+  roots = []
+  on_tree = np.zeros(n, dtype=bool)
+  max_paths = params.max_paths or n
+  for c in range(ncomp_g):
+    in_comp = comp_ids == c
+    nodes = np.flatnonzero(in_comp)
+    # root: farthest voxel (unweighted hops) from an arbitrary comp start
+    d0 = dijkstra(graph, indices=int(nodes[0]), unweighted=True)
+    root = int(np.argmax(np.where(np.isfinite(d0), d0, -1)))
+    roots.append(root)
+
+    # penalized distances + shortest-path tree from the root
+    dist, pred = dijkstra(graph, indices=root, return_predecessors=True)
+
+    captured = ~in_comp  # other components are off-limits for this trace
+    captured = captured.copy()
+    captured[root] = True
+
+    for _ in range(max_paths):
+      remaining = np.flatnonzero(~captured)
+      if len(remaining) == 0:
+        break
+      target = int(remaining[np.argmax(dist[remaining])])
+      # walk the predecessor tree from target back to a captured vertex
+      path = [target]
+      cur = target
+      while pred[cur] >= 0 and not captured[cur]:
         cur = int(pred[cur])
         path.append(cur)
-      if len(path) > 1:
-        arr = np.asarray(path, dtype=np.int64)
-        paths.append(arr)
-        on_tree[arr] = True
+      path = np.asarray(path, dtype=np.int64)
+      paths.append(path)
+      # rolling invalidation ball: capture voxels near the new centerline
+      ball = inval_radius[path]  # (p,)
+      # chunk to bound memory: |remaining| x |path| distances
+      rem = np.flatnonzero(~captured)
+      for start in range(0, len(path), 512):
+        seg = path[start : start + 512]
+        d2 = (
+          (phys[rem, None, :] - phys[None, seg, :]) ** 2
+        ).sum(-1)  # (r, p)
+        hit = (d2 <= (ball[None, start : start + 512] ** 2)).any(axis=1)
+        captured[rem[hit]] = True
+        rem = rem[~hit]
+        if len(rem) == 0:
+          break
+      captured[path] = True
+
+    # forced targets: path each one into this component's tree regardless
+    # of invalidation
+    if flat_targets is not None:
+      for p in paths:
+        on_tree[p] = True
+      on_tree[root] = True
+      pos = np.searchsorted(fg, flat_targets)
+      for p, t in zip(pos, flat_targets):
+        if p >= n or fg[p] != t or not in_comp[p]:
+          continue
+        path = [int(p)]
+        cur = int(p)
+        while pred[cur] >= 0 and not on_tree[cur]:
+          cur = int(pred[cur])
+          path.append(cur)
+        if len(path) > 1:
+          arr = np.asarray(path, dtype=np.int64)
+          paths.append(arr)
+          on_tree[arr] = True
 
   # assemble skeleton from paths
   verts = (coords.astype(np.float32) + np.asarray(offset, np.float32)) * \
@@ -267,7 +297,7 @@ def _skeletonize_component(
     edges.append(np.stack([path[:-1], path[1:]], axis=1))
   edges = np.concatenate(edges) if edges else np.zeros((0, 2), np.int64)
 
-  used = np.unique(np.concatenate([edges.reshape(-1), [root]]))
+  used = np.unique(np.concatenate([edges.reshape(-1), roots]))
   remap = np.full(n, -1, dtype=np.int64)
   remap[used] = np.arange(len(used))
   skel = Skeleton(
@@ -289,6 +319,7 @@ def skeletonize(
   extra_targets_per_label: Optional[Dict[int, np.ndarray]] = None,
   parallel: int = 1,
   progress: bool = False,
+  voxel_graph: Optional[np.ndarray] = None,
 ) -> Dict[int, Skeleton]:
   """Skeletonize every label in a volume → {label: Skeleton}.
 
@@ -334,6 +365,7 @@ def skeletonize(
     skel = skeletonize_mask(
       mask, anisotropy, params, offset=crop_offset, edt_field=crop_edt,
       extra_targets=targets,
+      voxel_graph=None if voxel_graph is None else voxel_graph[sl],
     )
     return None if skel.empty else (int(orig), skel)
 

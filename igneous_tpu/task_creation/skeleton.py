@@ -35,6 +35,7 @@ def create_skeletonizing_tasks(
   object_ids: Optional[Sequence[int]] = None,
   mask_ids: Optional[Sequence[int]] = None,
   dust_threshold: int = 1000,
+  dust_global: bool = False,
   fill_missing: bool = False,
   sharded: bool = False,
   skel_dir: Optional[str] = None,
@@ -144,6 +145,7 @@ def create_skeletonizing_tasks(
       object_ids=list(object_ids) if object_ids else None,
       mask_ids=list(mask_ids) if mask_ids else None,
       dust_threshold=dust_threshold,
+      dust_global=dust_global,
       fill_missing=fill_missing,
       sharded=sharded,
       skel_dir=skel_dir,
@@ -162,6 +164,7 @@ def create_skeletonizing_tasks(
       "skel_dir": skel_dir, "sharded": sharded,
       "teasar_params": teasar_params or {},
       "dust_threshold": dust_threshold,
+      "dust_global": dust_global,
       "bounds": task_bounds.to_list(),
     }, operator_contact())
     vol.commit_provenance()

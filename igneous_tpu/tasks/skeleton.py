@@ -92,6 +92,7 @@ class SkeletonTask(RegisteredTask):
     object_ids: Optional[Sequence[int]] = None,
     mask_ids: Optional[Sequence[int]] = None,
     dust_threshold: int = 1000,
+    dust_global: bool = False,
     fill_missing: bool = False,
     sharded: bool = False,
     skel_dir: Optional[str] = None,
@@ -110,6 +111,7 @@ class SkeletonTask(RegisteredTask):
     self.object_ids = list(object_ids) if object_ids else None
     self.mask_ids = list(mask_ids) if mask_ids else None
     self.dust_threshold = int(dust_threshold)
+    self.dust_global = bool(dust_global)
     self.fill_missing = fill_missing
     self.sharded = sharded
     self.skel_dir = skel_dir
@@ -130,6 +132,32 @@ class SkeletonTask(RegisteredTask):
     }
     self.parallel = int(parallel)
 
+  def _apply_global_dust(self, labels: np.ndarray) -> np.ndarray:
+    import struct as _struct
+
+    from .stats import load_voxel_counts
+
+    counts = load_voxel_counts(self.cloudpath, self.mip)
+    if counts is None:
+      raise ValueError(
+        "dust_global requires the voxel-count census: run "
+        "`igneous-tpu image voxels count` then `... voxels sum` (or "
+        "tasks.stats.accumulate_voxel_counts) on this layer first."
+      )
+    present = fastremap.unique(labels)
+    small = []
+    for label in present:
+      label = int(label)
+      if label == 0:
+        continue
+      blob = counts.get(label)
+      total = _struct.unpack("<Q", blob)[0] if blob else 0
+      if total < self.dust_threshold:
+        small.append(label)
+    if small:
+      labels = fastremap.mask(labels, small)
+    return labels
+
   def execute(self):
     vol = Volume(
       self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
@@ -148,6 +176,13 @@ class SkeletonTask(RegisteredTask):
       labels = fastremap.mask_except(labels, self.object_ids)
     if self.mask_ids:
       labels = fastremap.mask(labels, self.mask_ids)
+    local_dust = self.dust_threshold
+    if self.dust_global and self.dust_threshold:
+      # dust by GLOBAL per-label voxel counts (CountVoxelsTask census) so
+      # objects straddling task boundaries aren't wrongly dusted by their
+      # per-cutout fraction (reference tasks/skeleton.py:722-755)
+      labels = self._apply_global_dust(labels)
+      local_dust = 0
     if self.fill_holes:
       # cavities distort the EDT and spawn spurious loops
       # (reference tasks/skeleton.py:268-301)
@@ -185,7 +220,7 @@ class SkeletonTask(RegisteredTask):
       anisotropy=tuple(float(v) for v in vol.resolution),
       params=TeasarParams.from_dict(self.teasar_params),
       offset=tuple(float(v) for v in cutout.minpt),
-      dust_threshold=self.dust_threshold,
+      dust_threshold=local_dust,
       extra_targets_per_label=targets,
       parallel=self.parallel,
     )

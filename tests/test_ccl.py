@@ -200,3 +200,117 @@ def test_ccl_unaligned_bounds(tmp_path, rng):
   mx = tc.ccl_auto(src, f"file://{tmp_path}/out", shape=(64, 64, 64),
                    threshold_gte=1, bounds=Bbox((1, 1, 1), (65, 65, 39)))
   assert mx > 0
+
+
+# ---------------------------------------------------------------------------
+# cc3d feature parity (round 2): 18/26-connectivity, connectivity graph,
+# statistics
+
+
+def test_ccl_26_connectivity_vs_scipy(rng):
+  from scipy import ndimage
+
+  mask = (rng.random((24, 20, 16)) < 0.25).astype(np.uint8)
+  ours, n_ours = connected_components(mask, connectivity=26, return_N=True)
+  ref, n_ref = ndimage.label(mask, structure=np.ones((3, 3, 3), bool))
+  assert n_ours == n_ref
+  # same partition: bijection between labelings on foreground
+  pairs = np.unique(
+    np.stack([ours[mask > 0], ref[mask > 0]]), axis=1
+  )
+  assert len(np.unique(pairs[0])) == len(pairs[0])
+  assert len(np.unique(pairs[1])) == len(pairs[1])
+
+
+def test_ccl_18_connectivity_vs_scipy(rng):
+  from scipy import ndimage
+
+  mask = (rng.random((20, 18, 14)) < 0.3).astype(np.uint8)
+  ours, n_ours = connected_components(mask, connectivity=18, return_N=True)
+  struct = ndimage.generate_binary_structure(3, 2)
+  ref, n_ref = ndimage.label(mask, structure=struct)
+  assert n_ours == n_ref
+
+
+def test_ccl_26_diagonal_touch():
+  # two voxels sharing only a corner: one component at 26, two at 6
+  lab = np.zeros((4, 4, 4), np.uint8)
+  lab[1, 1, 1] = 1
+  lab[2, 2, 2] = 1
+  _, n6 = connected_components(lab, connectivity=6, return_N=True)
+  _, n26 = connected_components(lab, connectivity=26, return_N=True)
+  assert (n6, n26) == (2, 1)
+
+
+def test_voxel_connectivity_graph_bits():
+  from igneous_tpu.ops.ccl import graph_bit, voxel_connectivity_graph
+
+  lab = np.zeros((3, 3, 3), np.uint32)
+  lab[0, 1, 1] = 7
+  lab[1, 1, 1] = 7
+  lab[2, 1, 1] = 9
+  g = voxel_connectivity_graph(lab, connectivity=6)
+  # center connects to (−1,0,0) neighbor (same label) but not (+1,0,0)
+  assert (g[1, 1, 1] >> graph_bit((-1, 0, 0))) & 1 == 1
+  assert (g[1, 1, 1] >> graph_bit((1, 0, 0))) & 1 == 0
+  # symmetry: the neighbor's opposite bit is set too
+  assert (g[0, 1, 1] >> graph_bit((1, 0, 0))) & 1 == 1
+  # background voxels carry no bits
+  assert g[0, 0, 0] == 0
+
+
+def test_voxel_graph_constrains_skeleton():
+  """A connectivity graph that severs the touching plane between two bars
+  keeps their skeletons disconnected — the autapse-fix mechanism
+  (reference tasks/skeleton.py:337-398)."""
+  from igneous_tpu.ops.ccl import voxel_connectivity_graph
+  from igneous_tpu.ops.skeletonize import skeletonize_mask
+
+  mask = np.zeros((30, 8, 8), bool)
+  mask[:, 1:7, 1:7] = True  # one solid bar along x
+  # graph built from a TWO-label volume: the wall at x=15 severs them
+  twolab = np.ones(mask.shape, np.uint32)
+  twolab[15:] = 2
+  twolab[~mask] = 0
+  g = voxel_connectivity_graph(twolab, connectivity=26)
+  skel = skeletonize_mask(mask, (1, 1, 1), voxel_graph=g)
+  # edges never cross the severed plane: vertex pairs of every edge sit
+  # on the same side of x=14.5
+  vx = skel.vertices[:, 0]
+  sides = vx[skel.edges.astype(int)] > 14.5
+  assert np.all(sides[:, 0] == sides[:, 1])
+  # BOTH severed halves get skeletons (a severed component must be traced,
+  # not dropped with the root's component)
+  assert (vx < 14.5).any() and (vx > 14.5).any()
+  assert (vx < 14.5).sum() > 5 and (vx > 14.5).sum() > 5
+  # without the graph the bar is one connected path crossing the plane
+  skel_free = skeletonize_mask(mask, (1, 1, 1))
+  vxf = skel_free.vertices[:, 0]
+  sidesf = vxf[skel_free.edges.astype(int)] > 14.5
+  assert not np.all(sidesf[:, 0] == sidesf[:, 1])
+
+
+def test_statistics_parity(rng):
+  from igneous_tpu.ops.ccl import statistics
+
+  lab = np.zeros((12, 10, 8), np.uint32)
+  lab[1:4, 2:5, 3:6] = 1
+  lab[8:11, 0:2, 0:4] = 2
+  s = statistics(lab)
+  assert s["voxel_counts"][1] == 27
+  assert s["voxel_counts"][2] == 3 * 2 * 4
+  assert s["bounding_boxes"][1] == (slice(1, 4), slice(2, 5), slice(3, 6))
+  assert np.allclose(s["centroids"][1], [2, 3, 4])
+  assert np.isnan(s["centroids"][0]).all()  # background: NaN like cc3d
+
+
+def test_statistics_absent_label_nan():
+  from igneous_tpu.ops.ccl import statistics
+
+  lab = np.zeros((6, 6, 6), np.uint32)
+  lab[0, 0, 0] = 1
+  lab[5, 5, 5] = 3  # label 2 absent
+  s = statistics(lab)
+  assert s["voxel_counts"][2] == 0
+  assert np.isnan(s["centroids"][2]).all()
+  assert np.allclose(s["centroids"][3], [5, 5, 5])
