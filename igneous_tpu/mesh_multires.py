@@ -68,7 +68,7 @@ def _zorder(positions: np.ndarray) -> np.ndarray:
   return np.argsort(np.asarray(codes), kind="stable")
 
 
-def _clip_polygons(
+def clip_polygons(
   verts: np.ndarray, counts: np.ndarray, axis: int, sign: float, bound: float
 ) -> Tuple[np.ndarray, np.ndarray]:
   """Sutherland-Hodgman clip of padded polygons against one axis plane.
@@ -135,7 +135,7 @@ def clip_triangles_to_box(
   counts = np.full(len(tri), 3, dtype=np.int64)
   for axis in range(3):
     for sign, bound in ((-1.0, float(lo[axis])), (1.0, float(hi[axis]))):
-      verts, counts = _clip_polygons(verts, counts, axis, sign, bound)
+      verts, counts = clip_polygons(verts, counts, axis, sign, bound)
       keep = counts >= 3
       verts, counts = verts[keep], counts[keep]
       if len(verts) == 0:

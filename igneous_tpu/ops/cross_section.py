@@ -5,15 +5,18 @@ Reference: kimimaro.cross_sectional_area (backed by the xs3d C++ library,
 vertex, the area of the label's planar slice perpendicular to the local
 skeleton direction.
 
-Implementation: voxel-slab counting. For vertex v with unit tangent t,
-every foreground voxel center p in a local window contributes when
-|(p - v)·t| < 1/2 voxel step (a one-voxel-thick slab) and p is
-flood-connected to v within the slab (so parallel branches of the same
-label do not inflate the area). Area = count x (voxel volume / step),
-which converges to the geometric slice area for slabs through voxelized
-solids. Accuracy is the voxelization's (compare the tube test: pi*r^2
-within ~10%); exact polygonal slicing a la xs3d can swap in behind the
-same signature.
+Implementation (round 2 — exact): for vertex v with unit physical tangent
+t, the slice is the plane through v with normal t. Every voxel cube the
+plane crosses and that is flood-connected to v within the crossed set
+contributes the EXACT area of (plane ∩ cube) — a convex polygon obtained
+by clipping an in-plane patch against the cube's six half-spaces with the
+same vectorized Sutherland-Hodgman used for multires wall
+retriangulation. Cube slices partition the label's slice, so the sum is
+the exact planar section area of the voxelized solid (xs3d semantics):
+axis-aligned and oblique slices of cuboids are exact to float precision,
+curved solids exact for their voxelization. Connectivity within the
+crossed set keeps parallel branches of the same label from inflating the
+area (xs3d's contiguous-section rule).
 """
 
 from __future__ import annotations
@@ -49,14 +52,72 @@ def vertex_tangents(skel: Skeleton) -> np.ndarray:
   return tangents / norms
 
 
+def _plane_basis(t: np.ndarray):
+  """Two unit vectors spanning the plane with unit normal t."""
+  e = np.zeros(3)
+  e[int(np.argmin(np.abs(t)))] = 1.0
+  u = np.cross(t, e)
+  u /= np.linalg.norm(u)
+  return u, np.cross(t, u)
+
+
+def _plane_cube_areas(
+  vox_idx: np.ndarray, v_phys: np.ndarray, t: np.ndarray, anis: np.ndarray
+) -> float:
+  """Exact Σ area(plane ∩ cube) over voxel cubes at integer indices
+  vox_idx (K, 3); plane through v_phys with unit normal t. Convention:
+  index i is the CUBE CENTER, i.e. cube k spans
+  [(vox_idx-1/2)*anis, (vox_idx+1/2)*anis). Fully vectorized over cubes."""
+  from ..mesh_multires import clip_polygons
+
+  if len(vox_idx) == 0:
+    return 0.0
+  centers = vox_idx.astype(np.float64) * anis
+  lo_phys = centers - anis / 2.0
+  d_c = (centers - v_phys) @ t
+  # patch center: cube center projected onto the plane, cube-local coords
+  p_rel = (centers - d_c[:, None] * t) - lo_phys
+  s = float(np.linalg.norm(anis))  # covers any cube cross-section
+  u, w = _plane_basis(t)
+  quad = np.stack([
+    p_rel + s * (u + w), p_rel + s * (u - w),
+    p_rel + s * (-u - w), p_rel + s * (-u + w),
+  ], axis=1)  # (K, 4, 3), ordered around the patch
+  counts = np.full(len(quad), 4, dtype=np.int64)
+  verts = quad
+  for axis in range(3):
+    for sign, bound in ((-1.0, 0.0), (1.0, float(anis[axis]))):
+      verts, counts = clip_polygons(verts, counts, axis, sign, bound)
+      keep = counts >= 3
+      verts, counts = verts[keep], counts[keep]
+      if len(verts) == 0:
+        return 0.0
+  # 3D shoelace per polygon: 0.5 * |sum_i (v_i - v_0) x (v_{i+1} - v_0)|
+  total = 0.0
+  rel = verts - verts[:, :1]
+  acc = np.zeros((len(verts), 3))
+  for i in range(1, verts.shape[1] - 1):
+    valid = counts > i + 1
+    if not valid.any():
+      break
+    acc[valid] += np.cross(rel[valid, i], rel[valid, i + 1])
+  total = 0.5 * np.linalg.norm(acc, axis=1).sum()
+  return float(total)
+
+
 def cross_sectional_area(
   mask: np.ndarray,
   skel: Skeleton,
   anisotropy: Sequence[float] = (1.0, 1.0, 1.0),
   offset: Sequence[float] = (0.0, 0.0, 0.0),
   window: int = 48,
+  vertex_mask: Optional[np.ndarray] = None,
 ) -> np.ndarray:
   """Per-vertex slice areas (physical units²) of one label's mask.
+
+  ``vertex_mask``: optional bool array — compute only these vertices
+  (others stay -1); the contact-repair pass uses it to revisit just the
+  flagged vertices against a context re-download.
 
   ``skel`` vertices are physical; ``mask`` is the (x,y,z) label mask whose
   voxel grid starts at ``offset`` (voxels). Returns float32 values:
@@ -68,19 +129,14 @@ def cross_sectional_area(
     -1         vertex outside the mask.
   """
   anis = np.asarray(anisotropy, np.float32)
-  voxel_volume = float(np.prod(anis))
   tangents = vertex_tangents(skel)
   out = np.full(len(skel.vertices), -1.0, np.float32)
   shape = np.asarray(mask.shape, dtype=np.int64)
-
-  # one shared window coordinate grid; per vertex only a slice + the
-  # sub-voxel shift changes
   w = int(window)
-  base_grid = (
-    np.indices((2 * w + 1,) * 3).astype(np.float32) - w
-  )  # (3, 2w+1, 2w+1, 2w+1), centered
 
   for i, (v, t) in enumerate(zip(skel.vertices, tangents)):
+    if vertex_mask is not None and not vertex_mask[i]:
+      continue
     vv = v / anis - np.asarray(offset, np.float32)  # voxel coords
     vi = np.round(vv).astype(np.int64)
     if np.any(vi < 0) or np.any(vi >= shape):
@@ -93,28 +149,51 @@ def cross_sectional_area(
     hi = np.minimum(vi + w + 1, shape)
     sub = mask[lo[0]:hi[0], lo[1]:hi[1], lo[2]:hi[2]]
 
-    gsl = tuple(
-      slice(int(a - (c - w)), int(b - (c - w)))
-      for a, b, c in zip(lo, hi, vi)
-    )
+    # signed distance of each subwindow voxel center from the plane,
+    # built from per-axis aranges (never a materialized (2w+1)^3 grid —
+    # at the repair window of 150 that would be ~GB-scale)
     frac = (vi.astype(np.float32) - vv) * anis  # sub-voxel shift, physical
+    axes = [
+      (np.arange(lo[a], hi[a], dtype=np.float32) - vi[a])
+      * (anis[a] * t[a])
+      for a in range(3)
+    ]
     dist = (
-      base_grid[0][gsl] * (anis[0] * t[0])
-      + base_grid[1][gsl] * (anis[1] * t[1])
-      + base_grid[2][gsl] * (anis[2] * t[2])
+      axes[0][:, None, None] + axes[1][None, :, None]
+      + axes[2][None, None, :]
     ) + float(frac @ t)
-    # slab thickness: one step of the (anisotropic) voxel grid along t
-    step = float(np.linalg.norm(anis * t))
-    slab = sub & (np.abs(dist) < step / 2.0)
+    # a cube is crossed by the plane iff the center's distance is within
+    # the cube's support radius along the normal. Half-open: a plane
+    # lying EXACTLY on a shared face belongs to one neighbor only —
+    # inclusive-both would double-count the full face polygon
+    support = 0.5 * float(np.abs(anis * t).sum())
+    crossed = sub & (dist > -support) & (dist <= support)
     seed = tuple(vi - lo)
-    if not slab[seed]:
-      continue
-    # connectivity within the slab: other branches crossing the plane
-    # must not count (xs3d's contiguous-section semantics)
-    labeled, _ = ndimage.label(slab, structure=np.ones((3, 3, 3), bool))
+    if not crossed[seed]:
+      # the rounded vertex voxel can land on the open side of the
+      # half-open test (vertex exactly on a face); step to the crossed
+      # neighbor along the dominant tangent axis
+      ax = int(np.argmax(np.abs(t)))
+      for step_dir in (1, -1):
+        alt = np.asarray(seed)
+        alt[ax] += step_dir
+        if np.all(alt >= 0) and np.all(alt < np.asarray(sub.shape)) and \
+            crossed[tuple(alt)]:
+          seed = tuple(alt)
+          break
+      else:
+        continue
+    # connectivity within the crossed set: other branches crossing the
+    # plane must not count (xs3d's contiguous-section semantics)
+    labeled, _ = ndimage.label(crossed, structure=np.ones((3, 3, 3), bool))
     comp_mask = labeled == labeled[seed]
-    count = int(comp_mask.sum())
-    area = count * voxel_volume / step
+
+    # exact area: clip the plane against every crossed cube, sum polygons
+    local_idx = np.argwhere(comp_mask)  # crop-window voxel indices
+    vox_idx = local_idx + lo  # crop-frame voxel indices
+    area = _plane_cube_areas(
+      vox_idx, vv.astype(np.float64) * anis, t.astype(np.float64), anis
+    )
 
     # truncation: the section touches the window or cutout boundary, so
     # the true slice may continue beyond what we counted (window-clipped

@@ -158,6 +158,69 @@ class SkeletonTask(RegisteredTask):
       labels = fastremap.mask(labels, small)
     return labels
 
+  # context margin for cross-section contact repair (voxels): the
+  # reference re-downloads ±150vx around flagged vertices
+  # (tasks/skeleton.py:84,406-410)
+  CSA_REPAIR_CONTEXT = 150
+
+  def _repair_csa_contacts(self, vol: "Volume", skels, bounds: Bbox) -> None:
+    """Revisit vertices whose slice was clipped by the cutout (negative
+    areas): cluster them, re-download each cluster's neighborhood with
+    context, recompute exactly, and overwrite where the larger view
+    produced a clean slice (reference tasks/skeleton.py:574-720 —
+    DBSCAN-clustered boundary-contact repair)."""
+    from ..ops.cross_section import cross_sectional_area as _csa
+    from ..ops.dbscan import dbscan
+
+    anis = np.asarray(vol.resolution, dtype=np.float32)
+    ctx = self.CSA_REPAIR_CONTEXT
+    eps = float(2 * ctx * anis.min())  # one download per nearby group
+    for label, skel in skels.items():
+      areas = skel.extra_attributes.get("cross_sectional_area")
+      if areas is None or not len(skel.vertices):
+        continue
+      # clipped slices carry -area; exactly -1.0 is the unrepairable
+      # sentinel (vertex outside mask / zero tangent) — re-downloading
+      # cannot fix those, so skip them
+      bad = np.flatnonzero((areas < 0) & (areas != -1.0))
+      if len(bad) == 0:
+        continue
+      clusters = dbscan(skel.vertices[bad], eps=eps, min_samples=1)
+      for c in np.unique(clusters):
+        members = bad[clusters == c]
+        vox = np.round(
+          skel.vertices[members] / anis
+        ).astype(np.int64)
+        region = Bbox(vox.min(axis=0) - ctx, vox.max(axis=0) + ctx + 1)
+        region = Bbox.intersection(region, bounds)
+        if region.empty():
+          continue
+        cut = vol.download(region)[..., 0]
+        if self.fill_holes:
+          # same mask semantics as the original pass (execute fills holes
+          # before measuring); an unfilled cavity would shrink repaired
+          # areas relative to unflagged neighbors
+          from ..ops.morphology import fill_holes as _fill_holes
+
+          cut = _fill_holes(cut)
+        mask = np.ascontiguousarray(cut == label)
+        vmask = np.zeros(len(skel.vertices), dtype=bool)
+        vmask[members] = True
+        repaired = _csa(
+          mask, skel, anisotropy=tuple(float(v) for v in anis),
+          offset=tuple(float(v) for v in region.minpt),
+          window=ctx, vertex_mask=vmask,
+        )
+        # a clean (positive) recompute wins; a still-negative one means
+        # the section genuinely reaches the dataset boundary — keep the
+        # flagged lower bound if it grew
+        pos = repaired > 0
+        areas[members] = np.where(
+          pos[members], repaired[members],
+          np.minimum(areas[members], repaired[members]),
+        )
+      skel.extra_attributes["cross_sectional_area"] = areas
+
   def execute(self):
     vol = Volume(
       self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
@@ -269,6 +332,7 @@ class SkeletonTask(RegisteredTask):
           offset=tuple(np.asarray(cutout.minpt, np.float32) + crop_off),
         )
         skel.extra_attributes["cross_sectional_area"] = areas
+      self._repair_csa_contacts(vol, skels, bounds)
 
     sdir = skel_dir_for(vol, self.skel_dir)
     cf = CloudFiles(vol.cloudpath)

@@ -255,12 +255,95 @@ def test_cross_sectional_area_square_tube():
   s = skeletonize_mask(mask, anisotropy=(2, 2, 2),
                        params=TeasarParams(scale=4, const=6))
   areas = cross_sectional_area(mask, s, anisotropy=(2, 2, 2))
-  # interior vertices: area ~= (12*2)*(12*2) = 576 nm^2
+  # interior vertices: area == (12*2)*(12*2) = 576 nm^2 exactly where the
+  # tangent is axis-aligned (exact plane-cube slicing)
   xs = s.vertices[:, 0]
   interior = (xs > 20) & (xs < 96)
   good = areas[interior]
   assert (good > 0).all()
-  assert np.median(np.abs(good - 576.0)) / 576.0 < 0.15
+  assert np.median(np.abs(good - 576.0)) / 576.0 < 0.02
+
+
+def test_cross_section_exact_axis_aligned_cuboid():
+  """Analytic oracle (VERDICT item 6): a plane ⊥x through a b×c bar is
+  exactly b*c; exact to float tolerance, not voxelization tolerance."""
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  mask = np.zeros((40, 18, 14), bool)
+  mask[2:38, 3:13, 2:12] = True  # 10 x 10 voxel section
+  anis = (3.0, 5.0, 7.0)
+  verts = np.asarray(
+    [[16 * 3.0, 8 * 5.0, 7 * 7.0], [24 * 3.0, 8 * 5.0, 7 * 7.0]],
+    np.float32,
+  )
+  s = Skeleton(verts, [[0, 1]])
+  areas = cross_sectional_area(mask, s, anisotropy=anis)
+  expected = (10 * 5.0) * (10 * 7.0)
+  assert np.allclose(areas, expected, rtol=1e-5)
+
+
+def test_cross_section_exact_oblique_plane():
+  """45° plane through a square bar: area = w^2 * sqrt(2), exact for the
+  voxelized solid (cube slices partition the section)."""
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  mask = np.zeros((60, 60, 12), bool)
+  mask[:, 24:36, 1:11] = True  # bar along x, 12(y) x 10(z) voxels
+  d = np.float32(1.0 / np.sqrt(2.0))
+  verts = np.asarray(
+    [[28.0, 30.0, 5.5], [28.0 + 10 * d, 30.0 + 10 * d, 5.5]], np.float32
+  )  # tangent (1,1,0)/sqrt2 -> plane at 45°
+  s = Skeleton(verts, [[0, 1]])
+  areas = cross_sectional_area(mask, s, anisotropy=(1, 1, 1), window=40)
+  # bar is infinite along x w.r.t. the window -> section of the first
+  # vertex: width 12/cos45 in-plane x-y, height 10 -> 12*sqrt(2)*10
+  expected = 12 * np.sqrt(2) * 10
+  good = areas[areas > 0]
+  assert len(good) >= 1
+  assert np.allclose(good, expected, rtol=1e-3)
+
+
+def test_cross_section_plane_on_voxel_face_no_double_count():
+  """Regression: a vertex at a half-integer coordinate puts the slice
+  plane exactly on a shared voxel face; both adjacent cubes must not each
+  contribute the full face (was exactly 2x)."""
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  mask = np.zeros((40, 14, 14), bool)
+  mask[2:38, 2:12, 2:12] = True  # 10x10 bar
+  verts = np.asarray([[16.5, 7.0, 7.0], [17.5, 7.0, 7.0]], np.float32)
+  s = Skeleton(verts, [[0, 1]])
+  areas = cross_sectional_area(mask, s, anisotropy=(1, 1, 1))
+  assert np.allclose(areas, 100.0, rtol=1e-5)
+
+
+def test_cross_section_cylinder_pi_r2():
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  n, r = 26, 9.0
+  g = np.indices((50, n, n)).astype(np.float32)
+  cy = cz = (n - 1) / 2
+  mask = ((g[1] - cy) ** 2 + (g[2] - cz) ** 2) < r * r
+  verts = np.asarray([[20, cy, cz], [30, cy, cz]], np.float32)
+  s = Skeleton(verts, [[0, 1]])
+  areas = cross_sectional_area(mask, s, anisotropy=(1, 1, 1))
+  assert np.all(areas > 0)
+  # voxelized disk area ~ pi r^2 within ~3%
+  assert np.allclose(areas, np.pi * r * r, rtol=0.03)
+
+
+def test_dbscan_clusters_and_noise(rng):
+  from igneous_tpu.ops.dbscan import dbscan
+
+  a = rng.normal(0, 0.5, (20, 3))
+  b = rng.normal(20, 0.5, (15, 3))
+  noise = np.array([[100.0, 100.0, 100.0]])
+  pts = np.concatenate([a, b, noise])
+  labels = dbscan(pts, eps=3.0, min_samples=3)
+  assert len(np.unique(labels[:20])) == 1
+  assert len(np.unique(labels[20:35])) == 1
+  assert labels[0] != labels[25]
+  assert labels[-1] == -1  # isolated point with min_samples=3 is noise
 
 
 def test_skeleton_task_csa_attribute(tmp_path):
@@ -281,10 +364,12 @@ def test_skeleton_task_csa_attribute(tmp_path):
     vol.cf.get(f"{sdir}/55"), vertex_attributes=info["vertex_attributes"])
   csa = s.extra_attributes["cross_sectional_area"]
   assert len(csa) == len(s.vertices)
-  # tube cross-section 12x12 voxels at 16nm: 192*192 nm^2
-  interior = csa[csa > 0]
-  assert len(interior) > 0
-  assert np.median(np.abs(interior - 192.0 * 192.0)) / (192.0**2) < 0.25
+  # tube cross-section 12x12 voxels at 16nm: 192*192 nm^2. The tube does
+  # not touch the dataset boundary, so after the contact-repair pass NO
+  # vertex may remain flagged negative (task-boundary clips get repaired
+  # via context re-download — VERDICT item 6 'done' bar)
+  assert (csa > 0).all()
+  assert np.median(np.abs(csa - 192.0 * 192.0)) / (192.0**2) < 0.05
 
 
 def test_synapse_targets(tmp_path):
