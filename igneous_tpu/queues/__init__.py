@@ -4,8 +4,9 @@ Capability parity with the reference's external ``python-task-queue``
 dependency (/root/reference/igneous_cli/cli.py:69-78,935-964 and
 igneous/__init__.py:2): JSON-serializable tasks, ``LocalTaskQueue`` for
 in-process/multi-process execution, a lease-based filesystem queue
-(``fq://``) for cluster horizontal scaling, and a pluggable protocol hook
-where an SQS-style backend can be attached.
+(``fq://``) for cluster horizontal scaling, and an ``sqs://`` binding over
+a pluggable transport (boto3 in deployments; an in-process fake with
+faithful visibility semantics for tests).
 """
 
 from .registry import (
@@ -22,3 +23,6 @@ from .registry import (
 from .local import LocalTaskQueue, MockTaskQueue
 from .filequeue import FileQueue
 from .queue import TaskQueue, copy_queue, move_queue, register_queue_protocol
+from .sqs import FakeSQSTransport, SQSQueue
+
+register_queue_protocol("sqs", SQSQueue)

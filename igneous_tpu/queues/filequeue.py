@@ -24,6 +24,62 @@ LEASE_SEP = "--"
 CONTENTION_WINDOW = 100
 
 
+def iter_tasks(tasks):
+  """Normalize an insert() argument to an iterator of single tasks.
+  Strings/bytes/dicts are single payloads, not collections — shared by
+  every queue backend so a payload-dict never gets iterated as keys."""
+  if hasattr(tasks, "__iter__") and not isinstance(tasks, (str, bytes, dict)):
+    return iter(tasks)
+  return iter([tasks])
+
+
+def poll_loop(
+  queue,
+  lease_seconds: float = 600,
+  verbose: bool = False,
+  stop_fn=None,
+  max_backoff_window: float = 30.0,
+  before_fn=None,
+  after_fn=None,
+):
+  """Shared worker loop: lease→execute→delete until stop_fn says stop or
+  the queue drains (stop_fn=None polls forever, sleeping with bounded
+  backoff when empty). Used by every queue backend (fq://, sqs://) so
+  execution semantics — at-least-once, recycle-on-failure — are uniform."""
+  backoff = 1.0
+  executed = 0
+  while True:
+    if stop_fn is not None and stop_fn(executed=executed, empty=False):
+      return executed
+    leased = queue.lease(lease_seconds)
+    if leased is None:
+      if stop_fn is not None and stop_fn(executed=executed, empty=True):
+        return executed
+      time.sleep(backoff + random.random())
+      backoff = min(backoff * 2, max_backoff_window)
+      continue
+    backoff = 1.0
+    task, lease_id = leased
+    if verbose:
+      print(f"Executing {task!r}")
+    try:
+      if before_fn:
+        before_fn(task)
+      task.execute()
+      if after_fn:
+        after_fn(task)
+    except Exception:
+      # leave the lease in place: the task recycles after the timeout
+      # (at-least-once semantics; matches reference behavior on failure)
+      if verbose:
+        import traceback
+
+        traceback.print_exc()
+      continue
+    queue.delete(lease_id)
+    executed += 1
+
+
 class FileQueue:
   def __init__(self, path: str):
     if path.startswith("fq://"):
@@ -160,11 +216,7 @@ class FileQueue:
 
   insert_all = insert
 
-  @staticmethod
-  def _iter(tasks):
-    if hasattr(tasks, "__iter__") and not isinstance(tasks, (str, bytes, dict)):
-      return iter(tasks)
-    return iter([tasks])
+  _iter = staticmethod(lambda tasks: iter_tasks(tasks))
 
   # -- consumer -------------------------------------------------------------
 
@@ -251,38 +303,10 @@ class FileQueue:
     """Lease→execute→delete until stop_fn says stop or the queue drains
     (stop_fn=None polls forever, sleeping with bounded backoff when empty)."""
     del tally  # completions are always tallied; kept for API familiarity
-    backoff = 1.0
-    executed = 0
-    while True:
-      if stop_fn is not None and stop_fn(executed=executed, empty=False):
-        return executed
-      leased = self.lease(lease_seconds)
-      if leased is None:
-        if stop_fn is not None and stop_fn(executed=executed, empty=True):
-          return executed
-        time.sleep(backoff + random.random())
-        backoff = min(backoff * 2, max_backoff_window)
-        continue
-      backoff = 1.0
-      task, lease_id = leased
-      if verbose:
-        print(f"Executing {task!r}")
-      try:
-        if before_fn:
-          before_fn(task)
-        task.execute()
-        if after_fn:
-          after_fn(task)
-      except Exception:
-        # leave the lease in place: the task recycles after the timeout
-        # (at-least-once semantics; matches reference behavior on failure)
-        if verbose:
-          import traceback
-
-          traceback.print_exc()
-        continue
-      self.delete(lease_id)
-      executed += 1
+    return poll_loop(
+      self, lease_seconds, verbose, stop_fn, max_backoff_window,
+      before_fn, after_fn,
+    )
 
   def __len__(self):
     return self.enqueued

@@ -237,6 +237,18 @@ class _MemBackend:
     return None if data is None else len(data)
 
 
+def attach_memory_protocol(protocol: str):
+  """Serve ``<protocol>://`` from in-process memory buckets — the test/dev
+  double for cloud object stores (gs://, s3://): every caller-facing seam
+  (URL parsing, prefix listing, range reads, compression) runs the exact
+  code a real backend would, with only the byte transport faked.
+  Production deployments instead register a real backend via
+  register_protocol (the reference gets these from cloud-files)."""
+  register_protocol(
+    protocol, lambda path: _MemBackend(f"{protocol}://{path}")
+  )
+
+
 def _make_backend(pth: ExtractedPath):
   if pth.protocol == "file":
     return _FileBackend(pth.path)

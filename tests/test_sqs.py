@@ -1,0 +1,130 @@
+"""sqs:// binding over the in-process fake transport.
+
+The transport fake implements real SQS visibility semantics (receipt
+invalidation on redelivery, approximate counts, eventual-consistency
+double-confirmation), so the binding's seams are tested code — VERDICT
+round-1 item 8.
+"""
+
+import functools
+
+import pytest
+
+from igneous_tpu.queues import (
+  FakeSQSTransport,
+  LocalTaskQueue,
+  SQSQueue,
+  TaskQueue,
+  queueable,
+)
+
+RAN = []
+
+
+@queueable
+def sqs_probe_task(tag: str):
+  RAN.append(tag)
+
+
+class SteppableClock:
+  def __init__(self):
+    self.t = 1000.0
+
+  def __call__(self):
+    return self.t
+
+
+def make_queue(**kw):
+  clock = SteppableClock()
+  q = SQSQueue(
+    "sqs://fake/queue", transport=FakeSQSTransport(time_fn=clock),
+    empty_confirmation_sec=0.0, **kw,
+  )
+  return q, clock
+
+
+def test_insert_lease_delete_cycle():
+  q, clock = make_queue()
+  q.insert([functools.partial(sqs_probe_task, tag="a"), functools.partial(sqs_probe_task, tag="b")])
+  assert q.enqueued == 2 and q.inserted == 2
+  task, receipt = q.lease(seconds=600)
+  assert q.leased == 1
+  task.execute()
+  q.delete(receipt)
+  assert q.completed == 1
+  assert q.enqueued == 1
+
+
+def test_visibility_timeout_recycles():
+  q, clock = make_queue()
+  q.insert(functools.partial(sqs_probe_task, tag="x"))
+  got1 = q.lease(seconds=30)
+  assert got1 is not None
+  assert q.lease(seconds=30) is None  # in flight, invisible
+  clock.t += 31  # lease expires
+  got2 = q.lease(seconds=30)
+  assert got2 is not None
+  # the ORIGINAL receipt is now stale (SQS invalidates on redelivery):
+  # deleting with it must not remove the message
+  q.delete(got1[1])
+  assert q.enqueued == 1
+  q.delete(got2[1])
+  assert q.enqueued == 0
+
+
+def test_release_makes_visible_immediately():
+  q, clock = make_queue()
+  q.insert(functools.partial(sqs_probe_task, tag="r"))
+  _, receipt = q.lease(seconds=600)
+  assert q.lease(seconds=600) is None
+  q.release(receipt)
+  assert q.lease(seconds=600) is not None
+
+
+def test_is_empty_double_confirmation():
+  samples = []
+
+  class FlappingTransport(FakeSQSTransport):
+    def approximate_counts(self):
+      # eventually-consistent counts: first sample says empty, second
+      # reveals a message — is_empty must not trust the first zero
+      samples.append(len(samples))
+      if len(samples) == 2:
+        return (1, 0)
+      return (0, 0)
+
+  q = SQSQueue(
+    "sqs://fake/q", transport=FlappingTransport(),
+    empty_confirmation_sec=0.0,
+  )
+  assert not q.is_empty()
+  assert len(samples) >= 2
+
+
+def test_poll_executes_and_drains():
+  RAN.clear()
+  q, clock = make_queue()
+  q.insert([functools.partial(sqs_probe_task, tag=f"t{i}") for i in range(5)])
+  n = q.poll(
+    lease_seconds=600,
+    stop_fn=lambda executed, empty: empty,
+  )
+  assert n == 5
+  assert sorted(RAN) == [f"t{i}" for i in range(5)]
+  assert q.enqueued == 0 and q.completed == 5
+
+
+def test_taskqueue_resolves_sqs_protocol():
+  q = TaskQueue("sqs://fake/queue", transport=FakeSQSTransport())
+  assert isinstance(q, SQSQueue)
+
+
+def test_boto3_transport_missing_is_loud():
+  with pytest.raises(RuntimeError, match="boto3"):
+    SQSQueue("sqs://real/queue")
+
+
+def test_release_all_unsupported():
+  q, _ = make_queue()
+  with pytest.raises(NotImplementedError, match="visibility"):
+    q.release_all()
