@@ -1,0 +1,281 @@
+"""Independent, from-spec readers for the Neuroglancer Precomputed formats.
+
+These are written against the PUBLISHED specifications (the neuroglancer
+precomputed docs: sharded uint64 format, compressed_segmentation, the
+skeleton and legacy-mesh binary layouts, and Austin Appleby's public
+murmurhash3 reference) and deliberately import NOTHING from igneous_tpu —
+they share no helper, no constant, and no convention with the encoders
+under test. A byte-order or layout bug that an encoder and its own
+decoder agree on cannot cancel out here (VERDICT round-1 item 9).
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# murmurhash3_x86_128 (reference implementation transcription, public domain)
+
+
+def _rotl32(x, r):
+  x &= 0xFFFFFFFF
+  return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+
+def murmurhash3_x86_128_low64(key: bytes, seed: int = 0) -> int:
+  """Low 64 bits (first 8 output bytes, little endian) of
+  MurmurHash3_x86_128."""
+  c1, c2, c3, c4 = 0x239B961B, 0xAB0E9789, 0x38B34AE5, 0xA1E38B93
+  h1 = h2 = h3 = h4 = seed & 0xFFFFFFFF
+  n = len(key)
+  nblocks = n // 16
+  for i in range(nblocks):
+    k1, k2, k3, k4 = struct.unpack_from("<4I", key, i * 16)
+    k1 = (k1 * c1) & 0xFFFFFFFF
+    k1 = _rotl32(k1, 15)
+    k1 = (k1 * c2) & 0xFFFFFFFF
+    h1 ^= k1
+    h1 = _rotl32(h1, 19)
+    h1 = (h1 + h2) & 0xFFFFFFFF
+    h1 = (h1 * 5 + 0x561CCD1B) & 0xFFFFFFFF
+    k2 = (k2 * c2) & 0xFFFFFFFF
+    k2 = _rotl32(k2, 16)
+    k2 = (k2 * c3) & 0xFFFFFFFF
+    h2 ^= k2
+    h2 = _rotl32(h2, 17)
+    h2 = (h2 + h3) & 0xFFFFFFFF
+    h2 = (h2 * 5 + 0x0BCAA747) & 0xFFFFFFFF
+    k3 = (k3 * c3) & 0xFFFFFFFF
+    k3 = _rotl32(k3, 17)
+    k3 = (k3 * c4) & 0xFFFFFFFF
+    h3 ^= k3
+    h3 = _rotl32(h3, 15)
+    h3 = (h3 + h4) & 0xFFFFFFFF
+    h3 = (h3 * 5 + 0x96CD1C35) & 0xFFFFFFFF
+    k4 = (k4 * c4) & 0xFFFFFFFF
+    k4 = _rotl32(k4, 18)
+    k4 = (k4 * c1) & 0xFFFFFFFF
+    h4 ^= k4
+    h4 = _rotl32(h4, 13)
+    h4 = (h4 + h1) & 0xFFFFFFFF
+    h4 = (h4 * 5 + 0x32AC3B17) & 0xFFFFFFFF
+
+  tail = key[nblocks * 16:]
+  k1 = k2 = k3 = k4 = 0
+  t = len(tail)
+  if t >= 13:
+    for i in range(t - 1, 11, -1):
+      k4 = (k4 << 8) | tail[i]
+    k4 = (k4 * c4) & 0xFFFFFFFF
+    k4 = _rotl32(k4, 18)
+    k4 = (k4 * c1) & 0xFFFFFFFF
+    h4 ^= k4
+  if t >= 9:
+    for i in range(min(t, 12) - 1, 7, -1):
+      k3 = (k3 << 8) | tail[i]
+    k3 = (k3 * c3) & 0xFFFFFFFF
+    k3 = _rotl32(k3, 17)
+    k3 = (k3 * c4) & 0xFFFFFFFF
+    h3 ^= k3
+  if t >= 5:
+    for i in range(min(t, 8) - 1, 3, -1):
+      k2 = (k2 << 8) | tail[i]
+    k2 = (k2 * c2) & 0xFFFFFFFF
+    k2 = _rotl32(k2, 16)
+    k2 = (k2 * c3) & 0xFFFFFFFF
+    h2 ^= k2
+  if t >= 1:
+    for i in range(min(t, 4) - 1, -1, -1):
+      k1 = (k1 << 8) | tail[i]
+    k1 = (k1 * c1) & 0xFFFFFFFF
+    k1 = _rotl32(k1, 15)
+    k1 = (k1 * c2) & 0xFFFFFFFF
+    h1 ^= k1
+
+  h1 ^= n
+  h2 ^= n
+  h3 ^= n
+  h4 ^= n
+  h1 = (h1 + h2 + h3 + h4) & 0xFFFFFFFF
+  h2 = (h2 + h1) & 0xFFFFFFFF
+  h3 = (h3 + h1) & 0xFFFFFFFF
+  h4 = (h4 + h1) & 0xFFFFFFFF
+
+  def fmix(h):
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+  h1, h2, h3, h4 = fmix(h1), fmix(h2), fmix(h3), fmix(h4)
+  h1 = (h1 + h2 + h3 + h4) & 0xFFFFFFFF
+  h2 = (h2 + h1) & 0xFFFFFFFF
+  h3 = (h3 + h1) & 0xFFFFFFFF
+  h4 = (h4 + h1) & 0xFFFFFFFF
+  # output = h1 h2 h3 h4 little-endian; low 64 bits = h1 | h2 << 32
+  return h1 | (h2 << 32)
+
+
+# ---------------------------------------------------------------------------
+# sharded uint64 format (neuroglancer_uint64_sharded_v1)
+
+
+def _maybe_gunzip(data: bytes, encoding: str) -> bytes:
+  return gzip.decompress(data) if encoding == "gzip" else data
+
+
+class IndependentShardReader:
+  """Reads one chunk from shard files per the published sharded spec.
+
+  ``get_file(filename) -> bytes`` abstracts storage; spec is the sharding
+  dict from the info file.
+  """
+
+  def __init__(self, spec: dict, get_file):
+    assert spec["@type"] == "neuroglancer_uint64_sharded_v1", spec
+    self.preshift = int(spec.get("preshift_bits", 0))
+    self.minishard_bits = int(spec["minishard_bits"])
+    self.shard_bits = int(spec["shard_bits"])
+    self.hash = spec.get("hash", "identity")
+    self.mini_enc = spec.get("minishard_index_encoding", "raw")
+    self.data_enc = spec.get("data_encoding", "raw")
+    self.get_file = get_file
+
+  def _hashed(self, chunk_id: int) -> int:
+    x = chunk_id >> self.preshift
+    if self.hash == "identity":
+      return x
+    if self.hash == "murmurhash3_x86_128":
+      return murmurhash3_x86_128_low64(struct.pack("<Q", x))
+    raise ValueError(self.hash)
+
+  def shard_filename(self, chunk_id: int) -> str:
+    h = self._hashed(chunk_id)
+    shard = (h >> self.minishard_bits) & ((1 << self.shard_bits) - 1)
+    width = max((self.shard_bits + 3) // 4, 1)
+    return f"{shard:0{width}x}.shard"
+
+  def get_chunk(self, chunk_id: int):
+    h = self._hashed(chunk_id)
+    minishard = h & ((1 << self.minishard_bits) - 1)
+    raw = self.get_file(self.shard_filename(chunk_id))
+    if raw is None:
+      return None
+    index_len = 16 * (1 << self.minishard_bits)
+    shard_index = np.frombuffer(raw[:index_len], dtype="<u8").reshape(-1, 2)
+    lo, hi = int(shard_index[minishard, 0]), int(shard_index[minishard, 1])
+    if lo == hi:
+      return None
+    mini = _maybe_gunzip(raw[index_len + lo: index_len + hi], self.mini_enc)
+    arr = np.frombuffer(mini, dtype="<u8")
+    n = len(arr) // 3
+    ids = np.cumsum(arr[:n].astype(np.uint64))
+    offsets = arr[n:2 * n].astype(np.uint64)
+    sizes = arr[2 * n:3 * n].astype(np.uint64)
+    # offsets are delta encoded: offset[0] relative to the end of the
+    # shard index; offset[i] relative to the end of chunk i-1's data
+    pos = np.where(ids == np.uint64(chunk_id))[0]
+    if len(pos) == 0:
+      return None
+    i = int(pos[0])
+    start = int(offsets[: i + 1].sum() + sizes[:i].sum())
+    data = raw[index_len + start: index_len + start + int(sizes[i])]
+    return _maybe_gunzip(data, self.data_enc)
+
+
+# ---------------------------------------------------------------------------
+# compressed_segmentation
+
+
+def decode_compressed_segmentation(
+  data: bytes, shape, dtype, block_size=(8, 8, 8)
+) -> np.ndarray:
+  """(x, y, z, c) volume from the compressed_segmentation spec."""
+  x, y, z, channels = shape
+  bx, by, bz = block_size
+  gx = -(-x // bx)
+  gy = -(-y // by)
+  gz = -(-z // bz)
+  words = np.frombuffer(data, dtype="<u4")
+  out = np.zeros(shape, dtype=dtype)
+  is64 = np.dtype(dtype).itemsize == 8
+
+  for c in range(channels):
+    base = int(words[c])  # channel offset in 4-byte units
+    # block headers: x fastest, 2 words each
+    for bzi in range(gz):
+      for byi in range(gy):
+        for bxi in range(gx):
+          bidx = bxi + gx * (byi + gy * bzi)
+          w0 = int(words[base + 2 * bidx])
+          w1 = int(words[base + 2 * bidx + 1])
+          table_off = w0 & 0xFFFFFF
+          bits = (w0 >> 24) & 0xFF
+          values_off = w1
+          # boundary blocks are CLIPPED to the volume (spec): the encoded
+          # bit data covers exactly the clipped extent, x fastest
+          sx = min(bx, x - bxi * bx)
+          sy = min(by, y - byi * by)
+          sz = min(bz, z - bzi * bz)
+          nvox = sx * sy * sz
+          if bits == 0:
+            packed = np.zeros(nvox, dtype=np.uint32)
+          else:
+            nwords = (nvox * bits + 31) // 32
+            enc = words[base + values_off: base + values_off + nwords]
+            bitpos = np.arange(nvox) * bits
+            word_idx = bitpos // 32
+            shift = bitpos % 32
+            packed = (
+              enc[word_idx].astype(np.uint64) >> shift.astype(np.uint64)
+            ).astype(np.uint32) & np.uint32((1 << bits) - 1)
+          if is64:
+            # 64-bit labels: table entries are 2 words each
+            lo = words[base + table_off + 2 * packed.astype(np.int64)]
+            hi = words[base + table_off + 2 * packed.astype(np.int64) + 1]
+            vals = lo.astype(np.uint64) | (hi.astype(np.uint64) << np.uint64(32))
+          else:
+            vals = words[base + table_off + packed.astype(np.int64)]
+          block = vals.reshape(sz, sy, sx)  # x fastest within the block
+          xs = slice(bxi * bx, bxi * bx + sx)
+          ys = slice(byi * by, byi * by + sy)
+          zs = slice(bzi * bz, bzi * bz + sz)
+          out[xs, ys, zs, c] = block.transpose(2, 1, 0)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# skeleton + legacy mesh binaries
+
+
+def decode_precomputed_skeleton(data: bytes, vertex_attributes=()):
+  """Per the skeleton spec: nv u32, ne u32, positions f32*3nv,
+  edges u32*2ne, then attribute arrays in info order."""
+  nv, ne = struct.unpack_from("<II", data, 0)
+  pos = 8
+  vertices = np.frombuffer(data, "<f4", nv * 3, pos).reshape(nv, 3)
+  pos += 12 * nv
+  edges = np.frombuffer(data, "<u4", ne * 2, pos).reshape(ne, 2)
+  pos += 8 * ne
+  attrs = {}
+  for att in vertex_attributes:
+    dt = np.dtype(att["data_type"]).newbyteorder("<")
+    k = int(att["num_components"])
+    arr = np.frombuffer(data, dt, nv * k, pos)
+    attrs[att["id"]] = arr.reshape(nv, k) if k > 1 else arr
+    pos += dt.itemsize * nv * k
+  assert pos == len(data), (pos, len(data))
+  return vertices, edges, attrs
+
+
+def decode_legacy_mesh(data: bytes):
+  (nv,) = struct.unpack_from("<I", data, 0)
+  vertices = np.frombuffer(data, "<f4", nv * 3, 4).reshape(nv, 3)
+  faces = np.frombuffer(data, "<u4", -1, 4 + 12 * nv).reshape(-1, 3)
+  return vertices, faces
