@@ -243,54 +243,56 @@ def measure_transfer_MBps():
 
 
 def bench_mesh_kernel():
-  """BASELINE config 3: marching-tetrahedra count pass on a 256^3 mask
-  (the per-voxel device stage; emission is O(surface) host work)."""
-  import jax.numpy as jnp
-
+  """BASELINE config 3: marching-tetrahedra count pass, BATCHED — K masks
+  per shard_map dispatch (the per-voxel device stage; emission is
+  O(surface) host work)."""
   from igneous_tpu.ops.mesh import _count_kernel
+  from igneous_tpu.parallel.executor import BatchKernelExecutor
 
-  n = 128 if QUICK else 256
+  n = 64 if QUICK else 128
+  K = 4 if QUICK else 8
   g = np.indices((n, n, n)).astype(np.float32) - (n - 1) / 2
   mask = (np.sqrt((g**2).sum(0)) < n // 3).astype(np.uint8)
-  dev = jnp.asarray(mask.transpose(2, 1, 0))
+  batch = np.stack([mask.transpose(2, 1, 0)] * K)
+  ex = BatchKernelExecutor(_count_kernel)
 
-  def step():
-    cases, per, total = _count_kernel(dev)
-    return int(total)
-
-  step()  # compile
+  ex(batch)  # compile
   t0 = time.perf_counter()
-  iters = 3 if QUICK else 5
+  iters = 2 if QUICK else 4
   for _ in range(iters):
-    step()  # int(total) forces execution (scalar materialization)
+    ex(batch)
   dt = (time.perf_counter() - t0) / iters
-  return mask.size / dt
+  return batch.size / dt
 
 
 def bench_ccl_kernel():
-  """BASELINE config 4: block CCL (device) + host union-find merge."""
-  from igneous_tpu.ops.ccl import connected_components
+  """BASELINE config 4: block CCL, BATCHED — K cutouts per shard_map
+  dispatch (+ host renumber per chunk)."""
+  from igneous_tpu.ops.ccl import connected_components_batch
 
-  n = 128 if QUICK else 256
+  n = 64 if QUICK else 128
+  K = 4 if QUICK else 8
   rng = np.random.default_rng(0)
-  lab = (rng.integers(0, 3, (n, n, n)) * 7).astype(np.uint32)
-  connected_components(lab)  # compile
+  lab = (rng.integers(0, 3, (K, n, n, n)) * 7).astype(np.uint32)
+  connected_components_batch(lab)  # compile
   t0 = time.perf_counter()
-  connected_components(lab)
+  connected_components_batch(lab)
   dt = time.perf_counter() - t0
   return lab.size / dt
 
 
 def bench_edt_kernel():
-  """BASELINE config 5's device core: multilabel anisotropic EDT."""
-  from igneous_tpu.ops.edt import edt
+  """BASELINE config 5's device core: multilabel anisotropic EDT,
+  BATCHED — K cutouts per shard_map dispatch."""
+  from igneous_tpu.ops.edt import edt_batch
 
-  n = 96 if QUICK else 160
+  n = 64 if QUICK else 128
+  K = 4 if QUICK else 8
   rng = np.random.default_rng(0)
-  lab = (rng.integers(0, 3, (n, n, n)) * 9).astype(np.uint32)
-  edt(lab, (4, 4, 40))  # compile
+  lab = (rng.integers(0, 3, (K, n, n, n)) * 9).astype(np.uint32)
+  edt_batch(lab, (4, 4, 40))  # compile
   t0 = time.perf_counter()
-  edt(lab, (4, 4, 40))
+  edt_batch(lab, (4, 4, 40))
   dt = time.perf_counter() - t0
   return lab.size / dt
 

@@ -136,26 +136,72 @@ def connected_components(
     _ccl_kernel(dev, connectivity)
   ).transpose(2, 1, 0)  # (x, y, z)
 
-  big = np.iinfo(np.int32).max
-  fg = roots != big
-  out = np.zeros(labels.shape, dtype=np.uint32)
-  if fg.any():
-    # root values are flat indices in (z,y,x) C-order; renumber components
-    # in Fortran-scan first-appearance order for cc3d-like numbering
-    flat_f = roots.reshape(-1, order="F")
-    fg_f = fg.reshape(-1, order="F")
-    seen, first_pos = np.unique(flat_f[fg_f], return_index=True)
-    order = np.argsort(first_pos, kind="stable")
-    rank = np.empty(len(seen), dtype=np.uint32)
-    rank[order] = np.arange(1, len(seen) + 1, dtype=np.uint32)
-    comp = rank[np.searchsorted(seen, flat_f[fg_f])]
-    out_f = np.zeros(flat_f.shape, dtype=np.uint32)
-    out_f[fg_f] = comp
-    out = out_f.reshape(labels.shape, order="F")
+  out = _roots_to_components(roots)
   N = int(out.max())
   if return_N:
     return out, N
   return out
+
+
+def _roots_to_components(roots: np.ndarray) -> np.ndarray:
+  """Root flat-indices (x, y, z) → components renumbered 1..N in Fortran
+  (x-fastest) first-appearance order; background (sentinel) stays 0."""
+  big = np.iinfo(np.int32).max
+  fg = roots != big
+  if not fg.any():
+    return np.zeros(roots.shape, dtype=np.uint32)
+  flat_f = roots.reshape(-1, order="F")
+  fg_f = fg.reshape(-1, order="F")
+  seen, first_pos = np.unique(flat_f[fg_f], return_index=True)
+  order = np.argsort(first_pos, kind="stable")
+  rank = np.empty(len(seen), dtype=np.uint32)
+  rank[order] = np.arange(1, len(seen) + 1, dtype=np.uint32)
+  comp = rank[np.searchsorted(seen, flat_f[fg_f])]
+  out_f = np.zeros(flat_f.shape, dtype=np.uint32)
+  out_f[fg_f] = comp
+  return out_f.reshape(roots.shape, order="F")
+
+
+# executors (and their jit caches) are reused per connectivity so repeat
+# batches of the same shape never recompile
+_BATCH_EXECUTORS = {}
+
+
+def _batch_executor(connectivity: int):
+  if connectivity not in _BATCH_EXECUTORS:
+    from ..parallel.executor import BatchKernelExecutor
+
+    _BATCH_EXECUTORS[connectivity] = BatchKernelExecutor(
+      partial(_ccl_kernel, connectivity=connectivity)
+    )
+  return _BATCH_EXECUTORS[connectivity]
+
+
+def connected_components_batch(
+  labels_batch: np.ndarray, connectivity: int = 6, executor=None
+):
+  """Batched block CCL: (K, x, y, z) → list of K component volumes, each
+  numbered exactly as connected_components would number it alone.
+
+  One shard_map'd device dispatch labels all K cutouts with the chunk
+  axis partitioned across the mesh (SURVEY.md §5.8 / VERDICT item 3);
+  the per-chunk renumber stays host-side and is unchanged, so outputs are
+  byte-identical to the per-task path.
+  """
+  labels_batch = np.asarray(labels_batch)
+  if labels_batch.ndim != 4:
+    raise ValueError("labels_batch must be (K, x, y, z)")
+  uniq, inv = np.unique(labels_batch, return_inverse=True)
+  lab32 = inv.astype(np.int32).reshape(labels_batch.shape)
+  if uniq[0] != 0:
+    lab32 = lab32 + 1
+  dev = np.ascontiguousarray(lab32.transpose(0, 3, 2, 1))  # (K, z, y, x)
+  if executor is None:
+    executor = _batch_executor(connectivity)
+  roots = executor(dev)  # (K, z, y, x)
+  return [
+    _roots_to_components(np.asarray(r).transpose(2, 1, 0)) for r in roots
+  ]
 
 
 def threshold_image(

@@ -320,6 +320,7 @@ def skeletonize(
   parallel: int = 1,
   progress: bool = False,
   voxel_graph: Optional[np.ndarray] = None,
+  edt_field: Optional[np.ndarray] = None,
 ) -> Dict[int, Skeleton]:
   """Skeletonize every label in a volume → {label: Skeleton}.
 
@@ -334,7 +335,12 @@ def skeletonize(
   if labels.ndim == 4:
     labels = labels[..., 0]
 
-  whole_edt = device_edt(labels, anisotropy, black_border=True)
+  # the batched forge precomputes K cutouts' EDTs in one device dispatch
+  # and injects them here (edt_batch); solo tasks compute their own
+  whole_edt = (
+    edt_field if edt_field is not None
+    else device_edt(labels, anisotropy, black_border=True)
+  )
 
   from .remap import renumber as _renumber
 

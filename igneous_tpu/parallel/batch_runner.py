@@ -169,3 +169,161 @@ def batched_downsample(
       stats["edge_cutouts"] += 1
 
   return stats
+
+
+# ---------------------------------------------------------------------------
+# batched CCL + skeleton forges (VERDICT round-1 item 3: the lease-K →
+# one-dispatch pattern generalized beyond downsampling)
+
+
+def _chunked(items, size):
+  return [items[i : i + size] for i in range(0, len(items), size)]
+
+
+def batched_ccl_faces(
+  src_path: str,
+  mip: int = 0,
+  shape: Sequence[int] = (448, 448, 448),
+  batch_size: int = 8,
+  threshold_gte=None,
+  threshold_lte=None,
+  fill_missing: bool = False,
+  mesh=None,
+) -> dict:
+  """CCL pass 1 over a whole layer with batched device dispatches.
+
+  Consumes the same task grid create_ccl_face_tasks builds (identical
+  task_nums, offsets, and face outputs — later passes cannot tell the
+  difference). Cutouts stream through the batched CCL kernel in
+  prefetched groups per predicted shape (boundary tasks clamped along
+  the same dataset faces batch together); a shape with a single member
+  falls back to the per-task path.
+  """
+  from ..ops.ccl import _ccl_kernel, connected_components_batch
+  from ..storage import CloudFiles
+  from ..task_creation.ccl import create_ccl_face_tasks
+  from ..tasks.ccl import (
+    _offset_components,
+    _prep_ccl_image,
+    ccl_scratch_path,
+    store_ccl_faces,
+  )
+  from .executor import BatchKernelExecutor
+
+  tasks = list(create_ccl_face_tasks(
+    src_path, mip=mip, shape=shape, threshold_gte=threshold_gte,
+    threshold_lte=threshold_lte, fill_missing=fill_missing,
+  ))
+  files = CloudFiles(src_path)
+  scratch = ccl_scratch_path(src_path, mip)
+  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
+  executor = BatchKernelExecutor(_ccl_kernel, mesh=mesh)
+
+  # geometric pre-partition by PREDICTED cutout shape: boundary tasks
+  # clamped along the same dataset faces share shapes and batch together;
+  # only shapes with a single member run the plain task path
+  vol = Volume(src_path, mip=mip)
+  bounds = vol.meta.bounds(mip)
+  by_shape = {}
+  for t in tasks:
+    cutout = Bbox.intersection(Bbox(t.offset, t.offset + t.shape + 1), bounds)
+    by_shape.setdefault(tuple(cutout.size3()), []).append(t)
+
+  def prep(task):
+    img, cutout, core = _prep_ccl_image(
+      src_path, mip, task.shape, task.offset, fill_missing,
+      threshold_gte, threshold_lte,
+    )
+    return task, img, cutout, core
+
+  with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+    for shp, members in by_shape.items():
+      if len(members) == 1:
+        members[0].execute()
+        stats["edge_cutouts"] += 1
+        continue
+      groups = _chunked(members, batch_size)
+      # prefetch one group ahead: group i+1 downloads while i computes
+      pending = [io_pool.submit(prep, t) for t in groups[0]]
+      for i, group in enumerate(groups):
+        preps = [f.result() for f in pending]
+        pending = (
+          [io_pool.submit(prep, t) for t in groups[i + 1]]
+          if i + 1 < len(groups) else []
+        )
+        imgs = np.stack([p[1] for p in preps])
+        comps = connected_components_batch(imgs, executor=executor)
+        stats["dispatches"] += 1
+        for (task, _img, cutout, core), cc in zip(preps, comps):
+          cc = _offset_components(cc, task.task_num, task.shape)
+          store_ccl_faces(cc, cutout, core, task.task_num, files, scratch)
+          stats["batched_cutouts"] += 1
+  return stats
+
+
+def batched_skeleton_forge(
+  cloudpath: str,
+  mip: int = 0,
+  shape: Sequence[int] = (512, 512, 512),
+  batch_size: int = 4,
+  mesh=None,
+  **skeleton_kwargs,
+) -> dict:
+  """Skeleton forge with the flop-heavy EDT batched across K tasks.
+
+  Tasks stream in prefetched groups per predicted cutout shape: label
+  prep on IO threads, all K EDTs as ONE device dispatch
+  (ops.edt.edt_batch), then per-task host TEASAR + uploads via
+  SkeletonTask.execute(_prepared, _edt_field). Single-member shapes run
+  solo. Outputs are identical to solo task execution (edt_batch honors
+  the same backend dispatch as edt()).
+  """
+  from ..ops.edt import edt_batch
+  from ..task_creation.skeleton import create_skeletonizing_tasks
+
+  tasks = list(create_skeletonizing_tasks(
+    cloudpath, mip=mip, shape=shape, **skeleton_kwargs
+  ))
+  vol = Volume(cloudpath, mip=mip)
+  anis = tuple(float(v) for v in vol.resolution)
+  bounds = vol.meta.bounds(mip)
+  stats = {"batched_cutouts": 0, "solo_cutouts": 0, "dispatches": 0}
+
+  by_shape = {}
+  solo = []
+  for t in tasks:
+    core = Bbox.intersection(Bbox(t.offset, t.offset + t.shape), bounds)
+    if core.empty():
+      continue
+    cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
+    by_shape.setdefault(tuple(cutout.size3()), []).append(t)
+
+  def prep(task):
+    return task, task.prepare_labels(Volume(
+      cloudpath, mip=mip, fill_missing=task.fill_missing, bounded=False
+    ))
+
+  with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+    for shp, members in by_shape.items():
+      if len(members) == 1:
+        members[0].execute()
+        stats["solo_cutouts"] += 1
+        continue
+      groups = _chunked(members, batch_size)
+      pending = [io_pool.submit(prep, t) for t in groups[0]]
+      for i, group in enumerate(groups):
+        preps = [f.result() for f in pending]
+        pending = (
+          [io_pool.submit(prep, t) for t in groups[i + 1]]
+          if i + 1 < len(groups) else []
+        )
+        preps = [(t, p) for t, p in preps if p is not None]
+        if not preps:
+          continue
+        labels_batch = np.stack([p[0] for _, p in preps])
+        fields = edt_batch(labels_batch, anis, black_border=True)
+        stats["dispatches"] += 1
+        for (task, prepared), field in zip(preps, fields):
+          task.execute(_prepared=prepared, _edt_field=field)
+          stats["batched_cutouts"] += 1
+  return stats

@@ -27,6 +27,78 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "chunks") -> Mesh:
   return Mesh(np.asarray(devices), (axis,))
 
 
+class BatchKernelExecutor:
+  """shard_map + vmap wrapper for ANY per-chunk device kernel.
+
+  Generalizes ChunkExecutor's lease-K → one-dispatch pattern beyond
+  pooling (VERDICT round-1 item 3): the kernel is an arbitrary jax
+  function on one chunk (pytree in, pytree out, batch-uniform shapes);
+  this runs it for K chunks in a single compiled program with the chunk
+  axis partitioned across the mesh over ICI. Compiled variants are cached
+  per input signature.
+  """
+
+  def __init__(self, kernel, mesh: Optional[Mesh] = None):
+    self.kernel = kernel
+    self.mesh = mesh if mesh is not None else make_mesh()
+    self.axis = self.mesh.axis_names[0]
+    self._cache = {}
+
+  @property
+  def n_devices(self) -> int:
+    return int(np.prod(self.mesh.devices.shape))
+
+  def _signature(self, batch):
+    leaves, treedef = jax.tree.flatten(batch)
+    return (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
+
+  def _build(self, example):
+    out_shape = jax.eval_shape(jax.vmap(self.kernel), example)
+    out_specs = jax.tree.map(lambda _: P(self.axis), out_shape)
+    # check_vma off: kernels here are pure per-chunk programs with no
+    # collectives, but their internal scan/while carries start from
+    # literals, which the varying-manual-axes checker rejects under
+    # shard_map (carry input unvarying vs output varying)
+    try:
+      fn = jax.shard_map(
+        jax.vmap(self.kernel), mesh=self.mesh,
+        in_specs=P(self.axis), out_specs=out_specs, check_vma=False,
+      )
+    except TypeError:  # older jax: the parameter was named check_rep
+      fn = jax.shard_map(
+        jax.vmap(self.kernel), mesh=self.mesh,
+        in_specs=P(self.axis), out_specs=out_specs, check_rep=False,
+      )
+    return jax.jit(fn)
+
+  def __call__(self, batch):
+    """batch: pytree of (K, ...) arrays → pytree of (K, ...) numpy."""
+    batch = jax.tree.map(np.asarray, batch)
+    leaves = jax.tree.leaves(batch)
+    k = leaves[0].shape[0]
+    # canonical K: next power of two that is a mesh multiple. K is part
+    # of the jit-cache signature, so uncapped ragged group sizes (e.g.
+    # per-task label counts) would compile a program per K
+    canon = self.n_devices
+    while canon < k:
+      canon <<= 1
+    rem = canon - k
+    if rem:
+      batch = jax.tree.map(
+        lambda a: np.concatenate(
+          [a, np.zeros((rem,) + a.shape[1:], a.dtype)]
+        ),
+        batch,
+      )
+    sig = self._signature(batch)
+    if sig not in self._cache:
+      self._cache[sig] = self._build(batch)
+    sharding = NamedSharding(self.mesh, P(self.axis))
+    dev = jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+    out = self._cache[sig](dev)
+    return jax.tree.map(lambda a: np.asarray(a)[:k], out)
+
+
 class ChunkExecutor:
   """Compiles and runs batched chunk pyramids over a device mesh.
 

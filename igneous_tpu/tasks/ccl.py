@@ -70,6 +70,19 @@ def _download_and_ccl(
 ) -> Tuple[np.ndarray, Bbox, Bbox]:
   """The deterministic shared pass: cutout+1 → threshold → rails blackout
   → device CCL → +offset. Returns (labels_u64, cutout_bbox, core_bbox)."""
+  img, cutout, core = _prep_ccl_image(
+    src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte
+  )
+  cc = connected_components(img)
+  return _offset_components(cc, task_num, shape), cutout, core
+
+
+def _prep_ccl_image(
+  src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte
+) -> Tuple[np.ndarray, Bbox, Bbox]:
+  """Download + threshold + rails blackout (everything before the CCL
+  kernel) — the batched driver runs this per task and dispatches the CCL
+  for a whole batch at once."""
   vol = Volume(src_path, mip=mip, fill_missing=fill_missing, bounded=False)
   bounds = vol.meta.bounds(mip)
   core = Bbox.intersection(Bbox(offset, offset + shape), bounds)
@@ -88,10 +101,25 @@ def _download_and_ccl(
       ext[tuple(sl)] = 1
       ext_counts += ext
   img[ext_counts >= 2] = 0
+  return img, cutout, core
 
-  cc = connected_components(img).astype(np.uint64)
+
+def _offset_components(cc: np.ndarray, task_num: int, shape) -> np.ndarray:
+  cc = cc.astype(np.uint64)
   cc[cc != 0] += np.uint64(label_offset(task_num, shape))
-  return cc, cutout, core
+  return cc
+
+
+def store_ccl_faces(cc, cutout, core, task_num, cf, scratch):
+  """Upload the 3 overlap ('back') face planes (pass-1 output format)."""
+  for axis, name in enumerate("xyz"):
+    if cutout.maxpt[axis] > core.maxpt[axis]:
+      sl = [slice(None)] * 3
+      sl[axis] = int(cutout.size3()[axis]) - 1
+      cf.put(
+        f"{scratch}/faces/{task_num}-{name}.npy.gz",
+        _npy_bytes(cc[tuple(sl)]),
+      )
 
 
 class CCLFacesTask(RegisteredTask):
@@ -122,17 +150,10 @@ class CCLFacesTask(RegisteredTask):
       self.src_path, self.mip, self.shape, self.offset, self.task_num,
       self.fill_missing, self.threshold_gte, self.threshold_lte,
     )
-    cf = CloudFiles(self.src_path)
-    scratch = ccl_scratch_path(self.src_path, self.mip)
-    for axis, name in enumerate("xyz"):
-      if cutout.maxpt[axis] > core.maxpt[axis]:
-        sl = [slice(None)] * 3
-        sl[axis] = int(cutout.size3()[axis]) - 1
-        face = cc[tuple(sl)]
-        cf.put(
-          f"{scratch}/faces/{self.task_num}-{name}.npy.gz",
-          _npy_bytes(face),
-        )
+    store_ccl_faces(
+      cc, cutout, core, self.task_num, CloudFiles(self.src_path),
+      ccl_scratch_path(self.src_path, self.mip),
+    )
 
 
 class CCLEquivalancesTask(RegisteredTask):

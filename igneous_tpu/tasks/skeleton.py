@@ -221,15 +221,15 @@ class SkeletonTask(RegisteredTask):
         )
       skel.extra_attributes["cross_sectional_area"] = areas
 
-  def execute(self):
-    vol = Volume(
-      self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
-      bounded=False,
-    )
+  def prepare_labels(self, vol: "Volume"):
+    """Download + mask/dust/fill — everything before the EDT. Returns
+    (labels, cutout, core, bounds, local_dust) or None for empty cores.
+    The batched forge runs this per task, then dispatches all K tasks'
+    EDTs as one device program and injects them into execute()."""
     bounds = vol.meta.bounds(self.mip)
     core = Bbox.intersection(Bbox(self.offset, self.offset + self.shape), bounds)
     if core.empty():
-      return
+      return None
     # +1 overlap: adjacent tasks share their boundary plane
     # (reference tasks/skeleton.py:68-69)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
@@ -252,6 +252,17 @@ class SkeletonTask(RegisteredTask):
       from ..ops.morphology import fill_holes as _fill_holes
 
       labels = _fill_holes(labels)
+    return labels, cutout, core, bounds, local_dust
+
+  def execute(self, _prepared=None, _edt_field=None):
+    vol = Volume(
+      self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
+      bounded=False,
+    )
+    prepared = _prepared if _prepared is not None else self.prepare_labels(vol)
+    if prepared is None:
+      return
+    labels, cutout, core, bounds, local_dust = prepared
 
     targets = (
       border_targets(
@@ -286,6 +297,7 @@ class SkeletonTask(RegisteredTask):
       dust_threshold=local_dust,
       extra_targets_per_label=targets,
       parallel=self.parallel,
+      edt_field=_edt_field,
     )
 
     # type the synapse vertices for SWC export (reference swc_label)

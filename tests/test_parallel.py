@@ -107,3 +107,106 @@ def test_batched_downsample_odd_edges(tmp_path, rng):
   exp = oracle.np_downsample_with_averaging(data, (2, 2, 1), 1)[0]
   out = vol.download(vol.meta.bounds(1), mip=1)
   assert np.array_equal(out[..., 0], exp)
+
+
+# ---------------------------------------------------------------------------
+# batched kernels beyond downsampling (VERDICT round-1 item 3)
+
+
+def test_connected_components_batch_matches_solo(rng):
+  from igneous_tpu.ops.ccl import (
+    connected_components,
+    connected_components_batch,
+  )
+
+  batch = (rng.integers(0, 3, (5, 20, 18, 14)) * 7).astype(np.uint32)
+  outs = connected_components_batch(batch)
+  for k in range(5):
+    solo = connected_components(batch[k])
+    assert np.array_equal(outs[k], solo)
+
+
+def test_edt_batch_matches_solo(rng, monkeypatch):
+  from igneous_tpu.ops.edt import edt, edt_batch
+
+  monkeypatch.setenv("IGNEOUS_EDT_BACKEND", "device")
+  batch = (rng.integers(0, 3, (4, 16, 14, 12)) * 9).astype(np.uint32)
+  outs = edt_batch(batch, (4, 4, 40), black_border=True)
+  for k in range(4):
+    solo = edt(batch[k], (4, 4, 40), black_border=True)
+    assert np.allclose(outs[k], solo, atol=1e-3)
+
+
+def test_marching_tetrahedra_batch_matches_solo(rng):
+  from igneous_tpu.ops.mesh import (
+    marching_tetrahedra,
+    marching_tetrahedra_batch,
+  )
+
+  masks = []
+  for n in (10, 14, 18, 11):  # mixed shape buckets
+    g = np.indices((n, n, n)).astype(np.float32) - (n - 1) / 2
+    masks.append((np.sqrt((g**2).sum(0)) < n // 3).astype(np.uint8))
+  offsets = [(0, 0, 0), (5, 0, 0), (0, 7, 0), (1, 2, 3)]
+  batch = marching_tetrahedra_batch(masks, (2, 2, 2), offsets)
+  for mask, off, (bv, bf) in zip(masks, offsets, batch):
+    sv, sf = marching_tetrahedra(mask, (2, 2, 2), off)
+    assert np.array_equal(bv, sv)
+    assert np.array_equal(bf, sf)
+
+
+def test_batched_ccl_faces_matches_task_path(rng, tmp_path):
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.parallel.batch_runner import batched_ccl_faces
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.volume import Volume
+
+  img = (rng.random((192, 64, 64)) < 0.3).astype(np.uint8) * 200
+  pa = f"file://{tmp_path}/a"
+  pb = f"file://{tmp_path}/b"
+  for p in (pa, pb):
+    Volume.from_numpy(img, p, resolution=(8, 8, 8), chunk_size=(64, 64, 64))
+  LocalTaskQueue(parallel=1, progress=False).insert(
+    tc.create_ccl_face_tasks(pa, shape=(64, 64, 64), threshold_gte=100)
+  )
+  stats = batched_ccl_faces(
+    pb, shape=(64, 64, 64), threshold_gte=100, batch_size=4
+  )
+  assert stats["batched_cutouts"] > 0
+  va, vb = Volume(pa), Volume(pb)
+  keys_a = sorted(k for k in va.cf.list("") if "/faces/" in k)
+  keys_b = sorted(k for k in vb.cf.list("") if "/faces/" in k)
+  assert keys_a and [k for k in keys_a] == [k for k in keys_b]
+  for k in keys_a:
+    assert va.cf.get(k) == vb.cf.get(k), k
+
+
+def test_batched_skeleton_forge_matches_task_path(tmp_path):
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.parallel.batch_runner import batched_skeleton_forge
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.volume import Volume
+
+  data = np.zeros((128, 32, 32), np.uint64)
+  data[4:124, 10:22, 10:22] = 55
+  data[30:60, 2:8, 2:8] = 77
+  pa = f"file://{tmp_path}/a"
+  pb = f"file://{tmp_path}/b"
+  for p in (pa, pb):
+    Volume.from_numpy(data, p, resolution=(16, 16, 16),
+                      layer_type="segmentation", chunk_size=(32, 32, 32))
+  kwargs = dict(
+    shape=(32, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+  )
+  LocalTaskQueue(parallel=1, progress=False).insert(
+    tc.create_skeletonizing_tasks(pa, **kwargs))
+  stats = batched_skeleton_forge(pb, batch_size=4, **kwargs)
+  assert stats["batched_cutouts"] > 0
+  va, vb = Volume(pa), Volume(pb)
+  sdir = va.info["skeletons"]
+  keys_a = sorted(k for k in va.cf.list(f"{sdir}/") if k.endswith(".sk"))
+  keys_b = sorted(k for k in vb.cf.list(f"{sdir}/") if k.endswith(".sk"))
+  assert keys_a and keys_a == keys_b
+  for k in keys_a:
+    assert va.cf.get(k) == vb.cf.get(k), k
