@@ -153,16 +153,16 @@ def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
               type=click.Choice(["image", "segmentation"]))
 @click.option("--encoding", default="raw", show_default=True)
 def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding):
-  """Ingest a .npy array file as a Precomputed layer
-  (reference `igneous image create`, cli.py:1852-1923)."""
-  import numpy as np
-
+  """Ingest an array file (npy/npy.gz/nrrd/nii/nii.gz) as a Precomputed
+  layer (reference `igneous image create`, cli.py:1852-1923; h5/ckl need
+  their libraries and fail with instructions)."""
+  from .formats import load_volume_file
   from .volume import Volume
 
-  if src.endswith(".npy"):
-    arr = np.load(src)
-  else:
-    raise click.UsageError("Only .npy ingest is supported in this build")
+  try:
+    arr = load_volume_file(src)
+  except ValueError as e:
+    raise click.UsageError(str(e))
   Volume.from_numpy(
     arr, dest, resolution=resolution, voxel_offset=offset,
     chunk_size=chunk_size, layer_type=layer_type, encoding=encoding,
@@ -672,14 +672,19 @@ def skeleton_convert(path, out_dir, skel_dir, labels):
   click.echo(f"wrote {n} swc files to {out_dir}")
 
 
-@skeleton.command("spatial-index")
+@skeleton.group("spatial-index")
+def skeleton_spatial_index():
+  """Skeleton spatial-index maintenance."""
+
+
+@skeleton_spatial_index.command("create")
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
 @click.option("--skel-dir", default=None)
 @click.pass_context
-def skeleton_spatial_index(ctx, path, queue, mip, shape, skel_dir):
+def skeleton_spatial_index_create(ctx, path, queue, mip, shape, skel_dir):
   """Rebuild the skeleton spatial index."""
   from . import task_creation as tc
   from .tasks.skeleton import skel_dir_for
@@ -689,6 +694,23 @@ def skeleton_spatial_index(ctx, path, queue, mip, shape, skel_dir):
   enqueue(queue, tc.create_spatial_index_tasks(path, sdir, mip=mip,
                                                shape=shape),
           ctx.obj["parallel"])
+
+
+@skeleton_spatial_index.command("db")
+@click.argument("path")
+@click.argument("db_path", type=click.Path())
+@click.option("--skel-dir", default=None)
+def skeleton_spatial_index_db(path, db_path, skel_dir):
+  """Materialize the skeleton spatial index into a sqlite database
+  (reference `igneous skeleton spatial-index db`, cli.py:1565-1586)."""
+  from .spatial_index import SpatialIndex
+  from .tasks.skeleton import skel_dir_for
+  from .volume import Volume
+
+  vol = Volume(path)
+  sdir = skel_dir_for(vol, skel_dir)
+  n = SpatialIndex(vol.cf, sdir).to_sqlite(db_path)
+  click.echo(f"wrote {n} rows to {db_path}")
 
 
 @skeleton.command("clean")
@@ -847,6 +869,31 @@ def queue_status(queue_spec, eta, sample_sec):
     stats = queue_eta(tq, sample_seconds=sample_sec)
     click.echo(f"tasks/sec: {stats['tasks_per_sec']}")
     click.echo(f"eta_sec: {stats['eta_sec']}")
+
+
+@queue_group.command("wait")
+@click.argument("queue_spec")
+@click.option("--interval", default=5.0, show_default=True,
+              help="seconds between checks")
+@click.option("--timeout", default=None, type=float,
+              help="give up after this many seconds")
+def queue_wait(queue_spec, interval, timeout):
+  """Block until the queue is empty (reference `igneous queue wait`,
+  cli.py:1974). Uses the backend's own emptiness semantics — for sqs://
+  that includes the eventual-consistency double-confirmation."""
+  import time as _time
+
+  from .queues import TaskQueue
+
+  q = TaskQueue(queue_spec)
+  t0 = _time.monotonic()
+  while True:
+    if q.is_empty():
+      click.echo("queue empty")
+      return
+    if timeout is not None and _time.monotonic() - t0 > timeout:
+      raise click.ClickException(f"queue not empty after {timeout}s")
+    _time.sleep(interval)
 
 
 @queue_group.command("release")

@@ -1,0 +1,97 @@
+"""In-RAM compressed label volumes with lazy per-label access.
+
+The memory-stretch patterns that make 512^3 skeleton tasks fit in worker
+RAM (SURVEY.md §5.7(d,e); reference: crackle compression of the live
+cutout at /root/reference/igneous/tasks/skeleton.py:197-199 and lazy
+per-label iteration for the low-memory cross-section path at
+:477-527). Here the representation is this package's own
+compressed_segmentation codec, whose block LUT layout gives true random
+access: a per-label mask decodes only the blocks of that label's
+bounding box, never the whole cutout.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+from scipy import ndimage
+
+from . import cseg
+
+
+class CompressedLabels:
+  """One label cutout, cseg-compressed in RAM.
+
+  Construction makes a single pass to record per-label bounding boxes,
+  then holds only the compressed payload (typically 5-50x smaller than
+  raw for segmentation). ``mask(label)`` and ``each()`` decode O(label
+  bbox) voxels via cseg's block random access.
+  """
+
+  def __init__(self, labels: np.ndarray, block_size=(8, 8, 8)):
+    if labels.ndim != 3:
+      raise ValueError("labels must be (x, y, z)")
+    self.shape = tuple(int(s) for s in labels.shape)
+    self.dtype = labels.dtype
+    self.block_size = tuple(int(b) for b in block_size)
+    self._payload = cseg.compress(labels[..., None], self.block_size)
+
+    from .ops.remap import renumber
+
+    dense, mapping = renumber(labels)
+    slices = ndimage.find_objects(dense.astype(np.int32))
+    self._bboxes: Dict[int, Tuple[slice, slice, slice]] = {}
+    for new_id, sl in enumerate(slices, start=1):
+      if sl is None:
+        continue
+      orig = int(mapping[new_id])
+      if orig != 0:
+        self._bboxes[orig] = sl
+
+  @property
+  def nbytes(self) -> int:
+    return len(self._payload)
+
+  @property
+  def raw_nbytes(self) -> int:
+    return int(np.prod(self.shape)) * np.dtype(self.dtype).itemsize
+
+  def labels(self):
+    return sorted(self._bboxes.keys())
+
+  def bbox(self, label: int) -> Optional[Tuple[slice, slice, slice]]:
+    return self._bboxes.get(int(label))
+
+  def decompress(self) -> np.ndarray:
+    return cseg.decompress(
+      self._payload, self.shape + (1,), self.dtype, self.block_size
+    )[..., 0]
+
+  def region(self, lo, hi) -> np.ndarray:
+    return cseg.decompress_region(
+      self._payload, self.shape + (1,), self.dtype, lo, hi,
+      self.block_size,
+    )
+
+  def mask(self, label: int, margin: int = 0):
+    """(bool mask over the label's bbox + margin, (lo offset)) or None.
+
+    Decodes only the covering blocks — the low-memory per-label path."""
+    sl = self._bboxes.get(int(label))
+    if sl is None:
+      return None
+    lo = [max(0, s.start - margin) for s in sl]
+    hi = [min(d, s.stop + margin) for s, d in zip(sl, self.shape)]
+    region = self.region(lo, hi)
+    return region == np.asarray(label, dtype=self.dtype), tuple(lo)
+
+  def each(self, labels=None) -> Iterator:
+    """Yield (label, mask, lo_offset) lazily — the iteration pattern of
+    the reference's crackle ``.each()`` loop."""
+    for label in (labels if labels is not None else self.labels()):
+      got = self.mask(int(label))
+      if got is None:
+        continue
+      mask, lo = got
+      yield int(label), mask, lo

@@ -280,3 +280,78 @@ def decompress(
           bi += 1
 
   return out.astype(dtype)
+
+
+def decompress_region(
+  data: bytes,
+  shape: Sequence[int],
+  dtype,
+  lo: Sequence[int],
+  hi: Sequence[int],
+  block_size: Sequence[int] = (8, 8, 8),
+  channel: int = 0,
+) -> np.ndarray:
+  """Decode only the blocks overlapping [lo, hi) → (hi-lo) (x, y, z) array.
+
+  The random-access path that makes compressed_segmentation usable as an
+  IN-RAM representation (reference: crackle's lazy per-label reads,
+  /root/reference/igneous/tasks/skeleton.py:477-527): per-label masks
+  decode O(label bbox) voxels, never the whole cutout.
+  """
+  words = np.frombuffer(bytearray(data), dtype=np.uint32)
+  sx, sy, sz, num_channels = [int(v) for v in shape]
+  bx, by, bz = [int(b) for b in block_size]
+  gx, gy, gz = -(-sx // bx), -(-sy // by), -(-sz // bz)
+  lo = [max(0, int(v)) for v in lo]
+  hi = [min(s, int(v)) for s, v in zip((sx, sy, sz), hi)]
+  out = np.zeros(
+    (hi[0] - lo[0], hi[1] - lo[1], hi[2] - lo[2]), dtype=dtype
+  )
+  if out.size == 0:
+    return out
+  base = int(words[channel])
+  is64 = np.dtype(dtype).itemsize == 8
+
+  for bzi in range(lo[2] // bz, -(-hi[2] // bz)):
+    for byi in range(lo[1] // by, -(-hi[1] // by)):
+      for bxi in range(lo[0] // bx, -(-hi[0] // bx)):
+        bidx = bxi + gx * (byi + gy * bzi)
+        w0 = int(words[base + 2 * bidx])
+        w1 = int(words[base + 2 * bidx + 1])
+        table_off = w0 & 0xFFFFFF
+        bits = (w0 >> 24) & 0xFF
+        dx = min(bx, sx - bxi * bx)
+        dy = min(by, sy - byi * by)
+        dz = min(bz, sz - bzi * bz)
+        nvox = dx * dy * dz
+        if bits == 0:
+          packed = np.zeros(nvox, dtype=np.int64)
+        else:
+          vals_per_word = 32 // bits
+          nwords = -(-nvox // vals_per_word)
+          enc = words[base + w1 : base + w1 + nwords]
+          pos = np.arange(nvox)
+          packed = (
+            (enc[pos // vals_per_word] >> ((pos % vals_per_word) * bits))
+            & np.uint32((1 << bits) - 1)
+          ).astype(np.int64)
+        if is64:
+          lo32 = words[base + table_off + 2 * packed]
+          hi32 = words[base + table_off + 2 * packed + 1]
+          vals = lo32.astype(np.uint64) | (
+            hi32.astype(np.uint64) << np.uint64(32)
+          )
+        else:
+          vals = words[base + table_off + packed]
+        block = vals.astype(dtype).reshape((dx, dy, dz), order="F")
+        x0, y0, z0 = bxi * bx, byi * by, bzi * bz
+        src = tuple(
+          slice(max(lo[a] - o, 0), min(hi[a] - o, d))
+          for a, (o, d) in enumerate(((x0, dx), (y0, dy), (z0, dz)))
+        )
+        dst = tuple(
+          slice(max(o - lo[a], 0), max(o - lo[a], 0) + (s.stop - s.start))
+          for a, (o, s) in enumerate(((x0, src[0]), (y0, src[1]), (z0, src[2])))
+        )
+        out[dst] = block[src]
+  return out

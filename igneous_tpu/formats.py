@@ -1,0 +1,139 @@
+"""From-spec ingest readers for volumetric file formats.
+
+Capability parity with the reference's `igneous image create`
+(/root/reference/igneous_cli/cli.py:1852-1923), which accepts
+npy/h5/nii/nrrd/ckl. This environment ships neither h5py, nibabel,
+pynrrd, nor crackle, so: NRRD and NIfTI-1 are implemented here directly
+against their published specifications (both are simple
+header-plus-raw-array containers); HDF5 and crackle require their
+libraries and raise with instructions.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+_NRRD_DTYPES = {
+  "signed char": np.int8, "int8": np.int8, "int8_t": np.int8,
+  "uchar": np.uint8, "unsigned char": np.uint8, "uint8": np.uint8,
+  "uint8_t": np.uint8,
+  "short": np.int16, "int16": np.int16, "int16_t": np.int16,
+  "ushort": np.uint16, "uint16": np.uint16, "uint16_t": np.uint16,
+  "int": np.int32, "int32": np.int32, "int32_t": np.int32,
+  "uint": np.uint32, "uint32": np.uint32, "uint32_t": np.uint32,
+  "longlong": np.int64, "int64": np.int64, "int64_t": np.int64,
+  "ulonglong": np.uint64, "uint64": np.uint64, "uint64_t": np.uint64,
+  "float": np.float32, "double": np.float64,
+}
+
+
+def load_nrrd(path: str) -> np.ndarray:
+  """Minimal NRRD reader (the teem NRRD0004 spec): text header lines up
+  to a blank line, then the data blob. Supports raw/gzip encodings and
+  little/big endian; returns the array in header axis order (NRRD is
+  x-fastest, matching this package's (x, y, z) convention)."""
+  with open(path, "rb") as f:
+    blob = f.read()
+  header_end = blob.find(b"\n\n")
+  if header_end < 0:
+    raise ValueError("malformed NRRD: no blank line terminating header")
+  lines = blob[:header_end].decode("ascii", "replace").splitlines()
+  if not lines or not lines[0].startswith("NRRD"):
+    raise ValueError("not a NRRD file")
+  fields = {}
+  for line in lines[1:]:
+    if line.startswith("#") or ":" not in line:
+      continue
+    key, val = line.split(":", 1)
+    fields[key.strip().lower()] = val.strip().lstrip("=").strip()
+  dtype = _NRRD_DTYPES.get(fields.get("type", ""))
+  if dtype is None:
+    raise ValueError(f"unsupported NRRD type: {fields.get('type')!r}")
+  if "sizes" not in fields:
+    raise ValueError("malformed NRRD: missing required 'sizes' field")
+  sizes = [int(v) for v in fields["sizes"].split()]
+  encoding = fields.get("encoding", "raw").lower()
+  data = blob[header_end + 2:]
+  if encoding in ("gzip", "gz"):
+    data = gzip.decompress(data)
+  elif encoding != "raw":
+    raise ValueError(f"unsupported NRRD encoding: {encoding!r}")
+  endian = fields.get("endian", "little")
+  dt = np.dtype(dtype).newbyteorder("<" if endian == "little" else ">")
+  n = int(np.prod(sizes))
+  arr = np.frombuffer(data, dtype=dt, count=n)
+  # NRRD stores the FIRST size fastest; Fortran order puts axis 0 fastest
+  return arr.reshape(sizes, order="F").astype(dtype, copy=False)
+
+
+def load_nifti(path: str) -> np.ndarray:
+  """Minimal NIfTI-1 reader (.nii / .nii.gz, single-file form): 348-byte
+  header + voxel data at vox_offset. Returns the (x, y, z[, t]) array
+  (NIfTI data is x-fastest / Fortran order)."""
+  with open(path, "rb") as f:
+    blob = f.read()
+  if path.endswith(".gz") or blob[:2] == b"\x1f\x8b":
+    blob = gzip.decompress(blob)
+  if len(blob) < 352:
+    raise ValueError("truncated NIfTI file")
+  (sizeof_hdr,) = struct.unpack_from("<i", blob, 0)
+  bo = "<"
+  if sizeof_hdr != 348:
+    (sizeof_hdr,) = struct.unpack_from(">i", blob, 0)
+    if sizeof_hdr != 348:
+      raise ValueError("not a NIfTI-1 file (bad sizeof_hdr)")
+    bo = ">"
+  magic = blob[344:348]
+  if magic == b"ni1\x00":
+    raise ValueError(
+      "two-file NIfTI (.hdr/.img pair) is not supported — the voxel data "
+      "lives in a separate .img file; convert to single-file .nii first"
+    )
+  if magic != b"n+1\x00":
+    raise ValueError(f"not a single-file NIfTI-1 (magic {magic!r})")
+  dim = struct.unpack_from(bo + "8h", blob, 40)
+  ndim = max(1, min(int(dim[0]), 7))
+  shape = [max(1, int(d)) for d in dim[1:1 + ndim]]
+  (datatype,) = struct.unpack_from(bo + "h", blob, 70)
+  (vox_offset,) = struct.unpack_from(bo + "f", blob, 108)
+  dtypes = {
+    2: np.uint8, 4: np.int16, 8: np.int32, 16: np.float32,
+    64: np.float64, 256: np.int8, 512: np.uint16, 768: np.uint32,
+    1024: np.int64, 1280: np.uint64,
+  }
+  if datatype not in dtypes:
+    raise ValueError(f"unsupported NIfTI datatype code: {datatype}")
+  dt = np.dtype(dtypes[datatype]).newbyteorder(bo)
+  n = int(np.prod(shape))
+  arr = np.frombuffer(blob, dtype=dt, count=n, offset=int(vox_offset))
+  return arr.reshape(shape, order="F").astype(dtypes[datatype], copy=False)
+
+
+def load_volume_file(path: str) -> np.ndarray:
+  """Route an ingest file by extension (reference cli.py:1852-1923)."""
+  low = path.lower()
+  if low.endswith(".npy"):
+    return np.load(path)
+  if low.endswith(".npy.gz"):
+    import io
+
+    with open(path, "rb") as f:
+      return np.load(io.BytesIO(gzip.decompress(f.read())))
+  if low.endswith(".nrrd"):
+    return load_nrrd(path)
+  if low.endswith((".nii", ".nii.gz")):
+    return load_nifti(path)
+  if low.endswith((".h5", ".hdf5")):
+    raise ValueError(
+      "HDF5 ingest needs h5py, which this environment does not ship; "
+      "convert to .npy/.nrrd/.nii first (np.save(...) from any h5 reader)."
+    )
+  if low.endswith(".ckl"):
+    raise ValueError(
+      "crackle (.ckl) ingest needs the crackle-codec package; decompress "
+      "to .npy first."
+    )
+  raise ValueError(f"unrecognized volume file extension: {path}")

@@ -43,6 +43,7 @@ def create_skeletonizing_tasks(
   fix_borders: bool = True,
   fill_holes: bool = False,
   cross_sectional_area: bool = False,
+  low_memory_csa: bool = False,
   synapses: Optional[dict] = None,
   parallel: int = 1,
   bounds: Optional[Bbox] = None,
@@ -153,6 +154,7 @@ def create_skeletonizing_tasks(
       fix_borders=fix_borders,
       fill_holes=fill_holes,
       cross_sectional_area=cross_sectional_area,
+      low_memory_csa=low_memory_csa,
       extra_targets=task_targets(offset, shape_),
       parallel=parallel,
     )

