@@ -161,7 +161,7 @@ def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding
 
   try:
     arr = load_volume_file(src)
-  except ValueError as e:
+  except (ValueError, OSError) as e:  # OSError: corrupt gzip members
     raise click.UsageError(str(e))
   Volume.from_numpy(
     arr, dest, resolution=resolution, voxel_offset=offset,
@@ -886,14 +886,17 @@ def queue_wait(queue_spec, interval, timeout):
   from .queues import TaskQueue
 
   q = TaskQueue(queue_spec)
-  t0 = _time.monotonic()
+  deadline = None if timeout is None else _time.monotonic() + timeout
   while True:
     if q.is_empty():
       click.echo("queue empty")
       return
-    if timeout is not None and _time.monotonic() - t0 > timeout:
+    now = _time.monotonic()
+    if deadline is not None and now >= deadline:
       raise click.ClickException(f"queue not empty after {timeout}s")
-    _time.sleep(interval)
+    # never sleep past the deadline (a long --interval must not make the
+    # command overshoot --timeout)
+    _time.sleep(interval if deadline is None else min(interval, deadline - now))
 
 
 @queue_group.command("release")

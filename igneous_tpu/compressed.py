@@ -15,7 +15,6 @@ from __future__ import annotations
 from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
-from scipy import ndimage
 
 from . import cseg
 
@@ -37,17 +36,11 @@ class CompressedLabels:
     self.block_size = tuple(int(b) for b in block_size)
     self._payload = cseg.compress(labels[..., None], self.block_size)
 
-    from .ops.remap import renumber
+    from .ops.remap import label_bboxes
 
-    dense, mapping = renumber(labels)
-    slices = ndimage.find_objects(dense.astype(np.int32))
-    self._bboxes: Dict[int, Tuple[slice, slice, slice]] = {}
-    for new_id, sl in enumerate(slices, start=1):
-      if sl is None:
-        continue
-      orig = int(mapping[new_id])
-      if orig != 0:
-        self._bboxes[orig] = sl
+    self._bboxes: Dict[int, Tuple[slice, slice, slice]] = {
+      k: sl for k, sl in label_bboxes(labels).items() if k != 0
+    }
 
   @property
   def nbytes(self) -> int:

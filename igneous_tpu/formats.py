@@ -37,7 +37,14 @@ def load_nrrd(path: str) -> np.ndarray:
   x-fastest, matching this package's (x, y, z) convention)."""
   with open(path, "rb") as f:
     blob = f.read()
+  # the spec permits LF or CRLF line endings; the header ends at the
+  # first blank line either way
   header_end = blob.find(b"\n\n")
+  data_start = header_end + 2
+  crlf_end = blob.find(b"\r\n\r\n")
+  if crlf_end >= 0 and (header_end < 0 or crlf_end < header_end):
+    header_end = crlf_end
+    data_start = crlf_end + 4
   if header_end < 0:
     raise ValueError("malformed NRRD: no blank line terminating header")
   lines = blob[:header_end].decode("ascii", "replace").splitlines()
@@ -56,7 +63,7 @@ def load_nrrd(path: str) -> np.ndarray:
     raise ValueError("malformed NRRD: missing required 'sizes' field")
   sizes = [int(v) for v in fields["sizes"].split()]
   encoding = fields.get("encoding", "raw").lower()
-  data = blob[header_end + 2:]
+  data = blob[data_start:]
   if encoding in ("gzip", "gz"):
     data = gzip.decompress(data)
   elif encoding != "raw":

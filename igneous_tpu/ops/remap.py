@@ -116,3 +116,27 @@ def fit_dtype(dtype, value: int):
     if value <= np.iinfo(candidate).max:
       return candidate
   raise ValueError(f"{value} does not fit any {kind} dtype")
+
+
+def label_bboxes(labels: np.ndarray):
+  """{original label: (slice, slice, slice)} bounding boxes, one pass.
+
+  Shared by the skeleton CSA branch and CompressedLabels so the
+  renumber+find_objects recipe lives in one place; transient memory is
+  one dense volume at the minimal renumbered dtype (a uint32 view feeds
+  find_objects without an extra int32 copy)."""
+  from scipy import ndimage
+
+  dense, mapping = renumber(labels)
+  if dense.dtype == np.uint32:
+    dense_i = dense.view(np.int32)  # renumbered ids are far below 2^31
+  elif dense.dtype.kind != "i":
+    dense_i = dense.astype(np.int32)
+  else:
+    dense_i = dense
+  slices = ndimage.find_objects(dense_i)
+  return {
+    int(mapping[new_id]): sl
+    for new_id, sl in enumerate(slices, start=1)
+    if sl is not None
+  }

@@ -352,10 +352,7 @@ class SkeletonTask(RegisteredTask):
           skel.extra_attributes["cross_sectional_area"] = areas
         del comp  # repair re-downloads its own context regions
       else:
-        dense, mapping = fastremap.renumber(labels)
-        slices = ndimage.find_objects(dense.astype(np.int32))
-        by_orig = {mapping[new_id]: sl for new_id, sl in
-                   enumerate(slices, start=1) if sl is not None}
+        by_orig = fastremap.label_bboxes(labels)
         for label, skel in skels.items():
           sl = by_orig.get(int(label))
           if sl is None:
