@@ -278,10 +278,13 @@ def graph_bit(off) -> int:
 
 
 def voxel_connectivity_graph(
-  labels: np.ndarray, connectivity: int = 26
+  labels: np.ndarray, connectivity: int = 26, pair_allowed=None
 ) -> np.ndarray:
   """Per-voxel uint32 bitfield: bit set when the neighbor in that
-  direction is in-bounds and holds the same nonzero label.
+  direction is in-bounds and connected — by default, holds the same
+  nonzero label; ``pair_allowed(src_vals, dst_vals) -> bool array``
+  substitutes a custom predicate (the graphene chunk-graph uses edge-set
+  membership).
 
   Capability parity with cc3d.voxel_connectivity_graph (used by the
   reference's graphene autapse fix, /root/reference/igneous/tasks/
@@ -304,8 +307,11 @@ def voxel_connectivity_graph(
       slice(max(0, d), labels.shape[a] - max(0, -d))
       for a, d in enumerate(off)
     )
-    same = fg[src] & (labels[src] == labels[dst])
-    out[src] |= same.astype(np.uint32) << np.uint32(graph_bit(off))
+    if pair_allowed is None:
+      conn = fg[src] & (labels[src] == labels[dst])
+    else:
+      conn = fg[src] & pair_allowed(labels[src], labels[dst])
+    out[src] |= conn.astype(np.uint32) << np.uint32(graph_bit(off))
   return out
 
 

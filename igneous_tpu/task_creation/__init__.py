@@ -32,6 +32,7 @@ from .mesh import (
   create_mesh_deletion_tasks,
   create_mesh_manifest_tasks,
   create_mesh_transfer_tasks,
+  create_graphene_meshing_tasks,
   create_meshing_tasks,
   create_sharded_multires_mesh_from_unsharded_tasks,
   create_sharded_multires_mesh_tasks,

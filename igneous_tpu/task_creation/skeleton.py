@@ -47,6 +47,7 @@ def create_skeletonizing_tasks(
   synapses: Optional[dict] = None,
   parallel: int = 1,
   bounds: Optional[Bbox] = None,
+  timestamp: Optional[float] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
   vertex_attributes (reference :68-388)."""
@@ -157,6 +158,7 @@ def create_skeletonizing_tasks(
       low_memory_csa=low_memory_csa,
       extra_targets=task_targets(offset, shape_),
       parallel=parallel,
+      timestamp=timestamp,
     )
 
   def finish():

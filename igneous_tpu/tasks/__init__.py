@@ -19,6 +19,7 @@ from .image_sharded import ImageShardDownsampleTask, ImageShardTransferTask
 from .ccl import CCLEquivalancesTask, CCLFacesTask, RelabelCCLTask
 from .mesh import (
   DeleteMeshFilesTask,
+  GrapheneMeshTask,
   MeshManifestFilesystemTask,
   MeshManifestPrefixTask,
   MeshTask,

@@ -59,6 +59,7 @@ class MeshTask(RegisteredTask):
     sharded: bool = False,
     closed_dataset_edges: bool = True,
     fill_holes: int = 0,
+    timestamp: Optional[float] = None,
   ):
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
@@ -75,6 +76,7 @@ class MeshTask(RegisteredTask):
     self.sharded = sharded
     self.closed_dataset_edges = closed_dataset_edges
     self.fill_holes = int(fill_holes)
+    self.timestamp = timestamp
 
   def execute(self):
     vol = Volume(
@@ -88,7 +90,15 @@ class MeshTask(RegisteredTask):
     # 1-voxel high-side overlap: adjacent tasks share a boundary plane so
     # their surfaces meet exactly (reference mesh.py:64-69,155-160)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
-    img = vol.download(cutout)[..., 0]
+    if vol.graphene is not None:
+      # graphene volumes mesh at L2 granularity (reference
+      # GrapheneMeshTask, mesh.py:466-622): stable chunk-local ids whose
+      # meshes the proofreading frontend stitches per root
+      img = vol.download(
+        cutout, stop_layer=2, timestamp=self.timestamp
+      )[..., 0]
+    else:
+      img = vol.download(cutout)[..., 0]
 
     if self.object_ids:
       img = fastremap.mask_except(img, self.object_ids)
@@ -249,3 +259,44 @@ def TransferMeshFilesTask(
 def DeleteMeshFilesTask(cloudpath: str, mesh_dir: str, prefix: str = ""):
   cf = CloudFiles(cloudpath)
   cf.delete(list(cf.list(f"{mesh_dir}/{prefix}")))
+
+
+class GrapheneMeshTask(MeshTask):
+  """Mesh forge for graphene:// proofreading volumes — reference
+  GrapheneMeshTask (/root/reference/igneous/tasks/mesh/mesh.py:466-622).
+
+  Identical pipeline to MeshTask except the cutout downloads at L2
+  granularity (stop_layer=2, stable per-(root, chunk) ids via the
+  chunk-graph client) and defaults to draco-encoded sharded .frags — the
+  stage-1 payload the proofreading frontend's per-root stitcher consumes.
+  The 1-voxel overlap plus identical L2 ids on shared planes make
+  adjacent chunk meshes weld exactly (the role of the reference's
+  mesh_graphene_remap overlap relabeling).
+  """
+
+  def __init__(
+    self,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    layer_path: str,
+    mip: int = 0,
+    simplification_factor: int = 100,
+    max_simplification_error: int = 40,
+    mesh_dir: Optional[str] = None,
+    fill_missing: bool = False,
+    encoding: str = "draco",
+    timestamp: Optional[float] = None,
+  ):
+    super().__init__(
+      shape=shape,
+      offset=offset,
+      layer_path=layer_path,
+      mip=mip,
+      simplification_factor=simplification_factor,
+      max_simplification_error=max_simplification_error,
+      mesh_dir=mesh_dir,
+      fill_missing=fill_missing,
+      encoding=encoding,
+      sharded=True,
+      timestamp=timestamp,
+    )

@@ -103,6 +103,7 @@ class SkeletonTask(RegisteredTask):
     low_memory_csa: bool = False,
     extra_targets: Optional[Dict] = None,
     parallel: int = 1,
+    timestamp: Optional[float] = None,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -133,6 +134,7 @@ class SkeletonTask(RegisteredTask):
       for k, v in (extra_targets or {}).items()
     }
     self.parallel = int(parallel)
+    self.timestamp = timestamp
 
   def _apply_global_dust(self, labels: np.ndarray) -> np.ndarray:
     import struct as _struct
@@ -235,7 +237,17 @@ class SkeletonTask(RegisteredTask):
     # +1 overlap: adjacent tasks share their boundary plane
     # (reference tasks/skeleton.py:68-69)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
-    labels = vol.download(cutout)[..., 0]
+    if vol.graphene is not None:
+      # proofreading volume: skeletonize the agglomerated root objects as
+      # of the pinned timestamp (reference tasks/skeleton.py:159-164).
+      # One raw download serves both the root mapping here and the
+      # autapse voxel graph in execute() — stashing the supervoxels
+      # avoids fetching the identical cutout twice.
+      sv = vol.download(cutout)[..., 0]
+      labels = vol.graphene.get_roots(sv, self.timestamp)
+      self._graphene_sv = sv
+    else:
+      labels = vol.download(cutout)[..., 0]
 
     if self.object_ids:
       labels = fastremap.mask_except(labels, self.object_ids)
@@ -294,6 +306,22 @@ class SkeletonTask(RegisteredTask):
         )
         targets[label] = merged
     targets = targets or None
+    voxel_graph = None
+    if vol.graphene is not None:
+      # autapse fix (reference tasks/skeleton.py:337-398): constrain
+      # TEASAR moves to the chunk graph — two supervoxels that touch
+      # geometrically but share no active edge (a self-contact, or a
+      # proofread split) are severed even inside one root object
+      sv = getattr(self, "_graphene_sv", None)
+      if sv is None:  # prepare ran in another process (batched replay)
+        sv = vol.download(cutout)[..., 0]
+      else:
+        self._graphene_sv = None
+      voxel_graph = vol.graphene.voxel_connectivity_graph(
+        sv, 26, self.timestamp
+      )
+      del sv
+
     skels = skeletonize(
       labels,
       anisotropy=tuple(float(v) for v in vol.resolution),
@@ -303,6 +331,7 @@ class SkeletonTask(RegisteredTask):
       extra_targets_per_label=targets,
       parallel=self.parallel,
       edt_field=_edt_field,
+      voxel_graph=voxel_graph,
     )
 
     # type the synapse vertices for SWC export (reference swc_label)

@@ -66,11 +66,19 @@ class Volume:
     progress: bool = False,
     parallel: int = 1,
   ):
-    from .graphene import is_graphene, require_graphene_client
+    from .graphene import (
+      graphene_client,
+      is_graphene,
+      watershed_path,
+    )
 
+    self.graphene = None
     if is_graphene(cloudpath):
-      # curated gate: proofreading volumes need a PCG client registered
-      require_graphene_client(cloudpath)
+      # proofreading volume: metadata/chunks come from the watershed
+      # (supervoxel) layer; the chunk-graph client supplies the
+      # supervoxel->root and ->L2 mappings on download
+      self.graphene = graphene_client(cloudpath)
+      cloudpath = watershed_path(cloudpath)
     self.meta = PrecomputedMetadata(cloudpath, info=info)
     self.cloudpath = self.meta.cloudpath
     self.cf = self.meta.cf
@@ -236,8 +244,22 @@ class Volume:
     renumber: bool = False,
     label: Optional[int] = None,
     parallel: Optional[int] = None,
+    agglomerate: bool = False,
+    timestamp: Optional[float] = None,
+    stop_layer: Optional[int] = None,
   ):
-    """Download cutout; returns (x, y, z, c) array (plus mapping if renumber)."""
+    """Download cutout; returns (x, y, z, c) array (plus mapping if renumber).
+
+    Graphene volumes additionally accept ``agglomerate`` (map supervoxels
+    to proofread root ids as of ``timestamp``) and ``stop_layer=2`` (map
+    to L2 chunk-graph ids) — the reference's
+    ``download(agglomerate, timestamp, stop_layer)`` surface
+    (/root/reference/igneous/tasks/skeleton.py:159-164,:337-398).
+    """
+    if (agglomerate or stop_layer is not None) and self.graphene is None:
+      raise ValueError(
+        "agglomerate/stop_layer require a graphene:// volume"
+      )
     mip = self.mip if mip is None else mip
     bbox = Bbox(bbox.minpt, bbox.maxpt)
     bounds = self.meta.bounds(mip)
@@ -291,6 +313,29 @@ class Volume:
         for a, b in zip(isect.minpt - chunk_bbx.minpt, isect.maxpt - chunk_bbx.minpt)
       )
       out[dst] = chunk_img[src]
+
+    if self.graphene is not None and (agglomerate or stop_layer is not None):
+      from .graphene import voxel_chunk_index
+
+      if stop_layer not in (None, 1, 2):
+        raise ValueError(
+          f"stop_layer={stop_layer!r} unsupported: 1 (supervoxels) and "
+          "2 (L2 chunk ids) are the graphene stop layers"
+        )
+      if stop_layer == 2:
+        chunks = voxel_chunk_index(
+          bbox.minpt, out.shape[:3], self.graphene.chunk_size
+        )
+        mapped = self.graphene.get_l2_ids(
+          out[..., 0], chunks, timestamp
+        )
+      elif stop_layer == 1:
+        mapped = out[..., 0].astype(np.uint64, copy=False)  # raw supervoxels
+      else:
+        mapped = self.graphene.get_roots(out[..., 0], timestamp)
+      # root/L2 ids live above 2^40 — NEVER narrow them to the watershed
+      # layer's dtype (a uint32 layer would silently wrap ids to garbage)
+      out = mapped[..., np.newaxis].astype(np.uint64, copy=False)
 
     if label is not None:
       out = (out == label).astype(np.uint8)
