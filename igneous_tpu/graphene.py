@@ -307,12 +307,15 @@ def use_local_chunkgraph(cloudpath: str, graph: LocalChunkGraph):
   register_graphene_client(factory)
 
 
-def voxel_chunk_index(bbox_minpt, shape, chunk_size) -> np.ndarray:
+def voxel_chunk_index(bbox_minpt, shape, chunk_size, scale=(1, 1, 1)) -> np.ndarray:
   """Per-voxel linearized chunk index for a cutout at global offset
-  ``bbox_minpt`` with (x, y, z) ``shape``."""
+  ``bbox_minpt`` with (x, y, z) ``shape``. ``scale`` converts mip-level
+  voxel coordinates to the base resolution the chunk grid is defined at
+  (the volume's downsample_ratio for that mip)."""
   cs = np.asarray(chunk_size, dtype=np.int64)
   mn = np.asarray(bbox_minpt, dtype=np.int64)
-  gx = ((mn[0] + np.arange(shape[0], dtype=np.int64)) // cs[0])[:, None, None]
-  gy = ((mn[1] + np.arange(shape[1], dtype=np.int64)) // cs[1])[None, :, None]
-  gz = ((mn[2] + np.arange(shape[2], dtype=np.int64)) // cs[2])[None, None, :]
+  sc = np.asarray(scale, dtype=np.int64)
+  gx = (((mn[0] + np.arange(shape[0], dtype=np.int64)) * sc[0]) // cs[0])[:, None, None]
+  gy = (((mn[1] + np.arange(shape[1], dtype=np.int64)) * sc[1]) // cs[1])[None, :, None]
+  gz = (((mn[2] + np.arange(shape[2], dtype=np.int64)) * sc[2]) // cs[2])[None, None, :]
   return (gx + (gy << np.int64(20)) + (gz << np.int64(40))).astype(np.uint64)

@@ -87,6 +87,29 @@ def edt_lib() -> Optional[ctypes.CDLL]:
   return lib
 
 
+def pooling_lib() -> Optional[ctypes.CDLL]:
+  lib = load("pooling")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.pool_avg_u8.restype = None
+    lib.pool_avg_u8.argtypes = [
+      ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_long, ctypes.c_long, ctypes.c_long,
+      ctypes.c_long, ctypes.c_long, ctypes.c_long,
+      ctypes.c_int,
+    ]
+    lib.pool_mode_u64.restype = None
+    lib.pool_mode_u64.argtypes = [
+      ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_long, ctypes.c_long, ctypes.c_long,
+      ctypes.c_long, ctypes.c_long, ctypes.c_long,
+      ctypes.c_int, ctypes.c_int,
+    ]
+    lib._configured = True
+  return lib
+
+
 def cseg_lib() -> Optional[ctypes.CDLL]:
   lib = load("cseg")
   if lib is None:

@@ -99,3 +99,58 @@ def np_downsample_striding(img, factor, num_mips: int = 1):
     cur = cur[::fx, ::fy, ::fz]
     outs.append(cur[..., 0] if squeeze else cur)
   return outs
+
+
+# ---------------------------------------------------------------------------
+# native CPU comparator (bench baseline) — semantics twins of the numpy
+# oracles above at C speed; the closest in-image stand-in for tinybrain
+
+
+def _native_pyramid(img, factor, num_mips, dtype, run_mip):
+  """Shared mip-pyramid scaffold for the native pooling comparators."""
+  from ..native import pooling_lib
+
+  lib = pooling_lib()
+  if lib is None or img.dtype != dtype or img.ndim != 3:
+    return None
+  outs = []
+  cur = np.ascontiguousarray(img)
+  fx, fy, fz = (int(f) for f in factor)
+  for _ in range(num_mips):
+    nx, ny, nz = cur.shape
+    out = np.empty(
+      ((nx + fx - 1) // fx, (ny + fy - 1) // fy, (nz + fz - 1) // fz),
+      dtype=dtype,
+    )
+    run_mip(lib, cur, out, (nx, ny, nz), (fx, fy, fz))
+    outs.append(out)
+    cur = out
+  return outs
+
+
+def native_downsample_with_averaging(img, factor, num_mips=1, parallel=0):
+  """uint8 average pyramid via native/csrc/pooling.cpp; None if the
+  toolchain is unavailable."""
+  import ctypes
+
+  def run(lib, cur, out, dims, f):
+    lib.pool_avg_u8(
+      cur.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+      *dims, *f, int(parallel),
+    )
+
+  return _native_pyramid(img, factor, num_mips, np.uint8, run)
+
+
+def native_downsample_segmentation(img, factor, num_mips=1, sparse=False,
+                                   parallel=0):
+  """uint64 mode pyramid via native/csrc/pooling.cpp; None if unavailable."""
+  import ctypes
+
+  def run(lib, cur, out, dims, f):
+    lib.pool_mode_u64(
+      cur.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+      *dims, *f, int(bool(sparse)), int(parallel),
+    )
+
+  return _native_pyramid(img, factor, num_mips, np.uint64, run)

@@ -291,8 +291,6 @@ def create_graphene_meshing_tasks(
   chunks (their ids are per-(root, chunk))."""
   from ..tasks.mesh import GrapheneMeshTask
 
-  import numpy as np
-
   vol = Volume(cloudpath, mip=mip)
   if vol.graphene is None:
     raise ValueError("create_graphene_meshing_tasks needs a graphene:// path")

@@ -260,6 +260,12 @@ class Volume:
       raise ValueError(
         "agglomerate/stop_layer require a graphene:// volume"
       )
+    if stop_layer not in (None, 1, 2):
+      # pure argument validation: reject before any chunk is fetched
+      raise ValueError(
+        f"stop_layer={stop_layer!r} unsupported: 1 (supervoxels) and "
+        "2 (L2 chunk ids) are the graphene stop layers"
+      )
     mip = self.mip if mip is None else mip
     bbox = Bbox(bbox.minpt, bbox.maxpt)
     bounds = self.meta.bounds(mip)
@@ -317,14 +323,13 @@ class Volume:
     if self.graphene is not None and (agglomerate or stop_layer is not None):
       from .graphene import voxel_chunk_index
 
-      if stop_layer not in (None, 1, 2):
-        raise ValueError(
-          f"stop_layer={stop_layer!r} unsupported: 1 (supervoxels) and "
-          "2 (L2 chunk ids) are the graphene stop layers"
-        )
       if stop_layer == 2:
+        # graph chunks are defined at the watershed BASE resolution:
+        # scale mip coordinates by the downsample ratio so L2 identity
+        # is mip-invariant
         chunks = voxel_chunk_index(
-          bbox.minpt, out.shape[:3], self.graphene.chunk_size
+          bbox.minpt, out.shape[:3], self.graphene.chunk_size,
+          scale=self.meta.downsample_ratio(mip),
         )
         mapped = self.graphene.get_l2_ids(
           out[..., 0], chunks, timestamp
