@@ -10,3 +10,4 @@ axis of a jax.sharding.Mesh.
 
 from .executor import ChunkExecutor, make_mesh
 from .batch_runner import batched_downsample
+from . import multihost

@@ -170,6 +170,19 @@ class ChunkExecutor:
       batch = np.concatenate([batch, np.zeros((rem,) + batch.shape[1:], batch.dtype)])
     return batch, k
 
+  def run_global(self, global_batch):
+    """Multi-host entry point: run the compiled sharded program on an
+    ALREADY-sharded global jax.Array (multihost.from_process_local).
+    The caller owns padding (multihost.lease_partition) and reads
+    outputs through .addressable_shards — a host can only address its
+    own chips, so no global gather/un-pad happens here."""
+    arrs = (
+      global_batch if isinstance(global_batch, tuple) else (global_batch,)
+    )
+    if len(arrs) != self.planes:
+      raise ValueError(f"expected {self.planes} plane(s), got {len(arrs)}")
+    return self._fn(tuple(arrs))
+
   def __call__(self, batch):
     """batch: (K, c, z, y, x) array (planes=1) or a (lo, hi) tuple of such
     arrays (planes=2) → (per-mip outputs, global_nonzero). Per-mip outputs

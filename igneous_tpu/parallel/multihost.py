@@ -1,0 +1,135 @@
+"""Multi-host (TPU pod) execution: one lease per pod, one program per batch.
+
+SURVEY.md §5.8's scaling story, extended across hosts: cross-POD
+coordination stays queue control plane + object-store data plane (exactly
+where the reference puts NCCL-free coordination), and WITHIN one pod
+lease, ``jax.distributed`` forms a single global device mesh over every
+host's chips so the batched chunk programs (ChunkExecutor /
+BatchKernelExecutor) shard_map across the whole pod — collectives ride
+ICI between chips and the inter-host fabric between hosts, never DCN to
+the object store.
+
+The reference's analog is k8s horizontal scaling of single-host workers
+(/root/reference/deployment.yaml, README.md:178); a TPU pod is the unit
+here because its hosts share ICI and must run one program.
+
+Usage on each host of a pod (the driver's `dryrun` and the test rig use
+the same calls):
+
+    from igneous_tpu.parallel import multihost
+    multihost.initialize()          # env-driven: COORDINATOR/NPROC/PID
+    mesh = multihost.pod_mesh()     # global mesh over every host's chips
+    mine, per = multihost.lease_partition(n_chunks)
+    batch = multihost.from_process_local(mesh, download(mine), per)
+    ex = ChunkExecutor(mesh, ...)   # same executors as single-host
+    outs, stats = ex.run_global(batch)   # read via .addressable_shards
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def initialize(
+  coordinator_address: Optional[str] = None,
+  num_processes: Optional[int] = None,
+  process_id: Optional[int] = None,
+) -> None:
+  """jax.distributed.initialize with env fallbacks — idempotent.
+
+  Env: IGNEOUS_COORDINATOR (host:port), IGNEOUS_NUM_PROCESSES,
+  IGNEOUS_PROCESS_ID. On real TPU pods jax auto-detects all three, so
+  calling with no arguments and no env is also valid there.
+  """
+  import jax
+
+  kw = {}
+  addr = (
+    coordinator_address if coordinator_address is not None
+    else os.environ.get("IGNEOUS_COORDINATOR")
+  )
+  if addr:
+    kw["coordinator_address"] = addr
+  nproc = (
+    num_processes if num_processes is not None
+    else os.environ.get("IGNEOUS_NUM_PROCESSES")
+  )
+  if nproc is not None:
+    kw["num_processes"] = int(nproc)
+  pid = (
+    process_id if process_id is not None
+    else os.environ.get("IGNEOUS_PROCESS_ID")
+  )
+  if pid is not None:
+    kw["process_id"] = int(pid)
+  prior = getattr(initialize, "_args", None)
+  if prior is not None:
+    if prior != kw:
+      raise RuntimeError(
+        f"multihost.initialize already ran with {prior}; re-initializing "
+        f"with {kw} is not supported (jax.distributed is process-global)"
+      )
+    return
+  jax.distributed.initialize(**kw)
+  initialize._args = kw
+
+
+def pod_mesh(axis: str = "chunks"):
+  """Global 1-axis mesh over EVERY process's devices (jax.devices() is
+  the global list after jax.distributed.initialize). Same construction
+  as the single-host executor's make_mesh."""
+  from .executor import make_mesh
+
+  return make_mesh(axis=axis)
+
+
+def lease_partition(n_chunks: int):
+  """(this process's chunk indices, per-process slot count).
+
+  The global batch is padded to the canonical size every sharding rule
+  needs: a multiple of the global device count (which is itself a
+  multiple of the process count on a homogeneous pod). Every process
+  owns exactly ``per`` slots; indices past ``n_chunks`` are the zero-pad
+  slots ``from_process_local`` fills, so every process always passes the
+  SAME local shape regardless of lease divisibility.
+  """
+  import jax
+
+  ndev = jax.device_count()
+  nproc = jax.process_count()
+  canon = -(-max(n_chunks, 1) // ndev) * ndev
+  per = canon // nproc
+  pid = jax.process_index()
+  start = pid * per
+  return [i for i in range(start, start + per) if i < n_chunks], per
+
+
+def from_process_local(mesh, local_batch: np.ndarray, per: int):
+  """Assemble the global sharded batch from each host's local chunks.
+
+  Each process passes the chunks of its ``lease_partition`` slice (any
+  count up to ``per``); short batches are zero-padded to ``per`` rows so
+  all processes contribute identical local shapes and the inferred
+  global shape is consistent. No cross-host data movement — downloads
+  stay host-local, the way the reference keeps each worker's IO private.
+  """
+  import jax
+  from jax.sharding import NamedSharding, PartitionSpec as P
+
+  local_batch = np.asarray(local_batch)
+  if local_batch.shape[0] > per:
+    raise ValueError(
+      f"local batch has {local_batch.shape[0]} chunks but this process "
+      f"owns only {per} slots (see lease_partition)"
+    )
+  if local_batch.shape[0] < per:
+    pad = np.zeros(
+      (per - local_batch.shape[0],) + local_batch.shape[1:],
+      local_batch.dtype,
+    )
+    local_batch = np.concatenate([local_batch, pad])
+  sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+  return jax.make_array_from_process_local_data(sharding, local_batch)
