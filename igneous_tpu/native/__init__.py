@@ -87,6 +87,22 @@ def edt_lib() -> Optional[ctypes.CDLL]:
   return lib
 
 
+def ccl_lib() -> Optional[ctypes.CDLL]:
+  lib = load("ccl")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    for fn in (lib.ccl_ml32, lib.ccl_ml64):
+      fn.restype = ctypes.c_long
+      fn.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_int,
+      ]
+    lib._configured = True
+  return lib
+
+
 def pooling_lib() -> Optional[ctypes.CDLL]:
   lib = load("pooling")
   if lib is None:

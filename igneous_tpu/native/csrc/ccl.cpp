@@ -1,0 +1,124 @@
+// Multilabel connected-components labeling (host path) — cc3d parity.
+//
+// Classic two-pass union-find over a (z, y, x) C-contiguous volume
+// (x fastest — Fortran scan order for the package's (x, y, z) arrays, so
+// first-appearance output numbering matches the device kernel's
+// renumbering exactly). Two voxels connect iff their input labels are
+// equal and nonzero; connectivity 6/18/26 selects the backward neighbor
+// stencil. The device kernel (ops/ccl.py) stays the TPU batched path;
+// this is the CPU production path, ~3 orders of magnitude faster than
+// running the pointer-doubling kernel on the XLA CPU backend.
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+struct UF {
+  std::vector<int32_t> parent;
+  int32_t make() {
+    parent.push_back((int32_t)parent.size());
+    return (int32_t)(parent.size() - 1);
+  }
+  int32_t find(int32_t x) {
+    int32_t root = x;
+    while (parent[(size_t)root] != root) root = parent[(size_t)root];
+    while (parent[(size_t)x] != root) {
+      int32_t next = parent[(size_t)x];
+      parent[(size_t)x] = root;
+      x = next;
+    }
+    return root;
+  }
+  void unite(int32_t a, int32_t b) {
+    int32_t ra = find(a), rb = find(b);
+    if (ra != rb) parent[(size_t)(ra > rb ? ra : rb)] = (ra > rb ? rb : ra);
+  }
+};
+
+// backward neighbors (already-scanned) for scan order z outer, y, x inner
+// over a (z, y, x) C-contiguous array; entries are (dz, dy, dx) <= 0 ...
+// lexicographically before the current voxel.
+static const int OFFS26[13][3] = {
+    {-1, -1, -1}, {-1, -1, 0}, {-1, -1, 1}, {-1, 0, -1}, {-1, 0, 0},
+    {-1, 0, 1},   {-1, 1, -1}, {-1, 1, 0},  {-1, 1, 1},  {0, -1, -1},
+    {0, -1, 0},   {0, -1, 1},  {0, 0, -1},
+};
+static const int IDX18[9] = {1, 3, 4, 5, 7, 9, 10, 11, 12};  // degree <= 2
+static const int IDX6[3] = {4, 10, 12};                      // faces only
+
+template <typename LabT>
+static long ccl_impl(const LabT *lab, int32_t *out, long nz, long ny,
+                     long nx, int connectivity) {
+  const long sy = nx, sz = ny * nx;
+  UF uf;
+  uf.parent.reserve(1024);
+
+  const int(*offs)[3] = OFFS26;
+  std::vector<int> pick;
+  if (connectivity == 26) {
+    for (int i = 0; i < 13; ++i) pick.push_back(i);
+  } else if (connectivity == 18) {
+    pick.assign(IDX18, IDX18 + 9);
+  } else {
+    pick.assign(IDX6, IDX6 + 3);
+  }
+
+  // pass 1: provisional labels + unions
+  for (long z = 0; z < nz; ++z) {
+    for (long y = 0; y < ny; ++y) {
+      const long base = z * sz + y * sy;
+      for (long x = 0; x < nx; ++x) {
+        const long i = base + x;
+        const LabT v = lab[i];
+        if (v == 0) {
+          out[i] = -1;
+          continue;
+        }
+        int32_t assigned = -1;
+        for (int pi : pick) {
+          const int dz = offs[pi][0], dy = offs[pi][1], dx = offs[pi][2];
+          const long zz = z + dz, yy = y + dy, xx = x + dx;
+          if (zz < 0 || yy < 0 || yy >= ny || xx < 0 || xx >= nx) continue;
+          const long j = zz * sz + yy * sy + xx;
+          if (lab[j] != v) continue;
+          const int32_t pl = out[j];
+          if (assigned < 0) {
+            assigned = pl;
+          } else if (pl != assigned) {
+            uf.unite(assigned, pl);
+          }
+        }
+        out[i] = (assigned >= 0) ? assigned : uf.make();
+      }
+    }
+  }
+
+  // pass 2: resolve + renumber by first appearance in scan order
+  std::vector<int32_t> dense(uf.parent.size(), 0);
+  int32_t next_id = 0;
+  const long n = nz * ny * nx;
+  for (long i = 0; i < n; ++i) {
+    if (out[i] < 0) {
+      out[i] = 0;
+      continue;
+    }
+    const int32_t root = uf.find(out[i]);
+    if (dense[(size_t)root] == 0) dense[(size_t)root] = ++next_id;
+    out[i] = dense[(size_t)root];
+  }
+  return (long)next_id;
+}
+
+}  // namespace
+
+extern "C" long ccl_ml32(const int32_t *lab, int32_t *out, long nz, long ny,
+                         long nx, int connectivity) {
+  return ccl_impl<int32_t>(lab, out, nz, ny, nx, connectivity);
+}
+
+extern "C" long ccl_ml64(const int64_t *lab, int32_t *out, long nz, long ny,
+                         long nx, int connectivity) {
+  return ccl_impl<int64_t>(lab, out, nz, ny, nx, connectivity);
+}

@@ -111,24 +111,87 @@ def _ccl_kernel(labels: jnp.ndarray, connectivity: int = 6) -> jnp.ndarray:
   return jnp.where(fg, L, big)
 
 
+def _ccl_native(labels: np.ndarray, connectivity: int):
+  """Two-pass union-find in C++ (native/csrc/ccl.cpp); None if the
+  toolchain is unavailable. Output numbering matches the device path."""
+  import ctypes
+
+  from ..native import ccl_lib
+
+  lib = ccl_lib()
+  if lib is None:
+    return None
+  # (z, y, x) C-contiguous = Fortran scan order for the (x, y, z) array
+  t = np.ascontiguousarray(labels.transpose(2, 1, 0))
+  if t.dtype.itemsize <= 4:
+    if t.dtype.itemsize < 4:
+      t = t.astype(np.int32)
+    t = t.view(np.int32)
+    fn = lib.ccl_ml32
+  else:
+    t = t.view(np.int64)
+    fn = lib.ccl_ml64
+  out = np.empty(t.shape, dtype=np.int32)
+  n = fn(
+    t.ctypes.data_as(ctypes.c_void_p), out.ctypes.data_as(ctypes.c_void_p),
+    t.shape[0], t.shape[1], t.shape[2], int(connectivity),
+  )
+  return out.transpose(2, 1, 0).astype(np.uint32), int(n)
+
+
+def _ccl_backend() -> str:
+  import os
+
+  override = os.environ.get("IGNEOUS_CCL_BACKEND", "")
+  if override in ("native", "device"):
+    return override
+  platforms = os.environ.get("JAX_PLATFORMS", "")
+  if platforms:
+    return "native" if platforms.split(",")[0] == "cpu" else "device"
+  return "device" if jax.default_backend() != "cpu" else "native"
+
+
 def connected_components(
   labels: np.ndarray, connectivity: int = 6, return_N: bool = False
 ):
   """cc3d-equivalent block CCL. labels: (x, y, z) any integer dtype.
 
   Returns components renumbered 1..N in order of each component's first
-  voxel in Fortran (x-fastest) scan order; 0 stays background. Deterministic
-  across recomputation.
+  voxel in Fortran (x-fastest) scan order; 0 stays background.
+  Deterministic across recomputation. Dispatches to the device kernel on
+  accelerator backends and the native C++ two-pass union-find on CPU
+  hosts (override with IGNEOUS_CCL_BACKEND=native|device) — both
+  orderings are identical, so the 4-pass CCL protocol's recompute
+  determinism holds across backends.
   """
   if labels.ndim != 3:
     raise ValueError("labels must be (x, y, z)")
+  neighbor_offsets(connectivity)  # validate on EVERY backend, same error
+  if labels.size == 0:
+    out = np.zeros(labels.shape, dtype=np.uint32)
+    return (out, 0) if return_N else out
+
+  if _ccl_backend() == "native":
+    got = _ccl_native(labels, connectivity)
+    if got is not None:
+      out, N = got
+      return (out, N) if return_N else out
+    # no toolchain: fall through to the device kernel
 
   # multilabel equality only needs label-identity: compress any dtype to
   # int32 via dense renumbering (cheap: sort-based)
   uniq, inv = np.unique(labels, return_inverse=True)
   lab32 = inv.astype(np.int32).reshape(labels.shape)
-  if uniq[0] != 0:
-    lab32 = lab32 + 1  # no zero present: keep everything foreground
+  if not np.any(uniq == 0):
+    # no zero present: keep everything foreground (checking membership,
+    # not uniq[0] — signed inputs can sort negatives before zero)
+    lab32 = lab32 + 1
+  elif uniq[0] != 0:
+    # zero present but not first (negative labels): make zero's dense id 0
+    zero_pos = int(np.searchsorted(uniq, 0))
+    lab32 = np.where(
+      lab32 == zero_pos, 0, np.where(lab32 < zero_pos, lab32 + 1, lab32)
+    ).astype(np.int32)
 
   # device layout (z, y, x): x innermost on lanes
   dev = jnp.asarray(np.ascontiguousarray(lab32.transpose(2, 1, 0)))

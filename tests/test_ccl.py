@@ -37,7 +37,7 @@ def same_partition(a, b) -> bool:
 # kernel
 
 
-def test_ccl_binary_vs_scipy(rng):
+def test_ccl_binary_vs_scipy(rng, ccl_backend):
   img = (rng.random((40, 36, 20)) < 0.4).astype(np.uint8)
   out, N = connected_components(img, return_N=True)
   exp, eN = ndimage.label(img, structure=S6)
@@ -45,7 +45,7 @@ def test_ccl_binary_vs_scipy(rng):
   assert same_partition(out, exp)
 
 
-def test_ccl_multilabel(rng):
+def test_ccl_multilabel(rng, ccl_backend):
   lab = (rng.integers(0, 3, (24, 24, 12)) * 5).astype(np.uint64)
   out, N = connected_components(lab, return_N=True)
   total = 0
@@ -57,7 +57,7 @@ def test_ccl_multilabel(rng):
   assert np.array_equal(out, connected_components(lab))
 
 
-def test_ccl_snake():
+def test_ccl_snake(ccl_backend):
   # worst-case serpentine: exercises pointer-doubling convergence
   img = np.zeros((32, 32, 1), np.uint8)
   for i in range(0, 32, 2):
@@ -207,7 +207,18 @@ def test_ccl_unaligned_bounds(tmp_path, rng):
 # statistics
 
 
-def test_ccl_26_connectivity_vs_scipy(rng):
+@pytest.fixture(params=["device", "native"])
+def ccl_backend(request, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", request.param)
+  if request.param == "native":
+    from igneous_tpu.native import ccl_lib
+
+    if ccl_lib() is None:
+      pytest.fail("native CCL lib failed to build (toolchain present?)")
+  return request.param
+
+
+def test_ccl_26_connectivity_vs_scipy(rng, ccl_backend):
   from scipy import ndimage
 
   mask = (rng.random((24, 20, 16)) < 0.25).astype(np.uint8)
@@ -222,7 +233,7 @@ def test_ccl_26_connectivity_vs_scipy(rng):
   assert len(np.unique(pairs[1])) == len(pairs[1])
 
 
-def test_ccl_18_connectivity_vs_scipy(rng):
+def test_ccl_18_connectivity_vs_scipy(rng, ccl_backend):
   from scipy import ndimage
 
   mask = (rng.random((20, 18, 14)) < 0.3).astype(np.uint8)
@@ -232,7 +243,7 @@ def test_ccl_18_connectivity_vs_scipy(rng):
   assert n_ours == n_ref
 
 
-def test_ccl_26_diagonal_touch():
+def test_ccl_26_diagonal_touch(ccl_backend):
   # two voxels sharing only a corner: one component at 26, two at 6
   lab = np.zeros((4, 4, 4), np.uint8)
   lab[1, 1, 1] = 1
@@ -314,3 +325,35 @@ def test_statistics_absent_label_nan():
   assert s["voxel_counts"][2] == 0
   assert np.isnan(s["centroids"][2]).all()
   assert np.allclose(s["centroids"][3], [5, 5, 5])
+
+
+def test_ccl_backends_identical_numbering(rng, monkeypatch):
+  """Both backends must produce IDENTICAL labelings (not just identical
+  partitions): the 4-pass protocol recomputes CCL deterministically in
+  later passes, possibly on a different backend."""
+  from igneous_tpu.native import ccl_lib
+
+  if ccl_lib() is None:
+    pytest.fail("native CCL lib failed to build")
+  lab = (rng.integers(0, 4, (40, 33, 21)) * 7).astype(np.uint64)
+  outs = {}
+  for be in ("device", "native"):
+    monkeypatch.setenv("IGNEOUS_CCL_BACKEND", be)
+    outs[be] = connected_components(lab, connectivity=6)
+  assert np.array_equal(outs["device"], outs["native"])
+
+
+def test_ccl_negative_labels_and_empty(rng, ccl_backend):
+  """Signed inputs with negatives: only value 0 is background on every
+  backend; empty volumes return cleanly."""
+  lab = np.zeros((8, 6, 4), np.int32)
+  lab[0:3] = -5
+  lab[5:8] = 3
+  out, N = connected_components(lab, connectivity=6, return_N=True)
+  assert N == 2
+  assert (out[0:3] != 0).all() and (out[3:5] == 0).all()
+  out0, n0 = connected_components(
+    np.zeros((0, 4, 4), np.uint8), return_N=True)
+  assert out0.shape == (0, 4, 4) and n0 == 0
+  with pytest.raises(ValueError, match="connectivity"):
+    connected_components(lab, connectivity=4)
