@@ -147,3 +147,20 @@ def cseg_lib() -> Optional[ctypes.CDLL]:
     ]
     lib._configured = True
   return lib
+
+
+def simplify_lib() -> Optional[ctypes.CDLL]:
+  lib = load("simplify")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.igsimp_simplify.restype = ctypes.c_int
+    lib.igsimp_simplify.argtypes = [
+      ctypes.c_void_p, ctypes.c_int64,
+      ctypes.c_void_p, ctypes.c_int64,
+      ctypes.c_int64, ctypes.c_double, ctypes.c_int,
+      ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib._configured = True
+  return lib

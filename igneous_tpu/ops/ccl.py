@@ -143,7 +143,11 @@ def _ccl_backend() -> str:
   import os
 
   override = os.environ.get("IGNEOUS_CCL_BACKEND", "")
-  if override in ("native", "device"):
+  if override:
+    if override not in ("native", "device"):
+      raise ValueError(
+        f"IGNEOUS_CCL_BACKEND must be 'native' or 'device': {override!r}"
+      )
     return override
   platforms = os.environ.get("JAX_PLATFORMS", "")
   if platforms:
@@ -178,20 +182,7 @@ def connected_components(
       return (out, N) if return_N else out
     # no toolchain: fall through to the device kernel
 
-  # multilabel equality only needs label-identity: compress any dtype to
-  # int32 via dense renumbering (cheap: sort-based)
-  uniq, inv = np.unique(labels, return_inverse=True)
-  lab32 = inv.astype(np.int32).reshape(labels.shape)
-  if not np.any(uniq == 0):
-    # no zero present: keep everything foreground (checking membership,
-    # not uniq[0] — signed inputs can sort negatives before zero)
-    lab32 = lab32 + 1
-  elif uniq[0] != 0:
-    # zero present but not first (negative labels): make zero's dense id 0
-    zero_pos = int(np.searchsorted(uniq, 0))
-    lab32 = np.where(
-      lab32 == zero_pos, 0, np.where(lab32 < zero_pos, lab32 + 1, lab32)
-    ).astype(np.int32)
+  lab32 = _dense_relabel(labels)
 
   # device layout (z, y, x): x innermost on lanes
   dev = jnp.asarray(np.ascontiguousarray(lab32.transpose(2, 1, 0)))
@@ -204,6 +195,26 @@ def connected_components(
   if return_N:
     return out, N
   return out
+
+
+def _dense_relabel(labels: np.ndarray) -> np.ndarray:
+  """Compress any integer dtype to int32 dense ids for the device kernel
+  (multilabel equality only needs label-identity). Background zero keeps
+  dense id 0; every real label gets a positive id — including when signed
+  inputs sort negatives before zero, or when zero is absent entirely."""
+  uniq, inv = np.unique(labels, return_inverse=True)
+  lab32 = inv.astype(np.int32).reshape(labels.shape)
+  if not np.any(uniq == 0):
+    # no zero present: keep everything foreground (checking membership,
+    # not uniq[0] — signed inputs can sort negatives before zero)
+    lab32 = lab32 + 1
+  elif uniq[0] != 0:
+    # zero present but not first (negative labels): make zero's dense id 0
+    zero_pos = int(np.searchsorted(uniq, 0))
+    lab32 = np.where(
+      lab32 == zero_pos, 0, np.where(lab32 < zero_pos, lab32 + 1, lab32)
+    ).astype(np.int32)
+  return lab32
 
 
 def _roots_to_components(roots: np.ndarray) -> np.ndarray:
@@ -254,10 +265,7 @@ def connected_components_batch(
   labels_batch = np.asarray(labels_batch)
   if labels_batch.ndim != 4:
     raise ValueError("labels_batch must be (K, x, y, z)")
-  uniq, inv = np.unique(labels_batch, return_inverse=True)
-  lab32 = inv.astype(np.int32).reshape(labels_batch.shape)
-  if uniq[0] != 0:
-    lab32 = lab32 + 1
+  lab32 = _dense_relabel(labels_batch)
   dev = np.ascontiguousarray(lab32.transpose(0, 3, 2, 1))  # (K, z, y, x)
   if executor is None:
     executor = _batch_executor(connectivity)

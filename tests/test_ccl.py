@@ -343,6 +343,30 @@ def test_ccl_backends_identical_numbering(rng, monkeypatch):
   assert np.array_equal(outs["device"], outs["native"])
 
 
+def test_ccl_batch_matches_solo_with_negatives(rng, monkeypatch):
+  """connected_components_batch must number each cutout exactly as
+  connected_components would alone — including for signed inputs with
+  negative labels, where background-zero is not the smallest value."""
+  from igneous_tpu.ops.ccl import connected_components_batch
+
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "device")
+  batch = (rng.integers(-2, 3, (3, 16, 12, 8))).astype(np.int32) * 5
+  solo = [connected_components(b, connectivity=6) for b in batch]
+  batched = connected_components_batch(batch, connectivity=6)
+  for s, b in zip(solo, batched):
+    assert np.array_equal(s, b)
+  # background stayed background
+  assert all(np.all(b[batch[i] == 0] == 0) for i, b in enumerate(batched))
+
+
+def test_ccl_backend_override_validated(monkeypatch):
+  """A typo'd IGNEOUS_CCL_BACKEND must raise, not silently auto-detect."""
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "cpu")
+  lab = np.ones((4, 4, 4), np.uint32)
+  with pytest.raises(ValueError, match="IGNEOUS_CCL_BACKEND"):
+    connected_components(lab, connectivity=6)
+
+
 def test_ccl_negative_labels_and_empty(rng, ccl_backend):
   """Signed inputs with negatives: only value 0 is background on every
   backend; empty volumes return cleanly."""
