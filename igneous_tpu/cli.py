@@ -587,17 +587,39 @@ def skeleton():
 @click.option("--sharded", is_flag=True)
 @click.option("--skel-dir", default=None)
 @click.option("--fix-borders/--no-fix-borders", default=True, show_default=True)
+@click.option("--fix-branching/--no-fix-branching", default=True,
+              show_default=True,
+              help="regrow the path field from the whole tree before each "
+                   "branch so junctions attach on-center")
+@click.option("--fix-avocados", is_flag=True,
+              help="absorb nucleus labels engulfed by a soma and "
+                   "re-EDT the solid cell body")
+@click.option("--soma-detect", default=1100.0, show_default=True,
+              help="soma candidate EDT threshold (physical units)")
+@click.option("--soma-accept", default=3500.0, show_default=True,
+              help="soma acceptance EDT threshold (physical units)")
+@click.option("--soma-scale", default=2.0, show_default=True)
+@click.option("--soma-const", default=300.0, show_default=True)
 @click.pass_context
 def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
-                   dust_global, fill_missing, sharded, skel_dir, fix_borders):
+                   dust_global, fill_missing, sharded, skel_dir, fix_borders,
+                   fix_branching, fix_avocados, soma_detect, soma_accept,
+                   soma_scale, soma_const):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_skeletonizing_tasks(
     path, mip=mip, shape=shape,
-    teasar_params={"scale": scale, "const": const},
+    teasar_params={
+      "scale": scale, "const": const,
+      "soma_detection_threshold": soma_detect,
+      "soma_acceptance_threshold": soma_accept,
+      "soma_invalidation_scale": soma_scale,
+      "soma_invalidation_const": soma_const,
+    },
     dust_threshold=dust_threshold, dust_global=dust_global,
     fill_missing=fill_missing,
     sharded=sharded, skel_dir=skel_dir, fix_borders=fix_borders,
+    fix_branching=fix_branching, fix_avocados=fix_avocados,
   ), ctx.obj["parallel"])
 
 

@@ -32,13 +32,21 @@ PDRF_EXPONENT = 16
 
 
 class TeasarParams:
+  """TEASAR tuning knobs, mirroring the kimimaro teasar_params dict the
+  reference forwards verbatim (reference igneous_cli/cli.py:1325-1337):
+  path-invalidation scale/const, PDRF shaping, soma handling thresholds
+  (all physical units), and a path-count cap."""
+
   def __init__(
     self,
     scale: float = 4.0,
     const: float = 500.0,  # physical units (nm)
     pdrf_scale: float = 100000.0,
     pdrf_exponent: int = PDRF_EXPONENT,
-    soma_detection_threshold: float = 0.0,
+    soma_detection_threshold: float = 1100.0,
+    soma_acceptance_threshold: float = 3500.0,
+    soma_invalidation_scale: float = 2.0,
+    soma_invalidation_const: float = 300.0,
     max_paths: Optional[int] = None,
   ):
     self.scale = scale
@@ -46,18 +54,21 @@ class TeasarParams:
     self.pdrf_scale = pdrf_scale
     self.pdrf_exponent = pdrf_exponent
     self.soma_detection_threshold = soma_detection_threshold
+    self.soma_acceptance_threshold = soma_acceptance_threshold
+    self.soma_invalidation_scale = soma_invalidation_scale
+    self.soma_invalidation_const = soma_invalidation_const
     self.max_paths = max_paths
 
   KNOWN = (
     "scale", "const", "pdrf_scale", "pdrf_exponent",
-    "soma_detection_threshold", "max_paths",
+    "soma_detection_threshold", "soma_acceptance_threshold",
+    "soma_invalidation_scale", "soma_invalidation_const", "max_paths",
   )
 
   @classmethod
   def from_dict(cls, d: Optional[dict]) -> "TeasarParams":
-    """Unknown keys (e.g. kimimaro options without an equivalent here,
-    like fix_branching/soma_invalidation_scale) are ignored with a
-    warning instead of failing every queued task."""
+    """Unknown keys are ignored with a warning instead of failing every
+    queued task."""
     d = dict(d or {})
     unknown = set(d) - set(cls.KNOWN)
     if unknown:
@@ -131,6 +142,7 @@ def skeletonize_mask(
   edt_field: Optional[np.ndarray] = None,
   extra_targets: Optional[np.ndarray] = None,
   voxel_graph: Optional[np.ndarray] = None,
+  fix_branching: bool = True,
 ) -> Skeleton:
   """Skeletonize one binary object. Vertices come out in physical units:
   (voxel + offset) * anisotropy. ``edt_field`` lets callers supply a
@@ -140,7 +152,15 @@ def skeletonize_mask(
   vertices with a traced path to the tree — the border-pinning mechanism
   that makes adjacent tasks' skeletons weld at shared overlap planes
   (the reference's kimimaro fix_borders / extra_targets_after,
-  tasks/skeleton.py:68-69,177)."""
+  tasks/skeleton.py:68-69,177).
+
+  ``fix_branching``: recompute the penalized shortest-path field from the
+  ENTIRE current tree before each new path (multi-source Dijkstra), so
+  branches attach at the correct centerline junction instead of wherever
+  the single root-rooted predecessor tree happens to pass (the
+  reference's kimimaro fix_branching flag, tasks/skeleton.py:68;
+  default True there and here). False = one predecessor tree per
+  component, ~paths× faster, slightly off-center branch points."""
   params = params or TeasarParams()
   mask = np.ascontiguousarray(mask.astype(bool))
   if not mask.any():
@@ -159,7 +179,7 @@ def skeletonize_mask(
     for ci in range(1, ncomp + 1):
       piece = _skeletonize_component(
         comps == ci, dt, anisotropy, params, offset, extra_targets,
-        voxel_graph,
+        voxel_graph, fix_branching,
       )
       if not piece.empty:
         pieces.append(piece)
@@ -167,7 +187,8 @@ def skeletonize_mask(
       return Skeleton()
     return Skeleton.simple_merge(pieces).consolidate()
   return _skeletonize_component(
-    mask, dt, anisotropy, params, offset, extra_targets, voxel_graph
+    mask, dt, anisotropy, params, offset, extra_targets, voxel_graph,
+    fix_branching,
   )
 
 
@@ -179,6 +200,7 @@ def _skeletonize_component(
   offset,
   extra_targets,
   voxel_graph=None,
+  fix_branching: bool = True,
 ) -> Skeleton:
   dt = np.where(mask, dt, 0.0)
   dmax = float(dt.max())
@@ -221,6 +243,17 @@ def _skeletonize_component(
 
   ncomp_g, comp_ids = graph_components(graph, directed=False)
 
+  # soma mode (kimimaro soma_acceptance_threshold): a very thick object
+  # is a cell body — root at the EDT maximum, one big invalidation ball,
+  # radial paths to whatever pokes out, instead of a surface-crawling
+  # zigzag over the soma membrane
+  soma_node = None
+  if (
+    params.soma_acceptance_threshold
+    and dmax > params.soma_acceptance_threshold
+  ):
+    soma_node = int(np.argmax(edt_flat))
+
   paths = []
   roots = []
   on_tree = np.zeros(n, dtype=bool)
@@ -228,31 +261,57 @@ def _skeletonize_component(
   for c in range(ncomp_g):
     in_comp = comp_ids == c
     nodes = np.flatnonzero(in_comp)
-    # root: farthest voxel (unweighted hops) from an arbitrary comp start
-    d0 = dijkstra(graph, indices=int(nodes[0]), unweighted=True)
-    root = int(np.argmax(np.where(np.isfinite(d0), d0, -1)))
+    if soma_node is not None and in_comp[soma_node]:
+      root = soma_node
+    else:
+      # root: farthest voxel (unweighted hops) from an arbitrary start
+      d0 = dijkstra(graph, indices=int(nodes[0]), unweighted=True)
+      root = int(np.argmax(np.where(np.isfinite(d0), d0, -1)))
     roots.append(root)
-
-    # penalized distances + shortest-path tree from the root
-    dist, pred = dijkstra(graph, indices=root, return_predecessors=True)
 
     captured = ~in_comp  # other components are off-limits for this trace
     captured = captured.copy()
     captured[root] = True
+    tree_c = np.zeros(n, dtype=bool)  # this component's current tree
+    tree_c[root] = True
+
+    if root == soma_node:
+      r = (
+        params.soma_invalidation_scale * edt_flat[root]
+        + params.soma_invalidation_const
+      )
+      d2 = ((phys - phys[root]) ** 2).sum(-1)
+      captured |= d2 <= r * r
+
+    # penalized distances + shortest-path forest. With fix_branching the
+    # forest is regrown from the WHOLE current tree before every path
+    # (multi-source), so each branch attaches at the true junction; without
+    # it one root-rooted tree serves every path (faster, branches attach
+    # wherever the root tree passes).
+    if fix_branching:
+      dist, pred, _ = dijkstra(
+        graph, indices=[root], min_only=True, return_predecessors=True
+      )
+    else:
+      dist, pred = dijkstra(graph, indices=root, return_predecessors=True)
 
     for _ in range(max_paths):
       remaining = np.flatnonzero(~captured)
       if len(remaining) == 0:
         break
       target = int(remaining[np.argmax(dist[remaining])])
-      # walk the predecessor tree from target back to a captured vertex
+      # walk the predecessor forest from target back onto the tree: with
+      # fix_branching every source is a tree vertex (pred < 0 there); the
+      # single-tree variant stops at the first captured vertex
       path = [target]
       cur = target
-      while pred[cur] >= 0 and not captured[cur]:
+      while pred[cur] >= 0 and not (tree_c[cur] if fix_branching
+                                    else captured[cur]):
         cur = int(pred[cur])
         path.append(cur)
       path = np.asarray(path, dtype=np.int64)
       paths.append(path)
+      tree_c[path] = True
       # rolling invalidation ball: capture voxels near the new centerline
       ball = inval_radius[path]  # (p,)
       # chunk to bound memory: |remaining| x |path| distances
@@ -268,6 +327,13 @@ def _skeletonize_component(
         if len(rem) == 0:
           break
       captured[path] = True
+      if fix_branching and not captured.all():
+        dist, pred, _ = dijkstra(
+          graph,
+          indices=np.flatnonzero(tree_c),
+          min_only=True,
+          return_predecessors=True,
+        )
 
     # forced targets: path each one into this component's tree regardless
     # of invalidation
@@ -321,6 +387,8 @@ def skeletonize(
   progress: bool = False,
   voxel_graph: Optional[np.ndarray] = None,
   edt_field: Optional[np.ndarray] = None,
+  fix_branching: bool = True,
+  fix_avocados: bool = False,
 ) -> Dict[int, Skeleton]:
   """Skeletonize every label in a volume → {label: Skeleton}.
 
@@ -328,7 +396,18 @@ def skeletonize(
   to each label's bounding box (the reference's per-label split,
   tasks/skeleton.py:303-335). ``parallel`` threads the label loop (the
   scipy/numpy hot paths release the GIL) — the reference forwards the
-  same knob to kimimaro (task_creation/skeleton.py:159-163)."""
+  same knob to kimimaro (task_creation/skeleton.py:159-163).
+
+  ``fix_avocados`` (reference tasks/skeleton.py:70): a soma whose nucleus
+  was segmented as a separate label skeletonizes like an avocado — the
+  EDT sees a hollow shell and traces around the pit. For every
+  soma-candidate label (max EDT ≥ soma_detection_threshold), labels
+  wholly engulfed by its filled hull are absorbed into it (and dropped
+  from the output — the fused body is reported under the soma's label),
+  background holes are filled, and the label's EDT is recomputed on the
+  solid mask. With ``object_ids``, only requested labels are soma
+  candidates, so a requested label can never be silently absorbed by an
+  unrequested one."""
   del progress
   params = params or TeasarParams()
   labels = np.asarray(labels)
@@ -349,14 +428,67 @@ def skeletonize(
 
   wanted = set(int(v) for v in object_ids) if object_ids else None
 
+  absorbed: set = set()
+  solid_masks: Dict[int, np.ndarray] = {}
+  solid_edts: Dict[int, np.ndarray] = {}
+  if fix_avocados:
+    counts = np.bincount(dense.reshape(-1))
+    detect = float(params.soma_detection_threshold or 0.0)
+    for new_id, sl in enumerate(slices, start=1):
+      if sl is None:
+        continue
+      # only requested labels can be somas: absorption then never steals
+      # an explicitly requested label (it could only vanish into another
+      # requested label), and the scan cost scales with the request, not
+      # with the cutout's label count
+      if wanted is not None and int(mapping[new_id]) not in wanted:
+        continue
+      mask = dense[sl] == new_id
+      filled = ndimage.binary_fill_holes(mask)
+      added = filled & ~mask
+      if not added.any():
+        continue
+      crop = dense[sl]
+      pit_labels = [
+        int(lab)
+        for lab in np.unique(crop[added])
+        if lab not in (0, new_id)
+        and int(np.count_nonzero((crop == lab) & added)) == int(counts[lab])
+      ]
+      bg_holes = added & (crop == 0)
+      if not pit_labels and not bg_holes.any():
+        continue
+      solid = mask | bg_holes
+      if pit_labels:
+        solid |= np.isin(crop, pit_labels) & added
+      # soma candidacy is judged on the SOLID body: a hollow shell's raw
+      # EDT never reaches soma thickness, which is exactly the avocado
+      # symptom being repaired
+      edt_solid = device_edt(
+        solid.astype(np.uint8), anisotropy, black_border=True
+      )
+      if float(edt_solid.max()) < detect:
+        continue
+      absorbed.update(pit_labels)
+      solid_masks[new_id] = solid
+      solid_edts[new_id] = edt_solid
+
   def trace(new_id: int, sl) -> Optional[tuple]:
+    if new_id in absorbed:  # a nucleus swallowed by its soma
+      return None
     orig = mapping[new_id]
     if wanted is not None and orig not in wanted:
       return None
-    mask = dense[sl] == new_id
+    if new_id in solid_masks:
+      # the pit is solid now; the cavity-distorted whole-cutout EDT no
+      # longer applies — use the EDT of the solid body
+      mask = solid_masks[new_id]
+      crop_edt = solid_edts[new_id]
+    else:
+      mask = dense[sl] == new_id
+      crop_edt = np.where(mask, whole_edt[sl], 0.0)
     if dust_threshold and mask.sum() < dust_threshold:
       return None
-    crop_edt = np.where(mask, whole_edt[sl], 0.0)
     crop_offset = np.asarray(offset, np.float32) + np.asarray(
       [s.start for s in sl], np.float32
     )
@@ -372,6 +504,7 @@ def skeletonize(
       mask, anisotropy, params, offset=crop_offset, edt_field=crop_edt,
       extra_targets=targets,
       voxel_graph=None if voxel_graph is None else voxel_graph[sl],
+      fix_branching=fix_branching,
     )
     return None if skel.empty else (int(orig), skel)
 

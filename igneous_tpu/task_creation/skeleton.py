@@ -42,6 +42,8 @@ def create_skeletonizing_tasks(
   spatial_index: bool = True,
   fix_borders: bool = True,
   fill_holes: bool = False,
+  fix_branching: bool = True,
+  fix_avocados: bool = False,
   cross_sectional_area: bool = False,
   low_memory_csa: bool = False,
   synapses: Optional[dict] = None,
@@ -154,6 +156,8 @@ def create_skeletonizing_tasks(
       spatial_index=spatial_index,
       fix_borders=fix_borders,
       fill_holes=fill_holes,
+      fix_branching=fix_branching,
+      fix_avocados=fix_avocados,
       cross_sectional_area=cross_sectional_area,
       low_memory_csa=low_memory_csa,
       extra_targets=task_targets(offset, shape_),

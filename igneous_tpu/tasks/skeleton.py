@@ -99,6 +99,8 @@ class SkeletonTask(RegisteredTask):
     spatial_index: bool = True,
     fix_borders: bool = True,
     fill_holes: bool = False,
+    fix_branching: bool = True,
+    fix_avocados: bool = False,
     cross_sectional_area: bool = False,
     low_memory_csa: bool = False,
     extra_targets: Optional[Dict] = None,
@@ -120,6 +122,8 @@ class SkeletonTask(RegisteredTask):
     self.spatial_index = spatial_index
     self.fix_borders = fix_borders
     self.fill_holes = bool(fill_holes)
+    self.fix_branching = bool(fix_branching)
+    self.fix_avocados = bool(fix_avocados)
     self.cross_sectional_area = bool(cross_sectional_area)
     self.low_memory_csa = bool(low_memory_csa)
     # {label: [[x,y,z(,swc_label)] global voxel coords]} — synapse/marker
@@ -332,6 +336,8 @@ class SkeletonTask(RegisteredTask):
       parallel=self.parallel,
       edt_field=_edt_field,
       voxel_graph=voxel_graph,
+      fix_branching=self.fix_branching,
+      fix_avocados=self.fix_avocados,
     )
 
     # type the synapse vertices for SWC export (reference swc_label)

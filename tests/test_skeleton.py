@@ -501,3 +501,141 @@ def test_global_dust_requires_census(tmp_path):
       path, shape=(16, 16, 16), dust_threshold=10, dust_global=True,
       teasar_params={"scale": 4, "const": 50},
     ))
+
+
+# ---------------------------------------------------------------------------
+# kimimaro parity: fix_branching, soma mode, fix_avocados
+# (reference tasks/skeleton.py:68-70 task flags; igneous_cli/cli.py:1325-1337
+# teasar soma params)
+
+
+def _t_shape():
+  """A T: horizontal bar with a vertical stem meeting it mid-span."""
+  mask = np.zeros((64, 48, 10), bool)
+  mask[4:60, 4:10, 3:7] = True   # bar along x at y~7
+  mask[28:36, 4:44, 3:7] = True  # stem along y at x~32
+  return mask
+
+
+def test_fix_branching_attaches_on_center():
+  """With fix_branching the stem path joins the bar ON the bar's
+  centerline (a true junction vertex near (32, 7)); the skeleton is one
+  connected tree with 3 tips."""
+  mask = _t_shape()
+  s = skeletonize_mask(
+    mask, params=TeasarParams(scale=3, const=4), fix_branching=True
+  )
+  assert len(np.unique(s.components_by_vertex())) == 1
+  deg = np.bincount(s.edges.reshape(-1), minlength=len(s))
+  assert int((deg == 1).sum()) == 3  # three tips of the T
+  branch = np.flatnonzero(deg >= 3)
+  assert len(branch) >= 1
+  # the junction sits where the stem meets the bar: on the stem axis
+  # (x ~ 31.5), within the stem-bar merge region in y
+  bv = s.vertices[branch]
+  d = np.sqrt((bv[:, 0] - 31.5) ** 2 + (bv[:, 1] - 6.5) ** 2)
+  assert d.min() < 6.0, bv
+
+
+def test_fix_branching_off_is_the_fast_sloppy_path():
+  """fix_branching=False reuses one root-rooted predecessor tree: paths
+  can end on captured-but-off-tree voxels, so the result may fragment at
+  junctions (the exact artifact kimimaro's fix_branching repairs) — it
+  must still cover the object with at most a couple of pieces."""
+  s = skeletonize_mask(
+    _t_shape(), params=TeasarParams(scale=3, const=4), fix_branching=False
+  )
+  assert len(np.unique(s.components_by_vertex())) <= 2
+  assert len(s) > 10
+
+
+def test_soma_mode_star_topology():
+  """A cell body thicker than soma_acceptance_threshold gets a root at
+  the EDT max with radial paths (no zigzag over the soma surface): the
+  vertex nearest the ball center carries full-soma radius and the two
+  protruding neurites connect to it."""
+  mask = np.zeros((48, 48, 48), bool)
+  g = np.indices(mask.shape).astype(np.float32) - 23.5
+  ball = np.sqrt((g**2).sum(0)) < 12
+  mask |= ball
+  mask[2:24, 22:26, 22:26] = True  # neurite -x
+  mask[24:46, 22:26, 22:26] = True  # neurite +x
+  aniso = (300.0, 300.0, 300.0)  # EDT max ~ 12*300 = 3600 > 3500
+  s = skeletonize_mask(
+    mask, anisotropy=aniso,
+    params=TeasarParams(scale=4, const=300),
+  )
+  assert len(np.unique(s.components_by_vertex())) == 1
+  center = np.asarray([23.5 * 300] * 3, np.float32)
+  i = int(np.argmin(np.linalg.norm(s.vertices - center, axis=1)))
+  # root sits at the soma center (EDT max), within ~2 voxels
+  assert np.linalg.norm(s.vertices[i] - center) < 2.5 * 300
+  # and it carries the soma radius
+  assert s.radii[i] > 3000
+
+
+def test_fix_avocados_absorbs_nucleus():
+  """Soma label with its nucleus segmented separately: without the fix
+  the soma skeletonizes as a hollow shell (small radii); with it the
+  nucleus label is absorbed, dropped from the output, and the soma
+  re-EDTs as a solid body (full radius at the root)."""
+  labels = np.zeros((40, 40, 40), np.uint32)
+  g = np.indices(labels.shape).astype(np.float32) - 19.5
+  r = np.sqrt((g**2).sum(0))
+  labels[r < 14] = 1   # soma
+  labels[r < 6] = 2    # nucleus (wholly inside)
+  aniso = (200.0, 200.0, 200.0)  # solid EDT max ~ 14*200 = 2800 >= 1100
+  params = TeasarParams(scale=4, const=200)
+
+  plain = skeletonize(labels, anisotropy=aniso, params=params,
+                      fix_avocados=False)
+  fixed = skeletonize(labels, anisotropy=aniso, params=params,
+                      fix_avocados=True)
+
+  assert set(plain) == {1, 2}
+  assert set(fixed) == {1}  # nucleus absorbed
+  # hollow shell: max radius ~ half the shell thickness (~4 vox = 800);
+  # solid body: full soma radius (~14 vox = 2800)
+  assert plain[1].radii.max() < 1500
+  assert fixed[1].radii.max() > 2000
+
+
+def test_fix_avocados_respects_object_ids():
+  """Requesting only the nucleus must return its skeleton (the unrequested
+  soma cannot be a candidate, so it cannot absorb the requested label);
+  requesting only the soma absorbs the nucleus as usual."""
+  labels = np.zeros((40, 40, 40), np.uint32)
+  g = np.indices(labels.shape).astype(np.float32) - 19.5
+  r = np.sqrt((g**2).sum(0))
+  labels[r < 14] = 1
+  labels[r < 6] = 2
+  aniso = (200.0, 200.0, 200.0)
+  params = TeasarParams(scale=4, const=200)
+
+  only_nucleus = skeletonize(labels, anisotropy=aniso, params=params,
+                             object_ids=[2], fix_avocados=True)
+  assert set(only_nucleus) == {2}
+
+  only_soma = skeletonize(labels, anisotropy=aniso, params=params,
+                          object_ids=[1], fix_avocados=True)
+  assert set(only_soma) == {1}
+  assert only_soma[1].radii.max() > 2000  # nucleus absorbed: solid EDT
+
+
+def test_fix_avocados_keeps_independent_labels():
+  """A label merely ADJACENT to a soma (not engulfed) must not be
+  absorbed, and labels without cavities are untouched."""
+  labels = np.zeros((40, 40, 24), np.uint32)
+  g = np.indices(labels.shape).astype(np.float32)
+  r1 = np.sqrt(((g - np.array([12, 20, 12])[:, None, None, None]) ** 2).sum(0))
+  labels[r1 < 9] = 1
+  labels[r1 < 4] = 2          # nucleus inside label 1
+  labels[30:38, 16:24, 8:16] = 3  # independent neighbor block
+  aniso = (200.0, 200.0, 200.0)
+  out = skeletonize(
+    labels, anisotropy=aniso,
+    params=TeasarParams(scale=4, const=200), fix_avocados=True,
+  )
+  assert 3 in out       # untouched
+  assert 2 not in out   # absorbed
+  assert 1 in out
