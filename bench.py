@@ -259,10 +259,10 @@ def measure_transfer_MBps():
 
 
 def bench_mesh_kernel():
-  """BASELINE config 3: marching-tetrahedra count pass, BATCHED — K masks
-  per shard_map dispatch (the per-voxel device stage; emission is
-  O(surface) host work)."""
-  from igneous_tpu.ops.mesh import _count_kernel
+  """BASELINE config 3: marching-cubes count pass (the production
+  mesher), BATCHED — K masks per shard_map dispatch (the per-voxel device
+  stage; emission is O(surface) host work)."""
+  from igneous_tpu.ops.mesh import _mc_count_kernel as _count_kernel
   from igneous_tpu.parallel.executor import BatchKernelExecutor
 
   n = 64 if QUICK else 128

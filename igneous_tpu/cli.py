@@ -153,9 +153,9 @@ def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
               type=click.Choice(["image", "segmentation"]))
 @click.option("--encoding", default="raw", show_default=True)
 def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding):
-  """Ingest an array file (npy/npy.gz/nrrd/nii/nii.gz) as a Precomputed
-  layer (reference `igneous image create`, cli.py:1852-1923; h5/ckl need
-  their libraries and fail with instructions)."""
+  """Ingest an array file (npy/npy.gz/h5/nrrd/nii/nii.gz) as a Precomputed
+  layer (reference `igneous image create`, cli.py:1852-1923; ckl needs
+  the crackle library and fails with instructions)."""
   from .formats import load_volume_file
   from .volume import Volume
 

@@ -76,6 +76,25 @@ def load_nrrd(path: str) -> np.ndarray:
   return arr.reshape(sizes, order="F").astype(dtype, copy=False)
 
 
+def load_hdf5(path: str) -> np.ndarray:
+  """HDF5 ingest (reference cli.py:1867-1875 via h5py): read the dataset
+  named ``main`` when present (the conventional EM-volume dataset name),
+  otherwise the first dataset in the file."""
+  try:
+    import h5py
+  except ImportError as e:  # pragma: no cover - present in this image
+    raise ValueError(
+      "HDF5 ingest needs h5py; convert to .npy first (np.save(...))"
+    ) from e
+  with h5py.File(path, "r") as f:
+    if "main" in f and isinstance(f["main"], h5py.Dataset):
+      return f["main"][:]
+    for key in f:
+      if isinstance(f[key], h5py.Dataset):
+        return f[key][:]
+  raise ValueError(f"no dataset found in HDF5 file: {path}")
+
+
 def load_nifti(path: str) -> np.ndarray:
   """Minimal NIfTI-1 reader (.nii / .nii.gz, single-file form): 348-byte
   header + voxel data at vox_offset. Returns the (x, y, z[, t]) array
@@ -134,10 +153,7 @@ def load_volume_file(path: str) -> np.ndarray:
   if low.endswith((".nii", ".nii.gz")):
     return load_nifti(path)
   if low.endswith((".h5", ".hdf5")):
-    raise ValueError(
-      "HDF5 ingest needs h5py, which this environment does not ship; "
-      "convert to .npy/.nrrd/.nii first (np.save(...) from any h5 reader)."
-    )
+    return load_hdf5(path)
   if low.endswith(".ckl"):
     raise ValueError(
       "crackle (.ckl) ingest needs the crackle-codec package; decompress "

@@ -3,18 +3,23 @@
 Replaces the reference's zmesh C++ mesher for MeshTask
 (/root/reference/igneous/tasks/mesh/mesh.py:245 ``Mesher.mesh(data)``).
 
-TPU-first design: marching TETRAHEDRA instead of marching cubes. Each cell
-splits into 6 tetrahedra sharing the main diagonal; a tet has only 16
-sign cases, so the full case tables are generated programmatically at
-import (no hand-copied 256-entry MC tables), and per-cell work is a pure
-table-gather + arithmetic — exactly what vectorizes on the VPU. The
-surface is watertight and sits at the 0.5 iso-level of the binary mask
-(vertices at edge midpoints, like zmesh on binary masks).
+Two meshers share one TPU-first skeleton (two-pass count/emit, SURVEY.md
+§7 "hard parts": kernel 1 computes per-cell cases + triangle counts on
+device, O(voxels); the host then touches only the O(surface) slot set):
 
-Variable-size output uses the two-pass count/emit pattern (SURVEY.md §7
-"hard parts"): kernel 1 computes the per-slot validity mask and total
-count; host sizes a static capacity; kernel 2 gathers only the valid
-slots and emits vertex coordinates.
+* ``marching_cubes`` — true 256-case MC, zmesh's algorithm and the
+  production default. The case tables are GENERATED at import by walking
+  each case's surface loops over the cube's faces (segments per face,
+  chained through the shared crossing edges, fan-triangulated), with the
+  "separate inside corners" rule on ambiguous faces — a per-face rule, so
+  adjacent cells always agree and the surface is watertight by
+  construction. No hand-copied 256-entry tables.
+* ``marching_tetrahedra`` — 6-tet decomposition with 16-case tables; kept
+  as an independent second implementation (its output doubles as a
+  cross-check oracle: same voxel volume, same topology, ~2x triangles).
+
+Both emit vertices at cube-edge midpoints (the 0.5 iso-level of the
+binary mask, like zmesh on binary masks).
 """
 
 from __future__ import annotations
@@ -207,10 +212,61 @@ def _weld(tris, anisotropy, offset):
   vertices = uniq.astype(np.float32) / 2.0
   faces = inverse.reshape(-1, 3).astype(np.uint32)
   faces = drop_degenerate_faces(faces)
+  faces = _cancel_coincident_pairs(faces)
+  # prune vertices orphaned by the cancellation
+  used = np.zeros(len(vertices), dtype=bool)
+  used[faces.reshape(-1)] = True
+  if not used.all():
+    remap = np.cumsum(used) - 1
+    vertices = vertices[used]
+    faces = remap[faces.astype(np.int64)].astype(np.uint32)
   vertices = (vertices + np.asarray(offset, dtype=np.float32)) * np.asarray(
     anisotropy, dtype=np.float32
   )
   return vertices, faces
+
+
+def _cancel_coincident_pairs(faces: np.ndarray) -> np.ndarray:
+  """Drop pairs of coincident triangles (same vertex triple).
+
+  Marching cubes' fan triangulation can place a diagonal in a cell face's
+  plane; when the loop has further vertices on that same face, a whole fan
+  triangle can lie IN the shared face and the neighboring cell emits the
+  mirrored copy — a zero-volume fin. The pair cancels exactly: removing
+  both lowers each boundary edge's face count by 2, so closedness (even
+  counts) is preserved. An odd-multiplicity group (fin pair + a real
+  surface triangle) keeps one member of the MAJORITY winding — the real
+  triangle's orientation appears twice (its own copy plus the matching
+  fin half), so the survivor faces outward.
+  """
+  if len(faces) == 0:
+    return faces
+  key = np.sort(faces, axis=1)
+  _, inv, cnt = np.unique(key, axis=0, return_inverse=True,
+                          return_counts=True)
+  if (cnt <= 1).all():
+    return faces
+  keep = cnt[inv] == 1
+  # group duplicate rows by one argsort instead of rescanning per group
+  dup_ids = np.flatnonzero(~keep)
+  order = dup_ids[np.argsort(inv[dup_ids], kind="stable")]
+  ginv = inv[order]
+  starts = np.flatnonzero(np.concatenate([[True], ginv[1:] != ginv[:-1]]))
+  ends = np.concatenate([starts[1:], [len(order)]])
+  # winding parity: (a,b,c) is an even permutation of its sorted triple
+  perm = np.argsort(faces[order], axis=1)
+  even = (
+    (perm == (0, 1, 2)).all(axis=1)
+    | (perm == (1, 2, 0)).all(axis=1)
+    | (perm == (2, 0, 1)).all(axis=1)
+  )
+  for s, e in zip(starts, ends):
+    if (e - s) % 2 == 0:
+      continue
+    grp_even = even[s:e]
+    maj = grp_even if grp_even.sum() * 2 > (e - s) else ~grp_even
+    keep[order[s + int(np.flatnonzero(maj)[0])]] = True
+  return faces[keep]
 
 
 _EMPTY_MESH = (
@@ -220,18 +276,17 @@ _EMPTY_MESH = (
 _COUNT_EXECUTOR = None
 
 
-def marching_tetrahedra_batch(
-  masks, anisotropy=(1.0, 1.0, 1.0), offsets=None, executor=None,
-  batch_size: int = 16,
+def _isosurface_batch(
+  masks, anisotropy, offsets, executor, batch_size, get_executor, emit_k
 ):
-  """Batched isosurface extraction: list of binary (x, y, z) masks →
-  list of (vertices, faces), identical to per-mask marching_tetrahedra.
+  """Shared batched count/emit orchestration for both meshers.
 
   Masks are padded into power-of-two shape buckets and each bucket's
   members run the count pass as ONE shard_map'd device dispatch with the
   mask axis partitioned over the mesh (VERDICT round-1 item 3: the mesh
   forge's per-voxel stage in the batched path). Emission stays host-side
-  per mask (O(surface)).
+  per mask (O(surface)); ``emit_k(results, k, shape, real_cells)``
+  unpacks member k of the kernel outputs into a triangle array.
   """
   if offsets is None:
     offsets = [(0.0, 0.0, 0.0)] * len(masks)
@@ -243,13 +298,9 @@ def marching_tetrahedra_batch(
     groups.setdefault(_bucket_shape(m.shape), []).append(i)
 
   if executor is None:
-    # one module-level executor: its jit cache covers every shape bucket
-    global _COUNT_EXECUTOR
-    if _COUNT_EXECUTOR is None:
-      from ..parallel.executor import BatchKernelExecutor
-
-      _COUNT_EXECUTOR = BatchKernelExecutor(_count_kernel)
-    executor = _COUNT_EXECUTOR
+    # one module-level executor per kernel: its jit cache covers every
+    # shape bucket
+    executor = get_executor()
 
   for bucket, idxs in groups.items():
     # cap group size: an uncapped bucket (e.g. hundreds of labels sharing
@@ -262,21 +313,51 @@ def marching_tetrahedra_batch(
         )
         for i in gidx
       ])  # (K, z, y, x)
-      cases_b, per_b, totals = executor(batch)
+      results = executor(batch)
+      totals = results[-1]
       for k, i in enumerate(gidx):
         if int(totals[k]) == 0:
           out[i] = _EMPTY_MESH
           continue
         orig = masks[i].shape
-        tris = _emit_host(
-          [c[k] for c in cases_b], [p[k] for p in per_b], batch.shape[1:],
-          real_cells=(orig[0] - 1, orig[1] - 1, orig[2] - 1),
+        tris = emit_k(
+          results, k, batch.shape[1:],
+          (orig[0] - 1, orig[1] - 1, orig[2] - 1),
         )
         if len(tris) == 0:
           out[i] = _EMPTY_MESH
           continue
         out[i] = _weld(tris, anisotropy, offsets[i])
   return out
+
+
+def _mt_executor():
+  global _COUNT_EXECUTOR
+  if _COUNT_EXECUTOR is None:
+    from ..parallel.executor import BatchKernelExecutor
+
+    _COUNT_EXECUTOR = BatchKernelExecutor(_count_kernel)
+  return _COUNT_EXECUTOR
+
+
+def _mt_emit_k(results, k, shape, real_cells):
+  cases_b, per_b, _ = results
+  return _emit_host(
+    [c[k] for c in cases_b], [p[k] for p in per_b], shape,
+    real_cells=real_cells,
+  )
+
+
+def marching_tetrahedra_batch(
+  masks, anisotropy=(1.0, 1.0, 1.0), offsets=None, executor=None,
+  batch_size: int = 16,
+):
+  """Batched isosurface extraction: list of binary (x, y, z) masks →
+  list of (vertices, faces), identical to per-mask marching_tetrahedra."""
+  return _isosurface_batch(
+    masks, anisotropy, offsets, executor, batch_size,
+    _mt_executor, _mt_emit_k,
+  )
 
 
 def marching_tetrahedra(
@@ -312,3 +393,239 @@ def marching_tetrahedra(
   if len(tris) == 0:
     return _EMPTY_MESH
   return _weld(tris, anisotropy, offset)
+
+
+# ---------------------------------------------------------------------------
+# marching cubes (256-case), tables generated by surface-loop walking
+
+
+def _build_mc_tables():
+  """Generate the 256-case MC tables programmatically.
+
+  For each corner-insideness case, surface segments are produced per cube
+  face (0, 1, or 2 segments from the face's 4 crossing pattern; ambiguous
+  faces — diagonal inside corners — always SEPARATE the inside corners, a
+  rule that depends only on the shared face so adjacent cells agree and
+  the global surface is watertight), chained into closed loops through
+  the crossing cube edges (each crossing edge borders exactly two faces),
+  and fan-triangulated. Orientation: each loop's Newell normal is made to
+  point away from the mean of the loop's inside corner endpoints.
+
+  Returns (ntri[256], tris[256, MAXT, 3] edge ids padded with 0,
+  edge_mid[12, 3] midpoint offsets).
+  """
+  # 12 cube edges as corner pairs (corner i at (i&1, i>>1&1, i>>2&1))
+  edge_pairs = []
+  for a in range(8):
+    for d in range(3):
+      if not (a >> d) & 1:
+        edge_pairs.append((a, a | (1 << d)))
+  edge_id = {p: i for i, p in enumerate(edge_pairs)}  # 12 edges
+  edge_mid = np.array(
+    [(CORNER_OFFSETS[a] + CORNER_OFFSETS[b]) / 2.0 for a, b in edge_pairs],
+    dtype=np.float32,
+  )
+
+  # 6 faces: (axis, side) -> 4 corners in cyclic order around the face
+  faces = []
+  for d in range(3):
+    u, v = (d + 1) % 3, (d + 2) % 3
+    for s in (0, 1):
+      cyc = []
+      for bu, bv in ((0, 0), (1, 0), (1, 1), (0, 1)):
+        cyc.append((s << d) | (bu << u) | (bv << v))
+      faces.append(cyc)
+
+  all_tris = []
+  for case in range(256):
+    inside = [(case >> i) & 1 for i in range(8)]
+    segments = []  # pairs of edge ids
+    for cyc in faces:
+      cross = [
+        k for k in range(4)
+        if inside[cyc[k]] != inside[cyc[(k + 1) % 4]]
+      ]  # indices into the face cycle: edge (cyc[k], cyc[k+1]) crosses
+      def eid(k):
+        a, b = cyc[k], cyc[(k + 1) % 4]
+        return edge_id[(min(a, b), max(a, b))]
+      if len(cross) == 2:
+        segments.append((eid(cross[0]), eid(cross[1])))
+      elif len(cross) == 4:
+        # ambiguous: exactly two diagonal inside corners; cut each inside
+        # corner off individually. corner cyc[k] sits between face edges
+        # k-1 and k.
+        for k in range(4):
+          if inside[cyc[k]] and not inside[cyc[(k + 1) % 4]] \
+             and not inside[cyc[(k - 1) % 4]]:
+            segments.append((eid((k - 1) % 4), eid(k)))
+
+    # chain segments into loops (each crossing edge appears in exactly 2
+    # segments -> every vertex has degree 2)
+    tris_case = []
+    if segments:
+      adj = {}
+      for a, b in segments:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, []).append(a)
+      unvisited = set(adj)
+      loops = []
+      while unvisited:
+        start = min(unvisited)
+        loop = [start]
+        unvisited.discard(start)
+        prev, cur = None, start
+        while True:
+          nxt = [x for x in adj[cur] if x != prev]
+          # a double edge (two segments between the same pair) closes a
+          # 2-loop; guard by preferring unvisited continuation
+          nxt = nxt[0] if nxt else adj[cur][0]
+          if nxt == start:
+            break
+          loop.append(nxt)
+          unvisited.discard(nxt)
+          prev, cur = cur, nxt
+        loops.append(loop)
+
+      for loop in loops:
+        pts = edge_mid[loop]
+        # Newell normal of the (possibly non-planar) loop
+        n = np.zeros(3)
+        for i in range(len(loop)):
+          p0, p1 = pts[i], pts[(i + 1) % len(loop)]
+          n += np.cross(p0, p1)
+        # inside reference: mean of the loop's inside corner endpoints
+        ref = np.zeros(3)
+        cnt = 0
+        for e in loop:
+          a, b = edge_pairs[e]
+          c = a if inside[a] else b
+          ref += CORNER_OFFSETS[c]
+          cnt += 1
+        ref /= cnt
+        flip = np.dot(n, pts.mean(axis=0) - ref) < 0
+        for i in range(1, len(loop) - 1):
+          t = (loop[0], loop[i], loop[i + 1])
+          tris_case.append((t[0], t[2], t[1]) if flip else t)
+    all_tris.append(tris_case)
+
+  maxt = max(len(t) for t in all_tris)
+  ntri = np.array([len(t) for t in all_tris], dtype=np.int32)
+  tris = np.zeros((256, maxt, 3), dtype=np.int32)
+  for case, tc in enumerate(all_tris):
+    for k, t in enumerate(tc):
+      tris[case, k] = t
+  return ntri, tris, edge_mid
+
+
+MC_NTRI, MC_TRIS, MC_EDGE_MID = _build_mc_tables()
+
+
+@jax.jit
+def _mc_count_kernel(mask: jnp.ndarray):
+  """mask (z, y, x) uint8 → (case (cz,cy,cx) int32, ntri, total).
+
+  One 256-entry table gather per cell — constant-table ``take`` lowers to
+  a vectorized gather on the VPU."""
+  sz, sy, sx = mask.shape
+  cz, cy, cx = sz - 1, sy - 1, sx - 1
+  case = jnp.zeros((cz, cy, cx), dtype=jnp.int32)
+  for i in range(8):
+    ox, oy, oz = i & 1, (i >> 1) & 1, (i >> 2) & 1
+    case = case + (
+      mask[oz : oz + cz, oy : oy + cy, ox : ox + cx].astype(jnp.int32) << i
+    )
+  ntri = jnp.take(jnp.asarray(MC_NTRI), case)
+  return case, ntri, jnp.sum(ntri, dtype=jnp.int32)
+
+
+def _mc_emit_host(case_np, ntri_np, shape, real_cells=None) -> np.ndarray:
+  """Host-side MC triangle emission, O(triangles) numpy fancy indexing.
+  Returns (n, 3, 3) vertex coords in (x, y, z) voxel units."""
+  sz, sy, sx = shape
+  cz, cy, cx = sz - 1, sy - 1, sx - 1
+  ntri = np.asarray(ntri_np).reshape(-1)
+  case = np.asarray(case_np).reshape(-1)
+  if real_cells is not None:
+    rx, ry, rz = real_cells
+    flat = np.arange(ntri.shape[0], dtype=np.int64)
+    in_real = (
+      (flat % cx < rx) & ((flat // cx) % cy < ry) & (flat // (cy * cx) < rz)
+    )
+    ntri = np.where(in_real, ntri, 0)
+  cells = np.flatnonzero(ntri)
+  if len(cells) == 0:
+    return np.zeros((0, 3, 3), dtype=np.float32)
+  reps = ntri[cells]
+  cell = np.repeat(cells, reps)
+  # per-triangle index within its cell: arange minus each cell's start
+  starts = np.concatenate([[0], np.cumsum(reps)[:-1]])
+  k = np.arange(len(cell), dtype=np.int64) - np.repeat(starts, reps)
+  edges = MC_TRIS[case[cell], k]  # (n, 3) edge ids
+  mid = MC_EDGE_MID[edges]  # (n, 3, 3)
+  base = np.stack(
+    [cell % cx, (cell // cx) % cy, cell // (cy * cx)], axis=-1
+  ).astype(np.float32)
+  return base[:, None, :] + mid
+
+
+_MC_COUNT_EXECUTOR = None
+
+
+def marching_cubes(
+  mask: np.ndarray, anisotropy=(1.0, 1.0, 1.0), offset=(0.0, 0.0, 0.0)
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Binary mask (x, y, z) → (vertices (V,3) float32, faces (F,3) uint32).
+
+  True 256-case marching cubes (zmesh's algorithm; ~half the triangles of
+  marching_tetrahedra for the same surface). Vertices in physical units:
+  (voxel + offset) * anisotropy. Watertight over the mask interior; pad
+  with a zero shell to close surfaces at the array boundary."""
+  if mask.ndim != 3:
+    raise ValueError("mask must be 3d")
+  orig = mask.shape
+  bucket = _bucket_shape(orig)
+  mask = _pad_to_bucket(mask, bucket)
+  dev = jnp.asarray(
+    np.ascontiguousarray(mask.astype(np.uint8).transpose(2, 1, 0))
+  )
+  case, ntri, total = _mc_count_kernel(dev)
+  if int(total) == 0:
+    return _EMPTY_MESH
+  tris = _mc_emit_host(
+    np.asarray(case), np.asarray(ntri), dev.shape,
+    real_cells=(orig[0] - 1, orig[1] - 1, orig[2] - 1),
+  )
+  if len(tris) == 0:
+    return _EMPTY_MESH
+  return _weld(tris, anisotropy, offset)
+
+
+def _mc_executor():
+  global _MC_COUNT_EXECUTOR
+  if _MC_COUNT_EXECUTOR is None:
+    from ..parallel.executor import BatchKernelExecutor
+
+    _MC_COUNT_EXECUTOR = BatchKernelExecutor(_mc_count_kernel)
+  return _MC_COUNT_EXECUTOR
+
+
+def _mc_emit_k(results, k, shape, real_cells):
+  case_b, ntri_b, _ = results
+  return _mc_emit_host(
+    np.asarray(case_b[k]), np.asarray(ntri_b[k]), shape,
+    real_cells=real_cells,
+  )
+
+
+def marching_cubes_batch(
+  masks, anisotropy=(1.0, 1.0, 1.0), offsets=None, executor=None,
+  batch_size: int = 16,
+):
+  """Batched marching cubes: list of binary (x, y, z) masks → list of
+  (vertices, faces), identical to per-mask marching_cubes. Same
+  shard_map'd one-dispatch-per-bucket count pass as
+  marching_tetrahedra_batch."""
+  return _isosurface_batch(
+    masks, anisotropy, offsets, executor, batch_size,
+    _mc_executor, _mc_emit_k,
+  )

@@ -8,7 +8,8 @@ Reference parity: /root/reference/igneous/tasks/mesh/mesh.py
   TransferMeshFilesTask (:726), DeleteMeshFilesTask (:741)
 
 TPU-first difference: isosurface extraction runs on device
-(ops.mesh.marching_tetrahedra) per label over its cropped bounding box.
+(ops.mesh.marching_cubes by default; ``mesher="tetrahedra"`` selects the
+6-tet variant) per label over its cropped bounding box.
 """
 
 from __future__ import annotations
@@ -26,7 +27,7 @@ from ..storage import CloudFiles
 from ..volume import Volume
 from ..mesh_io import FragMap, Mesh, encode_mesh, simplify
 from ..ops import remap as fastremap
-from ..ops.mesh import marching_tetrahedra_batch
+from ..ops.mesh import marching_cubes_batch, marching_tetrahedra_batch
 from ..spatial_index import SpatialIndex
 
 
@@ -60,6 +61,7 @@ class MeshTask(RegisteredTask):
     closed_dataset_edges: bool = True,
     fill_holes: int = 0,
     timestamp: Optional[float] = None,
+    mesher: str = "cubes",
   ):
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
@@ -77,6 +79,9 @@ class MeshTask(RegisteredTask):
     self.closed_dataset_edges = closed_dataset_edges
     self.fill_holes = int(fill_holes)
     self.timestamp = timestamp
+    if mesher not in ("cubes", "tetrahedra"):
+      raise ValueError(f"mesher must be 'cubes' or 'tetrahedra': {mesher!r}")
+    self.mesher = mesher
 
   def execute(self):
     vol = Volume(
@@ -159,7 +164,11 @@ class MeshTask(RegisteredTask):
     res_int = np.asarray(vol.resolution, dtype=np.int64)
     for g0 in range(0, len(jobs), self.MESH_BATCH):
       group = jobs[g0 : g0 + self.MESH_BATCH]
-      results = marching_tetrahedra_batch(
+      mesher_batch = (
+        marching_cubes_batch if self.mesher == "cubes"
+        else marching_tetrahedra_batch
+      )
+      results = mesher_batch(
         [dense[grow] == new_id for _, grow, new_id in group],
         anisotropy=resolution,
         offsets=[

@@ -336,11 +336,32 @@ def test_formats_gated_extensions(tmp_path):
 
   from igneous_tpu.formats import load_volume_file
 
-  for name, msg in (("x.h5", "h5py"), ("x.ckl", "crackle")):
+  for name, msg in (("x.ckl", "crackle"),):
     p = tmp_path / name
     p.write_bytes(b"")
     with _pytest.raises(ValueError, match=msg):
       load_volume_file(str(p))
+
+
+def test_formats_hdf5_ingest(tmp_path, rng):
+  """h5 ingest: prefers the conventional 'main' dataset, falls back to
+  the first dataset (reference cli.py:1867-1875)."""
+  h5py = pytest.importorskip("h5py")
+  from igneous_tpu.formats import load_volume_file
+
+  arr = rng.integers(0, 255, (13, 9, 5), dtype=np.uint8)
+  other = rng.integers(0, 255, (4, 4), dtype=np.uint8)
+
+  p1 = str(tmp_path / "with_main.h5")
+  with h5py.File(p1, "w") as f:
+    f.create_dataset("aaa_first_alphabetically", data=other)
+    f.create_dataset("main", data=arr)
+  assert np.array_equal(load_volume_file(p1), arr)
+
+  p2 = str(tmp_path / "no_main.hdf5")
+  with h5py.File(p2, "w") as f:
+    f.create_dataset("volume", data=arr)
+  assert np.array_equal(load_volume_file(p2), arr)
 
 
 def test_cli_image_create_nrrd(tmp_path, rng):

@@ -403,3 +403,124 @@ def test_simplification_max_error_respected():
   pts = sample_surface(fine.vertices, fine.faces, 2000)
   hmax, hmean = one_sided_hausdorff(pts, v)
   assert hmean < 0.8
+
+
+# ---------------------------------------------------------------------------
+# marching cubes (256-case, generated tables)
+
+
+def _edge_counts(f):
+  e = np.sort(
+    f[:, [0, 1, 1, 2, 2, 0]].reshape(-1, 2).astype(np.int64), axis=1
+  )
+  _, c = np.unique(e, axis=0, return_counts=True)
+  return c
+
+
+def test_mc_tables_shape_and_extremes():
+  from igneous_tpu.ops.mesh import MC_NTRI, MC_TRIS
+
+  assert MC_NTRI.shape == (256,)
+  assert MC_NTRI[0] == 0 and MC_NTRI[255] == 0
+  assert MC_TRIS.shape[1] == 5  # classic MC: at most 5 triangles per cell
+  # single-corner cases cut off one corner with one triangle
+  for i in range(8):
+    assert MC_NTRI[1 << i] == 1
+  # NOTE: complement symmetry does NOT hold — the separate-inside-corners
+  # ambiguity rule is orientation-dependent by design (that per-face
+  # asymmetry is what makes adjacent cells consistent).
+
+
+def test_mc_sphere_manifold_and_volume():
+  from igneous_tpu.ops.mesh import marching_cubes
+
+  g = np.indices((40, 40, 40)).astype(np.float32) - 19.5
+  mask = (np.sqrt((g**2).sum(0)) < 15).astype(np.uint8)
+  v, f = marching_cubes(mask)
+  vt, ft = marching_tetrahedra(mask)
+  # manifold: every edge shared by exactly two faces
+  assert np.all(_edge_counts(f) == 2)
+  # ~1/3 the triangles of marching tetrahedra for the same surface
+  assert len(f) < 0.5 * len(ft)
+  # outward orientation + volume agreement with the MT oracle
+  sv, svt = signed_volume(v, f), signed_volume(vt, ft)
+  assert sv > 0 and svt > 0
+  assert abs(sv - svt) / svt < 0.01
+
+
+def test_mc_adversarial_blobs_closed():
+  """Random noise exercises every ambiguous case: the surface must stay
+  closed (even face count on every edge) with no coincident faces."""
+  from scipy import ndimage
+
+  from igneous_tpu.ops.mesh import marching_cubes
+
+  rng = np.random.default_rng(7)
+  for _ in range(4):
+    m = ndimage.binary_closing(rng.random((18, 16, 14)) < 0.4)
+    m = np.pad(m, 1).astype(np.uint8)
+    v, f = marching_cubes(m)
+    c = _edge_counts(f)
+    assert np.all(c % 2 == 0), np.bincount(c)
+    key = np.sort(f, axis=1)
+    _, cnt = np.unique(key, axis=0, return_counts=True)
+    assert np.all(cnt == 1)  # coincident fins cancelled
+    # no orphaned vertices
+    assert len(np.unique(f.reshape(-1))) == len(v)
+
+
+def test_mc_checkerboard_every_cell_ambiguous():
+  from igneous_tpu.ops.mesh import marching_cubes
+
+  m = np.zeros((8, 8, 8), np.uint8)
+  m[(np.indices((8, 8, 8)).sum(0) % 2) == 0] = 1
+  m = np.pad(m, 1)
+  v, f = marching_cubes(m)
+  assert len(f) > 0
+  assert np.all(_edge_counts(f) % 2 == 0)
+
+
+def test_mc_batch_matches_solo(rng):
+  from igneous_tpu.ops.mesh import marching_cubes, marching_cubes_batch
+
+  masks = []
+  for _ in range(5):
+    m = (rng.random((12, 10, 14)) < 0.35).astype(np.uint8)
+    masks.append(np.pad(m, 1))
+  offsets = [(float(i), 0.0, float(-i)) for i in range(len(masks))]
+  batched = marching_cubes_batch(masks, anisotropy=(2, 3, 4), offsets=offsets)
+  for m, off, (vb, fb) in zip(masks, offsets, batched):
+    vs, fs = marching_cubes(m, anisotropy=(2, 3, 4), offset=off)
+    assert np.array_equal(vs, vb)
+    assert np.array_equal(fs, fb)
+
+
+def test_mesh_task_mesher_option(tmp_path):
+  """MeshTask defaults to marching cubes; 'tetrahedra' still works and a
+  bad value raises."""
+  from igneous_tpu.tasks.mesh import MeshTask
+
+  with pytest.raises(ValueError, match="mesher"):
+    MeshTask(shape=(8, 8, 8), offset=(0, 0, 0), layer_path="file:///x",
+             mesher="marching")
+  assert MeshTask(
+    shape=(8, 8, 8), offset=(0, 0, 0), layer_path="file:///x"
+  ).mesher == "cubes"
+
+
+def test_cancel_coincident_pairs_majority_winding():
+  from igneous_tpu.ops.mesh import _cancel_coincident_pairs
+
+  faces = np.array(
+    [[5, 6, 7],     # unique — kept
+     [0, 1, 2],     # real surface triangle (even winding)
+     [2, 1, 0],     # fin half, mirrored
+     [1, 2, 0]],    # fin half, same winding as the real one
+    np.uint32,
+  )
+  out = _cancel_coincident_pairs(faces)
+  assert len(out) == 2
+  assert [5, 6, 7] in out.tolist()
+  # the survivor of the triple has the majority (outward) winding
+  surv = [f for f in out.tolist() if sorted(f) == [0, 1, 2]][0]
+  assert surv in ([0, 1, 2], [1, 2, 0], [2, 0, 1])
