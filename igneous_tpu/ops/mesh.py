@@ -94,7 +94,6 @@ def _build_tables():
 
 
 NTRIS_TABLE, EDGES_TABLE = _build_tables()
-MAX_SLOTS_PER_CELL = 12  # 6 tets x 2 triangles
 
 
 def _case_list(mask: jnp.ndarray):

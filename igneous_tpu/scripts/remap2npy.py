@@ -1,0 +1,43 @@
+"""Convert an HDF5 remap table to .npy for WatershedRemapTask.
+
+Parity with the reference's legacy converter
+(/root/reference/igneous/scripts/remap2npy.py): watershed remap tables
+were historically distributed as HDF5; WatershedRemapTask
+(igneous_tpu/tasks/obsolete.py) consumes .npy. Reads the conventional
+``main`` dataset (else the first dataset) and writes ``<input>.npy``
+next to the source.
+
+Usage:
+  python -m igneous_tpu.scripts.remap2npy TABLE.h5 [TABLE2.h5 ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def convert(path: str) -> str:
+  from ..formats import load_hdf5
+
+  arr = np.asarray(load_hdf5(path))
+  out = os.path.splitext(path)[0] + ".npy"
+  np.save(out, arr)
+  return out
+
+
+def main(argv=None) -> int:
+  argv = sys.argv[1:] if argv is None else argv
+  if not argv:
+    print(__doc__.strip(), file=sys.stderr)
+    return 2
+  for path in argv:
+    out = convert(path)
+    print(f"{path} -> {out}")
+  return 0
+
+
+if __name__ == "__main__":
+  raise SystemExit(main())

@@ -361,3 +361,20 @@ def test_sqlite_index_uint64_labels(tmp_path):
   db = str(tmp_path / "i.db")
   assert si.to_sqlite(db) == 1
   assert SpatialIndex.query_sqlite(db) == {big}
+
+
+def test_remap2npy_script(tmp_path):
+  h5py = pytest.importorskip("h5py")
+  import numpy as np
+
+  from igneous_tpu.scripts.remap2npy import convert, main
+
+  table = np.arange(100, dtype=np.uint64) * 3
+  src = str(tmp_path / "remap.h5")
+  with h5py.File(src, "w") as f:
+    f.create_dataset("main", data=table)
+  out = convert(src)
+  assert out.endswith(".npy")
+  assert np.array_equal(np.load(out), table)
+  assert main([src]) == 0
+  assert main([]) == 2
