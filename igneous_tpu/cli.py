@@ -40,6 +40,17 @@ class Tuple3(click.ParamType):
 TUPLE3 = Tuple3()
 
 
+def parse_id_list(value):
+  """'5,6,7' → [5, 6, 7]; tolerant of blanks; None/empty → None."""
+  if not value:
+    return None
+  try:
+    ids = [int(tok) for tok in str(value).split(",") if tok.strip()]
+  except ValueError:
+    raise click.UsageError(f"not a comma-separated id list: {value!r}")
+  return ids or None
+
+
 def enqueue(queue_spec: str, tasks, parallel: int = 1):
   from .queues import LocalTaskQueue, TaskQueue
 
@@ -435,9 +446,16 @@ def mesh():
 @click.option("--fill-missing", is_flag=True)
 @click.option("--sharded", is_flag=True)
 @click.option("--spatial-index/--no-spatial-index", default=True, show_default=True)
+@click.option("--obj-ids", default=None,
+              help="comma-separated: mesh only these labels")
+@click.option("--exclude-obj-ids", default=None,
+              help="comma-separated: never mesh these labels")
+@click.option("--mesher", default="cubes", show_default=True,
+              type=click.Choice(["cubes", "tetrahedra"]))
 @click.pass_context
 def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
-               mesh_dir, dust_threshold, fill_missing, sharded, spatial_index):
+               mesh_dir, dust_threshold, fill_missing, sharded, spatial_index,
+               obj_ids, exclude_obj_ids, mesher):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_meshing_tasks(
@@ -447,6 +465,9 @@ def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
     mesh_dir=mesh_dir, dust_threshold=dust_threshold,
     fill_missing=fill_missing, sharded=sharded,
     spatial_index=spatial_index,
+    object_ids=parse_id_list(obj_ids),
+    exclude_object_ids=parse_id_list(exclude_obj_ids),
+    mesher=mesher,
   ), ctx.obj["parallel"])
 
 
@@ -678,7 +699,8 @@ def skeleton_convert(path, out_dir, skel_dir, labels):
   sdir = skel_dir_for(vol, skel_dir)
   attrs = (vol.cf.get_json(f"{sdir}/info") or {}).get("vertex_attributes")
   os.makedirs(out_dir, exist_ok=True)
-  wanted = set(int(l) for l in labels.split(",")) if labels else None
+  ids = parse_id_list(labels)
+  wanted = set(ids) if ids else None
   n = 0
   for key in vol.cf.list(f"{sdir}/"):
     name = key.split("/")[-1]

@@ -35,6 +35,8 @@ def create_meshing_tasks(
   mesh_dir: Optional[str] = None,
   dust_threshold: Optional[int] = None,
   object_ids: Optional[Sequence[int]] = None,
+  exclude_object_ids: Optional[Sequence[int]] = None,
+  remap_table: Optional[dict] = None,
   fill_missing: bool = False,
   encoding: str = "precomputed",
   spatial_index: bool = True,
@@ -42,6 +44,7 @@ def create_meshing_tasks(
   bounds: Optional[Bbox] = None,
   closed_dataset_edges: bool = True,
   fill_holes: int = 0,
+  mesher: str = "cubes",
 ):
   """Stage-1 mesh forge grid; creates the mesh info
   (reference task_creation/mesh.py:158-267)."""
@@ -81,12 +84,17 @@ def create_meshing_tasks(
       mesh_dir=mesh_dir,
       dust_threshold=dust_threshold,
       object_ids=list(object_ids) if object_ids else None,
+      exclude_object_ids=(
+        list(exclude_object_ids) if exclude_object_ids else None
+      ),
+      remap_table=remap_table,
       fill_missing=fill_missing,
       encoding=encoding,
       spatial_index=spatial_index,
       sharded=sharded,
       closed_dataset_edges=closed_dataset_edges,
       fill_holes=fill_holes,
+      mesher=mesher,
     )
 
   def finish():

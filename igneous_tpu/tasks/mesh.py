@@ -54,6 +54,8 @@ class MeshTask(RegisteredTask):
     mesh_dir: Optional[str] = None,
     dust_threshold: Optional[int] = None,
     object_ids: Optional[Sequence[int]] = None,
+    exclude_object_ids: Optional[Sequence[int]] = None,
+    remap_table: Optional[dict] = None,
     fill_missing: bool = False,
     encoding: str = "precomputed",
     spatial_index: bool = True,
@@ -72,6 +74,16 @@ class MeshTask(RegisteredTask):
     self.mesh_dir = mesh_dir
     self.dust_threshold = dust_threshold
     self.object_ids = list(object_ids) if object_ids else None
+    self.exclude_object_ids = (
+      list(exclude_object_ids) if exclude_object_ids else None
+    )
+    # {orig_id: new_id} agglomeration applied before meshing (reference
+    # mesh.py remap_table: proofreading merges without rewriting the
+    # stored segmentation). Only the table's keys are meshed; see execute.
+    self.remap_table = (
+      {int(k): int(v) for k, v in remap_table.items()} if remap_table
+      else None
+    )
     self.fill_missing = fill_missing
     self.encoding = encoding
     self.spatial_index = spatial_index
@@ -105,8 +117,20 @@ class MeshTask(RegisteredTask):
     else:
       img = vol.download(cutout)[..., 0]
 
+    if self.remap_table:
+      # reference semantics (mesh.py:358-369): ONLY the table's keys are
+      # meshed — everything else is masked to background first — and
+      # background can never be remapped into a real label
+      table = dict(self.remap_table)
+      table[0] = 0
+      img = fastremap.mask_except(img, list(table.keys()))
+      img = fastremap.remap(img, table)
+
     if self.object_ids:
       img = fastremap.mask_except(img, self.object_ids)
+
+    if self.exclude_object_ids:
+      img = fastremap.mask(img, self.exclude_object_ids)
 
     if self.fill_holes:
       # close internal cavities so meshes have no interior shells

@@ -524,3 +524,32 @@ def test_cancel_coincident_pairs_majority_winding():
   # the survivor of the triple has the majority (outward) winding
   surv = [f for f in out.tolist() if sorted(f) == [0, 1, 2]][0]
   assert surv in ([0, 1, 2], [1, 2, 0], [2, 0, 1])
+
+
+def test_mesh_remap_table_and_exclude(tmp_path):
+  """remap_table agglomerates before meshing with reference semantics
+  (mesh.py:358-369): ONLY the table's keys are meshed — a proofreading
+  table maps every supervoxel to its root, including identity entries —
+  labels outside the table are dropped, and 0 can never be remapped.
+  exclude_object_ids drops labels after remapping."""
+  data = np.zeros((64, 64, 64), dtype=np.uint64)
+  data[4:30, 4:30, 4:30] = 5
+  data[30:60, 4:30, 4:30] = 6    # touching 5: agglomerate 6 -> 5
+  data[4:30, 34:60, 34:60] = 9   # excluded even though in the table
+  data[34:60, 34:60, 4:30] = 7   # NOT in the table: silently dropped
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(data, path, layer_type="segmentation")
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="mesh",
+    remap_table={5: 5, 6: 5, 9: 9, 0: 123},  # 0 key is force-guarded
+    exclude_object_ids=[9],
+  ))
+  vol = Volume(path)
+  frags = [k.split("/")[-1] for k in vol.cf.list("mesh/") if ":0:" in k]
+  labels = {f.split(":")[0] for f in frags}
+  assert labels == {"5"}
+  # the agglomerated mesh covers BOTH bricks' volume
+  m = Mesh.from_precomputed(vol.cf.get(f"mesh/{frags[0]}"))
+  vol5 = abs(signed_volume(m.vertices, m.faces))
+  merged = (26 * 26 * 26 + 30 * 26 * 26)
+  assert abs(vol5 - merged) / merged < 0.1
