@@ -184,9 +184,14 @@ def create_transfer_tasks(
   factor: Optional[Sequence[int]] = None,
   memory_target: int = MEMORY_TARGET,
   downsample_method: str = "auto",
+  agglomerate: bool = False,
+  timestamp: Optional[float] = None,
+  stop_layer: Optional[int] = None,
 ):
   """Grid of TransferTasks; creates/extends the destination info
-  (reference: task_creation/image.py:921-1170)."""
+  (reference: task_creation/image.py:921-1170). ``agglomerate``/
+  ``timestamp``/``stop_layer`` materialize a graphene volume's proofread
+  root (or L2) ids while copying."""
   src = Volume(src_layer_path, mip=mip)
   if factor is None:
     factor = DEFAULT_FACTOR
@@ -204,7 +209,13 @@ def create_transfer_tasks(
   dest_info = Volume.create_new_info(
     num_channels=src.num_channels,
     layer_type=src.layer_type,
-    data_type=src.meta.data_type,
+    # agglomerated/L2 downloads return uint64 ids above 2^40 regardless
+    # of the watershed layer's dtype; a narrower dest would silently
+    # wrap every root id on upload
+    data_type=(
+      "uint64" if (agglomerate or stop_layer is not None)
+      else src.meta.data_type
+    ),
     encoding=encoding or src_scale["encoding"],
     resolution=base_scale["resolution"],
     voxel_offset=(
@@ -265,6 +276,9 @@ def create_transfer_tasks(
       downsample_method=downsample_method,
       num_mips=num_mips,
       factor=tuple(factor),
+      agglomerate=agglomerate,
+      timestamp=timestamp,
+      stop_layer=stop_layer,
     )
 
   def finish():

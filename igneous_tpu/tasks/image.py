@@ -100,6 +100,9 @@ class TransferTask(RegisteredTask):
     downsample_method: str = "auto",
     num_mips: Optional[int] = None,
     factor: Optional[Sequence[int]] = None,
+    agglomerate: bool = False,
+    timestamp: Optional[float] = None,
+    stop_layer: Optional[int] = None,
   ):
     self.src_path = src_path
     self.dest_path = dest_path
@@ -117,6 +120,17 @@ class TransferTask(RegisteredTask):
     self.downsample_method = downsample_method
     self.num_mips = num_mips
     self.factor = factor
+    # graphene proofread transfers (reference TransferTask agglomerate/
+    # timestamp/stop_layer, image.py:434-517): materialize root ids (or
+    # L2 ids with stop_layer=2) as of `timestamp` while copying
+    self.agglomerate = bool(agglomerate)
+    self.timestamp = timestamp
+    self.stop_layer = stop_layer
+    if timestamp is not None and not (agglomerate or stop_layer is not None):
+      raise ValueError(
+        "timestamp only applies to agglomerate/stop_layer downloads; "
+        "set agglomerate=True (roots) or stop_layer=2 (L2 ids)"
+      )
 
   def execute(self):
     src = Volume(
@@ -135,7 +149,10 @@ class TransferTask(RegisteredTask):
       return
 
     with telemetry.stage("download"):
-      image = src.download(bounds)
+      image = src.download(
+        bounds, agglomerate=self.agglomerate,
+        timestamp=self.timestamp, stop_layer=self.stop_layer,
+      )
     dest_bounds = bounds.translate(self.translate)
 
     if not self.skip_first:

@@ -171,3 +171,64 @@ def test_graphene_mesh_forge_l2(tmp_path):
   # the object spans two 32-chunks along x -> two L2 meshes
   assert len(labels) == 2
   assert all(l >= int(LocalChunkGraph.L2_BASE) for l in labels)
+
+
+def test_transfer_task_agglomerate(tmp_path):
+  """TransferTask(agglomerate=True) materializes proofread root ids from
+  a graphene volume into a plain Precomputed layer (reference
+  TransferTask agglomerate/timestamp, image.py:434-517)."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+
+  data = np.zeros((64, 32, 32), np.uint64)
+  data[0:32, 10:20, 10:20] = 5
+  data[32:64, 10:20, 10:20] = 6
+  gpath = make_graphene_volume(tmp_path, data, edges=[(5, 6)])
+  dest = f"file://{tmp_path}/roots"
+  tq = LocalTaskQueue(parallel=1, progress=False)
+  tq.insert(tc.create_transfer_tasks(
+    gpath, dest, shape=(64, 32, 32), agglomerate=True,
+  ))
+  out = Volume(dest)
+  img = out.download(out.bounds)[..., 0]
+  labs = set(int(v) for v in np.unique(img))
+  labs.discard(0)
+  # 5 and 6 are merged: exactly one root id, covering both bricks
+  assert len(labs) == 1
+  root = labs.pop()
+  assert root >= int(LocalChunkGraph.ROOT_BASE)
+  assert int((img == root).sum()) == int((data != 0).sum())
+
+
+def test_transfer_agglomerate_forces_uint64_dest(tmp_path):
+  """A uint32 watershed layer must still produce a uint64 destination for
+  agglomerated transfers — root ids live above 2^40 and would otherwise
+  silently wrap on upload."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+
+  data = np.zeros((32, 32, 32), np.uint32)
+  data[4:28, 4:28, 4:28] = 5
+  inner = f"file://{tmp_path}/ws32"
+  Volume.from_numpy(data, inner, resolution=(16, 16, 16),
+                    layer_type="segmentation", chunk_size=(32, 32, 32))
+  gpath = f"graphene://{inner}"
+  use_local_chunkgraph(gpath, LocalChunkGraph(
+    initial_edges=[], chunk_size=(32, 32, 32)))
+  dest = f"file://{tmp_path}/roots32"
+  LocalTaskQueue(parallel=1, progress=False).insert(
+    tc.create_transfer_tasks(gpath, dest, shape=(32, 32, 32),
+                             agglomerate=True))
+  out = Volume(dest)
+  assert out.meta.data_type == "uint64"
+  img = out.download(out.bounds)[..., 0]
+  labs = set(int(v) for v in np.unique(img)) - {0}
+  assert all(l >= int(LocalChunkGraph.ROOT_BASE) for l in labs)
+
+
+def test_transfer_timestamp_requires_agglomerate():
+  from igneous_tpu.tasks.image import TransferTask
+
+  with pytest.raises(ValueError, match="timestamp"):
+    TransferTask("file:///a", "file:///b", mip=0, shape=(8, 8, 8),
+                 offset=(0, 0, 0), timestamp=123.0)
