@@ -29,8 +29,14 @@ from scipy import ndimage
 from ..skeleton_io import Skeleton
 
 
-def vertex_tangents(skel: Skeleton) -> np.ndarray:
-  """Unit tangent per vertex: mean direction of incident edges."""
+def vertex_tangents(skel: Skeleton, smoothing_window: int = 1) -> np.ndarray:
+  """Unit tangent per vertex: mean direction of incident edges.
+
+  ``smoothing_window`` > 1 averages each vertex's tangent with the
+  sign-aligned tangents of vertices within ceil((w-1)/2) graph hops —
+  the reference's kimimaro ``cross_sectional_area(smoothing_window=...)``
+  knob, which steadies slice normals on jagged centerlines
+  (reference tasks/skeleton.py:449-457)."""
   n = len(skel.vertices)
   tangents = np.zeros((n, 3), np.float32)
   edges = skel.edges.astype(np.int64)
@@ -49,7 +55,36 @@ def vertex_tangents(skel: Skeleton) -> np.ndarray:
         tangents[idx] += d
   norms = np.linalg.norm(tangents, axis=1, keepdims=True)
   norms[norms == 0] = 1.0
-  return tangents / norms
+  tangents = tangents / norms
+
+  w = int(smoothing_window)
+  if w > 1 and len(edges):
+    hops = (w - 1 + 1) // 2  # ceil((w-1)/2)
+    adj = [[] for _ in range(n)]
+    for a, b in edges:
+      adj[a].append(int(b))
+      adj[b].append(int(a))
+    smoothed = np.empty_like(tangents)
+    for i in range(n):
+      seen = {i}
+      frontier = [i]
+      for _ in range(hops):
+        nxt = []
+        for u in frontier:
+          for v in adj[u]:
+            if v not in seen:
+              seen.add(v)
+              nxt.append(v)
+        frontier = nxt
+      acc = np.zeros(3, np.float32)
+      ref = tangents[i]
+      for u in seen:
+        t = tangents[u]
+        acc += -t if np.dot(ref, t) < 0 else t
+      norm = np.linalg.norm(acc)
+      smoothed[i] = acc / norm if norm > 0 else ref
+    tangents = smoothed
+  return tangents
 
 
 def _plane_basis(t: np.ndarray):
@@ -112,6 +147,7 @@ def cross_sectional_area(
   offset: Sequence[float] = (0.0, 0.0, 0.0),
   window: int = 48,
   vertex_mask: Optional[np.ndarray] = None,
+  smoothing_window: int = 1,
 ) -> np.ndarray:
   """Per-vertex slice areas (physical units²) of one label's mask.
 
@@ -129,7 +165,7 @@ def cross_sectional_area(
     -1         vertex outside the mask.
   """
   anis = np.asarray(anisotropy, np.float32)
-  tangents = vertex_tangents(skel)
+  tangents = vertex_tangents(skel, smoothing_window=smoothing_window)
   out = np.full(len(skel.vertices), -1.0, np.float32)
   shape = np.asarray(mask.shape, dtype=np.int64)
   w = int(window)

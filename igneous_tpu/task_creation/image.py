@@ -196,6 +196,20 @@ def create_transfer_tasks(
   if factor is None:
     factor = DEFAULT_FACTOR
 
+  # validate the graphene options BEFORE any destination state is written
+  # (a half-created layer + thousands of doomed queued tasks otherwise)
+  materialize_ids = agglomerate or stop_layer is not None
+  if materialize_ids and src.graphene is None:
+    raise ValueError(
+      "agglomerate/stop_layer transfers require a graphene:// source"
+    )
+  if stop_layer not in (None, 1, 2):
+    raise ValueError(f"stop_layer must be 1 or 2: {stop_layer!r}")
+  if timestamp is not None and not materialize_ids:
+    raise ValueError(
+      "timestamp only applies with agglomerate=True or stop_layer"
+    )
+
   # destination metadata mirrors the source scale structure through `mip`
   # (so dest mip indices line up with the task's mip), fresh chunking
   src_scale = src.meta.scale(mip)
@@ -212,10 +226,7 @@ def create_transfer_tasks(
     # agglomerated/L2 downloads return uint64 ids above 2^40 regardless
     # of the watershed layer's dtype; a narrower dest would silently
     # wrap every root id on upload
-    data_type=(
-      "uint64" if (agglomerate or stop_layer is not None)
-      else src.meta.data_type
-    ),
+    data_type="uint64" if materialize_ids else src.meta.data_type,
     encoding=encoding or src_scale["encoding"],
     resolution=base_scale["resolution"],
     voxel_offset=(
@@ -229,6 +240,12 @@ def create_transfer_tasks(
   )
   try:
     dest = Volume(dest_layer_path)  # existing destination info wins
+    if materialize_ids and dest.meta.data_type != "uint64":
+      raise ValueError(
+        f"agglomerate/stop_layer transfers write uint64 root ids, but the "
+        f"existing destination is {dest.meta.data_type}; they would "
+        f"silently wrap on upload — delete or widen the destination first"
+      )
   except FileNotFoundError:
     dest = Volume.create(dest_layer_path, dest_info)
     for m in range(1, mip + 1):
@@ -240,7 +257,7 @@ def create_transfer_tasks(
 
   if shape is None:
     shape = downsample_shape_from_memory_target(
-      src.dtype.itemsize,
+      8 if materialize_ids else src.dtype.itemsize,
       dest_chunk[0], dest_chunk[1], dest_chunk[2],
       factor, memory_target,
       max_mips=max(num_mips, 1),

@@ -45,6 +45,7 @@ def create_skeletonizing_tasks(
   fix_branching: bool = True,
   fix_avocados: bool = False,
   cross_sectional_area: bool = False,
+  csa_smoothing_window: int = 1,
   low_memory_csa: bool = False,
   synapses: Optional[dict] = None,
   parallel: int = 1,
@@ -159,6 +160,7 @@ def create_skeletonizing_tasks(
       fix_branching=fix_branching,
       fix_avocados=fix_avocados,
       cross_sectional_area=cross_sectional_area,
+      csa_smoothing_window=csa_smoothing_window,
       low_memory_csa=low_memory_csa,
       extra_targets=task_targets(offset, shape_),
       parallel=parallel,

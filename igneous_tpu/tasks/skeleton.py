@@ -102,6 +102,7 @@ class SkeletonTask(RegisteredTask):
     fix_branching: bool = True,
     fix_avocados: bool = False,
     cross_sectional_area: bool = False,
+    csa_smoothing_window: int = 1,
     low_memory_csa: bool = False,
     extra_targets: Optional[Dict] = None,
     parallel: int = 1,
@@ -125,6 +126,9 @@ class SkeletonTask(RegisteredTask):
     self.fix_branching = bool(fix_branching)
     self.fix_avocados = bool(fix_avocados)
     self.cross_sectional_area = bool(cross_sectional_area)
+    # moving-average window over slice normals (reference kimimaro
+    # cross_sectional_area smoothing_window, tasks/skeleton.py:449-457)
+    self.csa_smoothing_window = int(csa_smoothing_window)
     self.low_memory_csa = bool(low_memory_csa)
     # {label: [[x,y,z(,swc_label)] global voxel coords]} — synapse/marker
     # points that must become skeleton vertices, optionally typed for SWC
@@ -218,6 +222,7 @@ class SkeletonTask(RegisteredTask):
           mask, skel, anisotropy=tuple(float(v) for v in anis),
           offset=tuple(float(v) for v in region.minpt),
           window=ctx, vertex_mask=vmask,
+          smoothing_window=self.csa_smoothing_window,
         )
         # a clean (positive) recompute wins; a still-negative one means
         # the section genuinely reaches the dataset boundary — keep the
@@ -383,6 +388,7 @@ class SkeletonTask(RegisteredTask):
               np.asarray(cutout.minpt, np.float32)
               + np.asarray(lo, np.float32)
             ),
+            smoothing_window=self.csa_smoothing_window,
           )
           skel.extra_attributes["cross_sectional_area"] = areas
         del comp  # repair re-downloads its own context regions
@@ -403,6 +409,7 @@ class SkeletonTask(RegisteredTask):
           areas = _csa(
             labels[grow] == label, skel, anisotropy=anis,
             offset=tuple(np.asarray(cutout.minpt, np.float32) + crop_off),
+            smoothing_window=self.csa_smoothing_window,
           )
           skel.extra_attributes["cross_sectional_area"] = areas
       self._repair_csa_contacts(vol, skels, bounds)

@@ -232,3 +232,37 @@ def test_transfer_timestamp_requires_agglomerate():
   with pytest.raises(ValueError, match="timestamp"):
     TransferTask("file:///a", "file:///b", mip=0, shape=(8, 8, 8),
                  offset=(0, 0, 0), timestamp=123.0)
+
+
+def test_transfer_agglomerate_validation(tmp_path):
+  """Invalid graphene-transfer combos fail BEFORE any destination state
+  is written: non-graphene source, bad stop_layer, stray timestamp,
+  and a pre-existing too-narrow destination."""
+  import os
+
+  from igneous_tpu import task_creation as tc
+
+  data = np.zeros((16, 16, 16), np.uint32)
+  data[2:14, 2:14, 2:14] = 5
+  plain = f"file://{tmp_path}/plain"
+  Volume.from_numpy(data, plain, layer_type="segmentation")
+
+  dest = f"file://{tmp_path}/dst"
+  with pytest.raises(ValueError, match="graphene"):
+    tc.create_transfer_tasks(plain, dest, shape=(16, 16, 16),
+                             agglomerate=True)
+  with pytest.raises(ValueError, match="timestamp"):
+    tc.create_transfer_tasks(plain, dest, shape=(16, 16, 16),
+                             timestamp=1.0)
+  assert not os.path.exists(f"{tmp_path}/dst")  # nothing half-created
+
+  gpath = make_graphene_volume(tmp_path, data.astype(np.uint64), edges=[],
+                               chunk_size=(16, 16, 16))
+  with pytest.raises(ValueError, match="stop_layer"):
+    tc.create_transfer_tasks(gpath, dest, shape=(16, 16, 16), stop_layer=3)
+
+  # existing uint32 destination must be rejected, not silently wrapped
+  Volume.from_numpy(data, dest, layer_type="segmentation")
+  with pytest.raises(ValueError, match="uint64"):
+    tc.create_transfer_tasks(gpath, dest, shape=(16, 16, 16),
+                             agglomerate=True)

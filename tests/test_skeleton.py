@@ -639,3 +639,34 @@ def test_fix_avocados_keeps_independent_labels():
   assert 3 in out       # untouched
   assert 2 not in out   # absorbed
   assert 1 in out
+
+
+def test_csa_smoothing_window_steadies_normals():
+  """On a jagged (staircase) centerline through a straight square tube,
+  smoothed tangents align with the tube axis, so slice areas approach the
+  true cross-section instead of the oblique-cut overestimate (reference
+  kimimaro smoothing_window)."""
+  from igneous_tpu.ops.cross_section import cross_sectional_area
+
+  mask = np.zeros((40, 12, 12), bool)
+  mask[:, 2:8, 2:8] = True  # 6x6 tube along x
+  # period-4 wave (two up-steps, two down-steps): unlike a 1-step zigzag,
+  # consecutive same-direction edges leave half the interior vertices
+  # with genuinely oblique (45deg) tangents
+  wave = [0.0, 1.0, 2.0, 1.0]
+  verts = np.asarray(
+    [[i, 3.0 + wave[i % 4], 4.0] for i in range(4, 36)], np.float32
+  )
+  edges = np.stack([np.arange(len(verts) - 1),
+                    np.arange(1, len(verts))], axis=1).astype(np.uint32)
+  skel = Skeleton(verts, edges)
+
+  raw = cross_sectional_area(mask, skel, smoothing_window=1)
+  smooth = cross_sectional_area(mask, skel, smoothing_window=7)
+  mid = slice(8, 24)
+  true_area = 36.0
+  # oblique 45deg cuts overestimate by ~sqrt(2) on half the vertices;
+  # smoothing recovers the axis-aligned area throughout
+  assert np.mean(raw[mid]) > 1.12 * true_area
+  assert abs(np.mean(smooth[mid]) - true_area) / true_area < 0.08
+  assert np.max(smooth[mid]) < 1.15 * true_area
