@@ -652,15 +652,19 @@ def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
 @click.option("--dust-threshold", default=4000.0, show_default=True)
 @click.option("--tick-threshold", default=6000.0, show_default=True)
 @click.option("--delete-fragments", is_flag=True)
+@click.option("--max-cable-length", type=float, default=None,
+              help="skip postprocessing (not upload) for merged skeletons "
+                   "longer than this (nm) — bounds the cost of merge-error "
+                   "monsters")
 @click.pass_context
 def skeleton_merge(ctx, path, queue, magnitude, skel_dir, dust_threshold,
-                   tick_threshold, delete_fragments):
+                   tick_threshold, delete_fragments, max_cable_length):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_unsharded_skeleton_merge_tasks(
     path, magnitude=magnitude, skel_dir=skel_dir,
     dust_threshold=dust_threshold, tick_threshold=tick_threshold,
-    delete_fragments=delete_fragments,
+    delete_fragments=delete_fragments, max_cable_length=max_cable_length,
   ), ctx.obj["parallel"])
 
 
@@ -670,14 +674,17 @@ def skeleton_merge(ctx, path, queue, magnitude, skel_dir, dust_threshold,
 @click.option("--skel-dir", default=None)
 @click.option("--dust-threshold", default=4000.0, show_default=True)
 @click.option("--tick-threshold", default=6000.0, show_default=True)
+@click.option("--max-cable-length", type=float, default=None,
+              help="skip postprocessing for merged skeletons longer than "
+                   "this (nm)")
 @click.pass_context
 def skeleton_merge_sharded(ctx, path, queue, skel_dir, dust_threshold,
-                           tick_threshold):
+                           tick_threshold, max_cable_length):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_sharded_skeleton_merge_tasks(
     path, skel_dir=skel_dir, dust_threshold=dust_threshold,
-    tick_threshold=tick_threshold,
+    tick_threshold=tick_threshold, max_cable_length=max_cable_length,
   ), ctx.obj["parallel"])
 
 

@@ -189,6 +189,7 @@ def create_unsharded_skeleton_merge_tasks(
   dust_threshold: float = 4000.0,
   tick_threshold: float = 6000.0,
   delete_fragments: bool = False,
+  max_cable_length: Optional[float] = None,
 ) -> Iterator:
   """Stage-2 merge split by decimal label prefix (reference :535-591;
   common.label_prefixes gives exactly-once coverage)."""
@@ -202,6 +203,7 @@ def create_unsharded_skeleton_merge_tasks(
       dust_threshold=dust_threshold,
       tick_threshold=tick_threshold,
       delete_fragments=delete_fragments,
+      max_cable_length=max_cable_length,
     )
 
 
@@ -210,6 +212,7 @@ def create_sharded_skeleton_merge_tasks(
   skel_dir: Optional[str] = None,
   dust_threshold: float = 4000.0,
   tick_threshold: float = 6000.0,
+  max_cable_length: Optional[float] = None,
   shard_index_bytes: int = 8192,
   minishard_index_bytes: int = 40000,
   min_shards: int = 1,
@@ -247,6 +250,7 @@ def create_sharded_skeleton_merge_tasks(
       skel_dir=sdir,
       dust_threshold=dust_threshold,
       tick_threshold=tick_threshold,
+      max_cable_length=max_cable_length,
     )
 
 

@@ -446,8 +446,17 @@ def _merge_label(
   fragments: List[Skeleton],
   dust_threshold: float,
   tick_threshold: float,
+  max_cable_length: "float | None" = None,
 ) -> Skeleton:
   merged = Skeleton.simple_merge(fragments)
+  if (
+    max_cable_length is not None
+    and merged.cable_length() > max_cable_length
+  ):
+    # reference :843,:999-1006: over-limit skeletons (merge-error monsters
+    # fusing many cells) SKIP the expensive postprocess but are still
+    # uploaded — the limit bounds compute, it does not filter output
+    return merged.consolidate()
   return postprocess(
     merged, dust_threshold=dust_threshold, tick_threshold=tick_threshold
   )
@@ -465,6 +474,7 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
     dust_threshold: float = 4000.0,
     tick_threshold: float = 6000.0,
     delete_fragments: bool = False,
+    max_cable_length: Optional[float] = None,
   ):
     self.cloudpath = cloudpath
     self.prefix = str(prefix)
@@ -472,6 +482,9 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
     self.dust_threshold = dust_threshold
     self.tick_threshold = tick_threshold
     self.delete_fragments = delete_fragments
+    self.max_cable_length = (
+      float(max_cable_length) if max_cable_length is not None else None
+    )
 
   def execute(self):
     vol = Volume(self.cloudpath)
@@ -494,7 +507,10 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
         Skeleton.from_precomputed(cf.get(k), vertex_attributes=attrs)
         for k in keys
       ]
-      merged = _merge_label(skels, self.dust_threshold, self.tick_threshold)
+      merged = _merge_label(
+        skels, self.dust_threshold, self.tick_threshold,
+        self.max_cable_length,
+      )
       if merged.empty:
         continue
       cf.put(f"{sdir}/{label}", merged.to_precomputed(), compress="gzip")
@@ -513,12 +529,16 @@ class ShardedSkeletonMergeTask(RegisteredTask):
     skel_dir: Optional[str] = None,
     dust_threshold: float = 4000.0,
     tick_threshold: float = 6000.0,
+    max_cable_length: Optional[float] = None,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
     self.skel_dir = skel_dir
     self.dust_threshold = dust_threshold
     self.tick_threshold = tick_threshold
+    self.max_cable_length = (
+      float(max_cable_length) if max_cable_length is not None else None
+    )
 
   def execute(self):
     from ..sharding import ShardingSpecification
@@ -560,7 +580,10 @@ class ShardedSkeletonMergeTask(RegisteredTask):
           pieces.append(Skeleton.from_precomputed(blob, vertex_attributes=attrs))
       if not pieces:
         continue
-      merged = _merge_label(pieces, self.dust_threshold, self.tick_threshold)
+      merged = _merge_label(
+        pieces, self.dust_threshold, self.tick_threshold,
+        self.max_cable_length,
+      )
       if not merged.empty:
         out[int(label)] = merged.to_precomputed()
 

@@ -670,3 +670,40 @@ def test_csa_smoothing_window_steadies_normals():
   assert np.mean(raw[mid]) > 1.12 * true_area
   assert abs(np.mean(smooth[mid]) - true_area) / true_area < 0.08
   assert np.max(smooth[mid]) < 1.15 * true_area
+
+
+def test_merge_max_cable_length_skips_postprocess_only(tmp_path):
+  """max_cable_length bounds the cost of merge-error monsters by skipping
+  postprocess — the skeleton is STILL uploaded (reference :821-843,
+  :999-1006 keeps over-limit skeletons unpostprocessed; it does not
+  filter them)."""
+  path, data = make_tube_seg(tmp_path)
+  run(tc.create_skeletonizing_tasks(
+    path, shape=(64, 32, 32), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+  ))
+  vol = Volume(path)
+  sdir = vol.info["skeletons"]
+
+  # the tube's merged skeleton is ~112 voxels * 16nm = ~1800nm of cable.
+  # A dust_threshold above that would normally remove it in postprocess;
+  # an over-limit skeleton skips that postprocess, so it SURVIVES:
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, magnitude=1, dust_threshold=3000, tick_threshold=100,
+    max_cable_length=500.0))
+  over = vol.cf.get(f"{sdir}/55")
+  assert over is not None
+  s_over = Skeleton.from_precomputed(over)
+
+  # under the limit, postprocess runs and the same dust threshold kills it
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, magnitude=1, dust_threshold=3000, tick_threshold=100,
+    max_cable_length=1e9))
+  # stale over-limit upload is replaced only when a new merge writes; the
+  # dusted result writes nothing, so remove the old object to observe
+  vol.cf.delete([f"{sdir}/55"])
+  run(tc.create_unsharded_skeleton_merge_tasks(
+    path, magnitude=1, dust_threshold=3000, tick_threshold=100,
+    max_cable_length=1e9))
+  assert vol.cf.get(f"{sdir}/55") is None
+  assert len(s_over) > 0
