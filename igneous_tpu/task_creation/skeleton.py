@@ -212,10 +212,10 @@ def create_sharded_skeleton_merge_tasks(
   skel_dir: Optional[str] = None,
   dust_threshold: float = 4000.0,
   tick_threshold: float = 6000.0,
-  max_cable_length: Optional[float] = None,
   shard_index_bytes: int = 8192,
   minishard_index_bytes: int = 40000,
   min_shards: int = 1,
+  max_cable_length: Optional[float] = None,
 ) -> Iterator:
   """Stage-2 sharded merge: census labels via the spatial index, solve
   shard parameters, attach the sharding spec to the skeleton info, and

@@ -343,6 +343,25 @@ def test_ccl_backends_identical_numbering(rng, monkeypatch):
   assert np.array_equal(outs["device"], outs["native"])
 
 
+def test_ccl_backends_identical_on_degenerate_shapes(rng, monkeypatch):
+  """Backend equivalence at flat/thin/odd extents — single-voxel axes
+  remove whole neighbor directions and are easy to get wrong in exactly
+  one backend."""
+  from igneous_tpu.native import ccl_lib
+
+  if ccl_lib() is None:
+    pytest.fail("native CCL lib failed to build")
+  for shape in [(1, 7, 3), (17, 3, 9), (8, 8, 1), (1, 1, 5), (2, 1, 1)]:
+    for conn in (6, 18, 26):
+      lab = ((rng.random(shape) < 0.6)
+             * rng.integers(1, 4, shape)).astype(np.uint32)
+      outs = {}
+      for be in ("device", "native"):
+        monkeypatch.setenv("IGNEOUS_CCL_BACKEND", be)
+        outs[be] = connected_components(lab, connectivity=conn)
+      assert np.array_equal(outs["device"], outs["native"]), (shape, conn)
+
+
 def test_ccl_batch_matches_solo_with_negatives(rng, monkeypatch):
   """connected_components_batch must number each cutout exactly as
   connected_components would alone — including for signed inputs with

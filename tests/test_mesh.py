@@ -469,6 +469,28 @@ def test_mc_adversarial_blobs_closed():
     assert len(np.unique(f.reshape(-1))) == len(v)
 
 
+def test_mc_all_256_neighborhoods_closed_and_oriented():
+  """Exhaustive: every 2x2x2 corner configuration, meshed inside a zero
+  shell, yields a closed, consistently-oriented surface — every directed
+  edge is matched by its reverse (stronger than even undirected counts:
+  it also catches winding flips)."""
+  from igneous_tpu.ops.mesh import marching_cubes
+
+  for case in range(256):
+    m = np.zeros((4, 4, 4), np.uint8)
+    for i in range(8):
+      if (case >> i) & 1:
+        m[1 + (i & 1), 1 + ((i >> 1) & 1), 1 + ((i >> 2) & 1)] = 1
+    v, f = marching_cubes(m)
+    if len(f) == 0:
+      assert case == 0
+      continue
+    directed = f[:, [0, 1, 1, 2, 2, 0]].reshape(-1, 2).astype(np.int64)
+    fwd, fc = np.unique(directed, axis=0, return_counts=True)
+    rev, rc = np.unique(directed[:, ::-1], axis=0, return_counts=True)
+    assert np.array_equal(fwd, rev) and np.array_equal(fc, rc), case
+
+
 def test_mc_checkerboard_every_cell_ambiguous():
   from igneous_tpu.ops.mesh import marching_cubes
 

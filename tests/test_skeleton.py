@@ -695,12 +695,9 @@ def test_merge_max_cable_length_skips_postprocess_only(tmp_path):
   assert over is not None
   s_over = Skeleton.from_precomputed(over)
 
-  # under the limit, postprocess runs and the same dust threshold kills it
-  run(tc.create_unsharded_skeleton_merge_tasks(
-    path, magnitude=1, dust_threshold=3000, tick_threshold=100,
-    max_cable_length=1e9))
-  # stale over-limit upload is replaced only when a new merge writes; the
-  # dusted result writes nothing, so remove the old object to observe
+  # under the limit, postprocess runs and the same dust threshold kills
+  # it. The dusted result writes nothing, so remove the stale over-limit
+  # object first to observe the absence.
   vol.cf.delete([f"{sdir}/55"])
   run(tc.create_unsharded_skeleton_merge_tasks(
     path, magnitude=1, dust_threshold=3000, tick_threshold=100,
