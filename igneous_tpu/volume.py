@@ -301,10 +301,15 @@ class Volume:
         (c, self._decode_chunk(data, c, mip)) for c, data in zip(chunks, datas)
       ]
 
+    # Fortran order end to end: decoded chunks are F-order views, the
+    # device layout (c,z,y,x) is a zero-copy transpose of an F-order
+    # cutout, and raw encode is tobytes("F") — C-order assembly here would
+    # force a full-volume transpose copy on BOTH sides of the compute.
     out = np.full(
       tuple(int(v) for v in bbox.size3()) + (self.num_channels,),
       self.background_color,
       dtype=self.dtype,
+      order="F",
     )
     for chunk_bbx, chunk_img in renders:
       isect = Bbox.intersection(chunk_bbx, bbox)
