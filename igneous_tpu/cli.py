@@ -452,10 +452,12 @@ def mesh():
               help="comma-separated: never mesh these labels")
 @click.option("--mesher", default="cubes", show_default=True,
               type=click.Choice(["cubes", "tetrahedra"]))
+@click.option("--simplify-parallel", default=1, show_default=True,
+              help="threads for per-label simplification inside each task")
 @click.pass_context
 def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
                mesh_dir, dust_threshold, fill_missing, sharded, spatial_index,
-               obj_ids, exclude_obj_ids, mesher):
+               obj_ids, exclude_obj_ids, mesher, simplify_parallel):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_meshing_tasks(
@@ -467,7 +469,7 @@ def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
     spatial_index=spatial_index,
     object_ids=parse_id_list(obj_ids),
     exclude_object_ids=parse_id_list(exclude_obj_ids),
-    mesher=mesher,
+    mesher=mesher, parallel=simplify_parallel,
   ), ctx.obj["parallel"])
 
 

@@ -153,21 +153,23 @@ def _emit_host(cases_np, per_np, shape, real_cells=None) -> np.ndarray:
   sz, sy, sx = shape
   cz, cy, cx = sz - 1, sy - 1, sx - 1
   per = np.stack([p.reshape(-1) for p in per_np], axis=-1)  # (ncells, 6)
-  ncells = per.shape[0]
 
-  sel1 = per >= 1
-  sel2 = per >= 2
-  if real_cells is not None:
-    rx, ry, rz = real_cells
-    flat = np.arange(ncells, dtype=np.int64)
-    in_real = (
-      (flat % cx < rx) & ((flat // cx) % cy < ry) & (flat // (cy * cx) < rz)
-    )
-    sel1 &= in_real[:, None]
-    sel2 &= in_real[:, None]
   # nonzero keeps allocation proportional to the surface, not the volume
-  cell1, tet1 = np.nonzero(sel1)
-  cell2, tet2 = np.nonzero(sel2)
+  cell1, tet1 = np.nonzero(per >= 1)
+  cell2, tet2 = np.nonzero(per >= 2)
+  if real_cells is not None:
+    # pad-ring filter on the O(surface) nonzero set only
+    rx, ry, rz = real_cells
+
+    def in_real(cell):
+      return (
+        (cell % cx < rx) & ((cell // cx) % cy < ry)
+        & (cell // (cy * cx) < rz)
+      )
+
+    k1, k2 = in_real(cell1), in_real(cell2)
+    cell1, tet1 = cell1[k1], tet1[k1]
+    cell2, tet2 = cell2[k2], tet2[k2]
   cell = np.concatenate([cell1, cell2])
   tet = np.concatenate([tet1, tet2])
   tri = np.concatenate([
@@ -207,7 +209,17 @@ def _weld(tris, anisotropy, offset):
   from ..mesh_io import drop_degenerate_faces
 
   lattice = np.round(tris.reshape(-1, 3) * 2.0).astype(np.int64)
-  uniq, inverse = np.unique(lattice, axis=0, return_inverse=True)
+  # scalar-key unique: ~5x faster than unique(axis=0)'s void-view row
+  # sort. x occupies the top bits so the sort order (and therefore the
+  # vertex numbering) is identical to lexicographic row order. 21 bits
+  # per axis covers half-lattice coords to 2^21 (volumes to ~1M voxels
+  # per side — far beyond any task cutout).
+  key = (lattice[:, 0] << 42) | (lattice[:, 1] << 21) | lattice[:, 2]
+  ukey, inverse = np.unique(key, return_inverse=True)
+  uniq = np.empty((len(ukey), 3), dtype=np.int64)
+  uniq[:, 0] = ukey >> 42
+  uniq[:, 1] = (ukey >> 21) & 0x1FFFFF
+  uniq[:, 2] = ukey & 0x1FFFFF
   vertices = uniq.astype(np.float32) / 2.0
   faces = inverse.reshape(-1, 3).astype(np.uint32)
   faces = drop_degenerate_faces(faces)
@@ -240,9 +252,16 @@ def _cancel_coincident_pairs(faces: np.ndarray) -> np.ndarray:
   """
   if len(faces) == 0:
     return faces
-  key = np.sort(faces, axis=1)
-  _, inv, cnt = np.unique(key, axis=0, return_inverse=True,
-                          return_counts=True)
+  tri = np.sort(faces, axis=1).astype(np.int64)
+  if int(tri[:, 2].max()) < (1 << 21):
+    # scalar-key grouping (fast path): collision-free while every vertex
+    # index fits 21 bits...
+    key = (tri[:, 0] << 42) | (tri[:, 1] << 21) | tri[:, 2]
+    _, inv, cnt = np.unique(key, return_inverse=True, return_counts=True)
+  else:
+    # ...multi-million-vertex meshes fall back to exact row grouping
+    _, inv, cnt = np.unique(tri, axis=0, return_inverse=True,
+                            return_counts=True)
   if (cnt <= 1).all():
     return faces
   keep = cnt[inv] == 1
@@ -544,14 +563,16 @@ def _mc_emit_host(case_np, ntri_np, shape, real_cells=None) -> np.ndarray:
   cz, cy, cx = sz - 1, sy - 1, sx - 1
   ntri = np.asarray(ntri_np).reshape(-1)
   case = np.asarray(case_np).reshape(-1)
-  if real_cells is not None:
-    rx, ry, rz = real_cells
-    flat = np.arange(ntri.shape[0], dtype=np.int64)
-    in_real = (
-      (flat % cx < rx) & ((flat // cx) % cy < ry) & (flat // (cy * cx) < rz)
-    )
-    ntri = np.where(in_real, ntri, 0)
   cells = np.flatnonzero(ntri)
+  if real_cells is not None and len(cells):
+    # pad-ring filter on the O(surface) nonzero set only — full-grid
+    # coordinate arithmetic per label costs more than the device pass
+    rx, ry, rz = real_cells
+    in_real = (
+      (cells % cx < rx) & ((cells // cx) % cy < ry)
+      & (cells // (cy * cx) < rz)
+    )
+    cells = cells[in_real]
   if len(cells) == 0:
     return np.zeros((0, 3, 3), dtype=np.float32)
   reps = ntri[cells]

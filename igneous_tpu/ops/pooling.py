@@ -261,6 +261,13 @@ def _pack_u64_planes(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
   import sys
 
   if sys.byteorder == "little":
+    if lo.flags["C_CONTIGUOUS"] and hi.flags["C_CONTIGUOUS"]:
+      # C-contiguous planes (e.g. batched device outputs): sequential
+      # reads, stride-2 writes, C-order result
+      out = np.empty(lo.shape + (2,), dtype=np.uint32)
+      out[..., 0] = lo
+      out[..., 1] = hi
+      return out.view(np.uint64)[..., 0]
     out = np.empty((2,) + lo.shape, dtype=np.uint32, order="F")
     out[0] = lo
     out[1] = hi

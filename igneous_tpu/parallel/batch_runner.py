@@ -22,7 +22,12 @@ from ..volume import Volume
 from ..downsample_scales import compute_factors, DEFAULT_FACTOR
 from ..task_creation.common import get_bounds
 from ..tasks.image import DownsampleTask
-from ..ops.pooling import _from_device_layout, _to_device_layout
+from ..ops.pooling import (
+  _from_device_layout,
+  _pack_u64_planes,
+  _split_u64_planes,
+  _to_device_layout,
+)
 from .executor import ChunkExecutor, make_mesh
 
 # single source of truth for the (x,y,z,c) <-> (c,z,y,x) convention
@@ -107,17 +112,15 @@ def batched_downsample(
 
   def run_batch(io_pool, boxes, imgs):
     if is_u64_mode:
-      lo = np.stack([
-        _to_batch_layout((i & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-        for i in imgs
-      ])
-      hi = np.stack([
-        _to_batch_layout((i >> np.uint64(32)).astype(np.uint32)) for i in imgs
-      ])
+      # zero-copy strided views; the one copy per plane happens in
+      # _to_batch_layout's contiguity fixup (shared helpers with
+      # ops.pooling.downsample — keep the two paths in sync)
+      planes = [_split_u64_planes(i) for i in imgs]
+      lo = np.stack([_to_batch_layout(l) for l, _ in planes])
+      hi = np.stack([_to_batch_layout(h) for _, h in planes])
       outs, _ = executor((lo, hi))
       mips_out = [
-        (ol.astype(np.uint64) | (oh.astype(np.uint64) << np.uint64(32)))
-        for ol, oh in outs
+        _pack_u64_planes(np.asarray(ol), np.asarray(oh)) for ol, oh in outs
       ]
     else:
       batch = np.stack([_to_batch_layout(i) for i in imgs])

@@ -45,6 +45,7 @@ def create_meshing_tasks(
   closed_dataset_edges: bool = True,
   fill_holes: int = 0,
   mesher: str = "cubes",
+  parallel: int = 1,
 ):
   """Stage-1 mesh forge grid; creates the mesh info
   (reference task_creation/mesh.py:158-267)."""
@@ -95,6 +96,7 @@ def create_meshing_tasks(
       closed_dataset_edges=closed_dataset_edges,
       fill_holes=fill_holes,
       mesher=mesher,
+      parallel=parallel,
     )
 
   def finish():

@@ -64,6 +64,7 @@ class MeshTask(RegisteredTask):
     fill_holes: int = 0,
     timestamp: Optional[float] = None,
     mesher: str = "cubes",
+    parallel: int = 1,
   ):
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
@@ -94,6 +95,11 @@ class MeshTask(RegisteredTask):
     if mesher not in ("cubes", "tetrahedra"):
       raise ValueError(f"mesher must be 'cubes' or 'tetrahedra': {mesher!r}")
     self.mesher = mesher
+    # label-level threading for the simplification stage, mirroring
+    # SkeletonTask's parallel= (the native QEM collapse is a ctypes call
+    # that releases the GIL; results are per-label independent and
+    # deterministic regardless of completion order)
+    self.parallel = int(parallel)
 
   def execute(self):
     vol = Volume(
@@ -201,16 +207,28 @@ class MeshTask(RegisteredTask):
           for _, grow, _ in group
         ],
       )
-      for (orig, grow, _), (verts, faces) in zip(group, results):
+      def _finish(args):
+        (orig, grow, _), (verts, faces) = args
         mesh = Mesh(verts, faces)
         if self.simplification_factor > 1:
           mesh = simplify(
             mesh, self.simplification_factor, self.max_simplification_error
           )
-        meshes[orig] = mesh
         mn = (np.asarray([g.start for g in grow]) + np.asarray(origin)) * res_int
         mx = (np.asarray([g.stop for g in grow]) + np.asarray(origin)) * res_int
-        label_bounds[orig] = Bbox(mn, mx)
+        return orig, mesh, Bbox(mn, mx)
+
+      pairs = list(zip(group, results))
+      if self.parallel > 1 and len(pairs) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.parallel) as ex:
+          finished = list(ex.map(_finish, pairs))
+      else:
+        finished = [_finish(p) for p in pairs]
+      for orig, mesh, bbx in finished:
+        meshes[orig] = mesh
+        label_bounds[orig] = bbx
 
     self._upload(meshes, core, cutout, vol, label_bounds)
 

@@ -180,6 +180,22 @@ def test_mesh_forge_sharded_frags(tmp_path):
   assert found == {77, 123}
 
 
+def test_mesh_forge_parallel_identical(tmp_path):
+  """parallel=N threads the per-label simplification; outputs must be
+  byte-identical to the serial path (deterministic native collapse,
+  results keyed by label)."""
+  path, data = make_seg(tmp_path)
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="m1", sharded=True))
+  run(tc.create_meshing_tasks(
+    path, shape=(64, 64, 64), mesh_dir="m4", sharded=True, parallel=4))
+  vol = Volume(path)
+  k1 = sorted(k for k in vol.cf.list("m1/") if k.endswith(".frags"))
+  assert k1
+  for key in k1:
+    assert vol.cf.get(key) == vol.cf.get("m4/" + key.split("/", 1)[1])
+
+
 def test_mesh_spatial_index(tmp_path):
   path, data = make_seg(tmp_path)
   run(tc.create_meshing_tasks(path, shape=(64, 64, 64), mesh_dir="mesh"))
