@@ -318,12 +318,28 @@ def _skeletonize_component(
       rem = np.flatnonzero(~captured)
       for start in range(0, len(path), 512):
         seg = path[start : start + 512]
+        rchunk = ball[start : start + 512]
+        # exact bbox prefilter: no voxel outside the chunk's bounding box
+        # padded by its largest ball radius can be captured — for tube-like
+        # objects this shrinks the pairwise set by orders of magnitude
+        rmax = float(rchunk.max())
+        lo = phys[seg].min(axis=0) - rmax
+        hi = phys[seg].max(axis=0) + rmax
+        rp = phys[rem]
+        near = np.flatnonzero(
+          ((rp >= lo) & (rp <= hi)).all(axis=1)
+        )
+        if len(near) == 0:
+          continue
+        cand = rem[near]
         d2 = (
-          (phys[rem, None, :] - phys[None, seg, :]) ** 2
-        ).sum(-1)  # (r, p)
-        hit = (d2 <= (ball[None, start : start + 512] ** 2)).any(axis=1)
-        captured[rem[hit]] = True
-        rem = rem[~hit]
+          (phys[cand, None, :] - phys[None, seg, :]) ** 2
+        ).sum(-1)  # (c, p)
+        hit = (d2 <= (rchunk[None, :] ** 2)).any(axis=1)
+        captured[cand[hit]] = True
+        keep = np.ones(len(rem), dtype=bool)
+        keep[near[hit]] = False
+        rem = rem[keep]
         if len(rem) == 0:
           break
       captured[path] = True
