@@ -281,20 +281,26 @@ def bench_mesh_kernel():
   return batch.size / dt
 
 
-def bench_ccl_kernel():
+def bench_ccl_kernel(algo: str = "scan"):
   """BASELINE config 4: block CCL, BATCHED — K cutouts per shard_map
-  dispatch (+ host renumber per chunk)."""
+  dispatch (+ host renumber per chunk). ``algo`` selects the device
+  kernel variant (scan = pointer jumps, relax = gather-free) so TPU runs
+  record the ROADMAP hardware A/B."""
   from igneous_tpu.ops.ccl import connected_components_batch
 
-  n = 64 if QUICK else 128
-  K = 4 if QUICK else 8
-  rng = np.random.default_rng(0)
-  lab = (rng.integers(0, 3, (K, n, n, n)) * 7).astype(np.uint32)
-  connected_components_batch(lab)  # compile
-  t0 = time.perf_counter()
-  connected_components_batch(lab)
-  dt = time.perf_counter() - t0
-  return lab.size / dt
+  os.environ["IGNEOUS_CCL_DEVICE_ALGO"] = algo
+  try:
+    n = 64 if QUICK else 128
+    K = 4 if QUICK else 8
+    rng = np.random.default_rng(0)
+    lab = (rng.integers(0, 3, (K, n, n, n)) * 7).astype(np.uint32)
+    connected_components_batch(lab)  # compile
+    t0 = time.perf_counter()
+    connected_components_batch(lab)
+    dt = time.perf_counter() - t0
+    return lab.size / dt
+  finally:
+    os.environ.pop("IGNEOUS_CCL_DEVICE_ALGO", None)
 
 
 def bench_edt_kernel():
@@ -328,7 +334,11 @@ def run_bench(platform: str):
   e2e = bench_e2e(img, seg)
   up, down = measure_transfer_MBps()
   mesh_rate = bench_mesh_kernel()
-  ccl_rate = bench_ccl_kernel()
+  ccl_rate = bench_ccl_kernel("scan")
+  # the gather-free variant is only worth timing where gathers are the
+  # question (TPU); on the CPU-fallback path it would blow the child
+  # deadline for a number BASELINE doesn't use
+  ccl_relax_rate = bench_ccl_kernel("relax") if platform == "tpu" else None
   edt_rate = bench_edt_kernel()
 
   result = {
@@ -345,6 +355,9 @@ def run_bench(platform: str):
       "transfer_MBps_up_down": [up, down],
       "mesh_count_kernel_voxps": round(mesh_rate, 1),
       "ccl_kernel_voxps": round(ccl_rate, 1),
+      "ccl_relax_kernel_voxps": (
+        round(ccl_relax_rate, 1) if ccl_relax_rate is not None else None
+      ),
       "edt_kernel_voxps": round(edt_rate, 1),
       "baseline": baseline_kind + " (reference stack not installed here)",
       "platform": platform,

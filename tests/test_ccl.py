@@ -68,6 +68,30 @@ def test_ccl_snake(ccl_backend):
   assert N == 1
 
 
+def test_ccl_device_algos_identical(rng, monkeypatch):
+  """The gather-free 'relax' kernel must reach the identical fixpoint
+  (component min flat index) as the pointer-jumping 'scan' kernel —
+  including on the serpentine worst case that maximizes round count."""
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "device")
+  snake = np.zeros((32, 32, 1), np.uint8)
+  for i in range(0, 32, 2):
+    snake[:, i, 0] = 1
+    if i + 1 < 32:
+      snake[-1 if (i // 2) % 2 == 0 else 0, i + 1, 0] = 1
+  vols = [
+    snake,
+    ((rng.random((21, 17, 9)) < 0.55)
+     * rng.integers(1, 4, (21, 17, 9))).astype(np.uint32),
+  ]
+  for lab in vols:
+    for conn in (6, 26):
+      outs = {}
+      for algo in ("scan", "relax"):
+        monkeypatch.setenv("IGNEOUS_CCL_DEVICE_ALGO", algo)
+        outs[algo] = connected_components(lab, connectivity=conn)
+      assert np.array_equal(outs["scan"], outs["relax"]), conn
+
+
 def test_threshold_image():
   img = np.arange(27, dtype=np.uint8).reshape(3, 3, 3)
   fg = threshold_image(img, threshold_gte=10, threshold_lte=20)
