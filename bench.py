@@ -303,6 +303,47 @@ def bench_ccl_kernel(algo: str = "scan"):
     os.environ.pop("IGNEOUS_CCL_DEVICE_ALGO", None)
 
 
+def bench_pool_ab():
+  """Device-resident A/B of one 2x2x1 average-pool step: the Pallas
+  hand-tiled kernel vs the XLA-fused formulation (same data, each in its
+  preferred layout). TPU-only; the ROADMAP promotion decision needs this
+  number."""
+  import jax
+  import jax.numpy as jnp
+
+  from igneous_tpu.ops import pallas_pooling
+  from igneous_tpu.ops.pooling import _pyramid_impl
+  from functools import partial
+
+  if not pallas_pooling.available():
+    return None
+  rng = np.random.default_rng(0)
+  yxz = jax.device_put(
+    jnp.asarray(rng.integers(0, 255, (1024, 1024, 128)).astype(np.uint8))
+  )
+  czyx = jax.device_put(jnp.transpose(yxz, (2, 0, 1))[None])
+
+  pallas_fn = jax.jit(
+    lambda x: jnp.sum(
+      pallas_pooling._pool_zlast(x, "average", 8, 8, False).astype(jnp.int32)
+    )
+  )
+  xla_fn = jax.jit(
+    lambda x: jnp.sum(
+      _pyramid_impl(x, ((2, 2, 1),), "average", False)[0].astype(jnp.int32)
+    )
+  )
+  out = {}
+  for name, fn, arg in (("pallas", pallas_fn, yxz), ("xla", xla_fn, czyx)):
+    float(fn(arg))  # compile + settle
+    t0 = time.perf_counter()
+    iters = 2 if QUICK else 5
+    for _ in range(iters):
+      float(fn(arg))
+    out[name + "_voxps"] = round(arg.size / ((time.perf_counter() - t0) / iters), 1)
+  return out
+
+
 def bench_edt_kernel():
   """BASELINE config 5's device core: multilabel anisotropic EDT,
   BATCHED — K cutouts per shard_map dispatch."""
@@ -339,6 +380,7 @@ def run_bench(platform: str):
   # question (TPU); on the CPU-fallback path it would blow the child
   # deadline for a number BASELINE doesn't use
   ccl_relax_rate = bench_ccl_kernel("relax") if platform == "tpu" else None
+  pool_ab = bench_pool_ab() if platform == "tpu" else None
   edt_rate = bench_edt_kernel()
 
   result = {
@@ -359,6 +401,7 @@ def run_bench(platform: str):
         round(ccl_relax_rate, 1) if ccl_relax_rate is not None else None
       ),
       "edt_kernel_voxps": round(edt_rate, 1),
+      "pool_ab": pool_ab,
       "baseline": baseline_kind + " (reference stack not installed here)",
       "platform": platform,
       "device": _device_name(),

@@ -12,10 +12,11 @@ op (one 2x2x1 average/mode pooling step) for TPU:
   - the mode variant implements the same earliest-position majority vote
     as ops/pooling._pool_mode via 4 static window slices.
 
-Use ``available()`` / ``pool2x2x1`` with ``interpret=True`` for CPU tests;
-the task pipeline keeps the XLA path as default until the Pallas path is
-benchmarked faster on the target chip (enable with
-IGNEOUS_TPU_PALLAS_POOL=1).
+Use ``available()`` / ``pool2x2x1`` with ``interpret=True`` for CPU tests.
+The task pipeline keeps the XLA path; this kernel is the promotion
+CANDIDATE — bench.py records the device-resident Pallas-vs-XLA A/B on
+every TPU run (detail.pool_ab), and the pyramid switches only when that
+evidence says so (ROADMAP item 1).
 """
 
 from __future__ import annotations
