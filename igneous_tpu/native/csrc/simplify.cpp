@@ -91,6 +91,11 @@ struct Simplifier {
   std::vector<std::vector<int>> inc; // vertex -> incident face ids
   std::priority_queue<HeapEntry, std::vector<HeapEntry>, HeapCmp> heap;
   int64_t live_faces;
+  // epoch-marking scratch for O(deg) neighbor dedup / intersection
+  // (replaces per-pop sort+unique+set_intersection, the run()-loop cost
+  // center at ~60k collapses/sec before)
+  std::vector<uint32_t> mark;
+  uint32_t epoch = 0;
 
   void init(const float* v, int64_t nv_, const uint32_t* f, int64_t nf_,
             int preserve_border) {
@@ -241,18 +246,46 @@ struct Simplifier {
     heap.push({cost, u, w, gen[u], gen[w], p[0], p[1], p[2]});
   }
 
-  // vertices adjacent to v over live faces (deduplicated, sorted)
-  void neighbors(int v, std::vector<int>& out) const {
+  // vertices adjacent to v over live faces (deduplicated via epoch
+  // marks, O(deg); order is incidence order — the heap comparator is
+  // total on (cost, v0, v1) so push order never changes pop order)
+  void neighbors(int v, std::vector<int>& out) {
     out.clear();
+    if (mark.size() != (size_t)nv) mark.assign(nv, 0);
+    if (epoch == 0xffffffffu) {  // wrap: clear stale marks
+      mark.assign(nv, 0);
+      epoch = 0;
+    }
+    uint32_t e = ++epoch;
     for (int t : inc[v]) {
       if (!face_alive[t]) continue;
       for (int k = 0; k < 3; k++) {
         int u = faces[3*t+k];
-        if (u != v) out.push_back(u);
+        if (u != v && mark[u] != e) {
+          mark[u] = e;
+          out.push_back(u);
+        }
       }
     }
-    std::sort(out.begin(), out.end());
-    out.erase(std::unique(out.begin(), out.end()), out.end());
+  }
+
+  // |neighbors(v0) ∩ neighbors(v1)| without materializing either set
+  // sorted: mark v0's neighborhood, scan v1's
+  int64_t shared_neighbors(int v0, int v1, std::vector<int>& nb_v) {
+    neighbors(v0, nb_v);
+    uint32_t e = epoch;  // nb_v's marks
+    int64_t shared = 0;
+    for (int t : inc[v1]) {
+      if (!face_alive[t]) continue;
+      for (int k = 0; k < 3; k++) {
+        int u = faces[3*t+k];
+        if (u != v1 && mark[u] == e) {
+          mark[u] = 0;  // count each shared vertex once
+          shared++;
+        }
+      }
+    }
+    return shared;
   }
 
   // would moving vertex v to p flip or squash any of its live faces that
@@ -313,7 +346,7 @@ struct Simplifier {
 
   void run(int64_t target_faces, double max_error) {
     const double max_cost = (max_error > 0) ? max_error * max_error : -1.0;
-    std::vector<int> nb_v, nb_w, shared;
+    std::vector<int> nb_v;
     while (live_faces > target_faces && !heap.empty()) {
       HeapEntry e = heap.top();
       heap.pop();
@@ -326,12 +359,7 @@ struct Simplifier {
       // link condition: the common neighborhood of (v0,v1) must be
       // exactly the apex vertices of the faces the edge bounds; extra
       // shared neighbors mean the collapse would pinch the surface
-      neighbors(e.v0, nb_v);
-      neighbors(e.v1, nb_w);
-      shared.clear();
-      std::set_intersection(nb_v.begin(), nb_v.end(),
-                            nb_w.begin(), nb_w.end(),
-                            std::back_inserter(shared));
+      int64_t shared = shared_neighbors(e.v0, e.v1, nb_v);
       int edge_face_count = 0;
       for (int t : inc[e.v0]) {
         if (!face_alive[t]) continue;
@@ -339,7 +367,7 @@ struct Simplifier {
         bool hasw = (a == e.v1 || b == e.v1 || c == e.v1);
         if (hasw) edge_face_count++;
       }
-      if ((int64_t)shared.size() > edge_face_count) continue;
+      if (shared > edge_face_count) continue;
       double p[3] = {e.px, e.py, e.pz};
       if (flips(e.v0, e.v1, p) || flips(e.v1, e.v0, p)) continue;
       collapse(e.v0, e.v1, p);
