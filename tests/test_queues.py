@@ -89,6 +89,32 @@ def test_local_queue_parallel_spawn(tmp_path):
   assert all(os.path.exists(tmp_path / f"p{i}") for i in range(6))
 
 
+def test_parallel_spawn_outputs_identical_to_serial(tmp_path):
+  """Real compute tasks through spawn workers must write byte-identical
+  chunks to the serial path — catches hidden global state (jit caches,
+  env mutations, RNG) leaking into task results."""
+  import numpy as np
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+
+  rng = np.random.default_rng(3)
+  img = rng.integers(0, 255, (96, 96, 32)).astype(np.uint8)
+  outs = {}
+  for par in (1, 2):
+    path = f"file://{tmp_path}/v{par}"
+    Volume.from_numpy(img, path, chunk_size=(32, 32, 32))
+    LocalTaskQueue(parallel=par, progress=False).insert(
+      tc.create_downsampling_tasks(path, mip=0, num_mips=2)
+    )
+    vol = Volume(path)
+    outs[par] = {
+      k: vol.cf.get(k) for k in sorted(vol.cf.list("")) if "info" not in k
+    }
+  assert outs[1].keys() == outs[2].keys()
+  assert all(outs[1][k] == outs[2][k] for k in outs[1])
+
+
 def test_mock_queue():
   MockTaskQueue().insert(PrintTask("hi"))
 
