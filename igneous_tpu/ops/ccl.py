@@ -339,6 +339,11 @@ def connected_components_batch(
   labels_batch = np.asarray(labels_batch)
   if labels_batch.ndim != 4:
     raise ValueError("labels_batch must be (K, x, y, z)")
+  if executor is None and _ccl_backend() == "native":
+    # CPU-only host: per-cutout native union-find IS the fast path (the
+    # device kernel on XLA CPU is orders of magnitude slower); an
+    # explicit executor means the caller already chose the device route
+    return [connected_components(b, connectivity) for b in labels_batch]
   lab32 = _dense_relabel(labels_batch)
   dev = np.ascontiguousarray(lab32.transpose(0, 3, 2, 1))  # (K, z, y, x)
   if executor is None:

@@ -452,10 +452,9 @@ def edt_batch(
     work = np.pad(
       labels_batch, ((0, 0), (1, 1), (1, 1), (1, 1)), constant_values=0
     )
-  uniq, inv = np.unique(work, return_inverse=True)
-  lab32 = inv.astype(np.int32).reshape(work.shape)
-  if uniq[0] != 0:
-    lab32 += 1
+  from .ccl import _dense_relabel
+
+  lab32 = _dense_relabel(work)  # shared: handles signed/no-zero inputs
   dev = np.ascontiguousarray(lab32.transpose(0, 3, 2, 1))  # (K, z, y, x)
   wx, wy, wz = (float(a) for a in anisotropy)
   if executor is None:
@@ -512,11 +511,11 @@ def edt(
     sq = _edt_sq_numpy(work, (wx, wy, wz))
   elif backend == "device":
     # compress labels to int32 identity space (values only matter by
-    # equality; the device kernel works on 32-bit planes)
-    uniq, inv = np.unique(work, return_inverse=True)
-    lab32 = inv.astype(np.int32).reshape(work.shape)
-    if uniq[0] != 0:
-      lab32 += 1
+    # equality; the device kernel works on 32-bit planes). Shared helper:
+    # keeps zero as background even for signed inputs with negatives.
+    from .ccl import _dense_relabel
+
+    lab32 = _dense_relabel(work)
     dev = jnp.asarray(np.ascontiguousarray(lab32.transpose(2, 1, 0)))
     sq = np.asarray(_edt_sq_kernel(dev, (wx, wy, wz))).transpose(2, 1, 0)
   if black_border:

@@ -13,6 +13,7 @@ batched program keeps a single compiled shape.
 from __future__ import annotations
 
 import concurrent.futures as cf
+from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -202,7 +203,12 @@ def batched_ccl_faces(
   the same dataset faces batch together); a shape with a single member
   falls back to the per-task path.
   """
-  from ..ops.ccl import _ccl_kernel, connected_components_batch
+  from ..ops.ccl import (
+    _ccl_backend,
+    _ccl_kernel,
+    _device_algo,
+    connected_components_batch,
+  )
   from ..storage import CloudFiles
   from ..task_creation.ccl import create_ccl_face_tasks
   from ..tasks.ccl import (
@@ -217,10 +223,20 @@ def batched_ccl_faces(
     src_path, mip=mip, shape=shape, threshold_gte=threshold_gte,
     threshold_lte=threshold_lte, fill_missing=fill_missing,
   ))
+  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
+  if _ccl_backend() == "native":
+    # CPU-only host: the native two-pass union-find (per cutout) is the
+    # production path — the device kernel on XLA CPU is orders of
+    # magnitude slower, so batching it would be a pessimization
+    for t in tasks:
+      t.execute()
+      stats["edge_cutouts"] += 1
+    return stats
   files = CloudFiles(src_path)
   scratch = ccl_scratch_path(src_path, mip)
-  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
-  executor = BatchKernelExecutor(_ccl_kernel, mesh=mesh)
+  executor = BatchKernelExecutor(
+    partial(_ccl_kernel, algo=_device_algo()), mesh=mesh
+  )
 
   # geometric pre-partition by PREDICTED cutout shape: boundary tasks
   # clamped along the same dataset faces share shapes and batch together;

@@ -207,7 +207,14 @@ class SkeletonTask(RegisteredTask):
         region = Bbox.intersection(region, bounds)
         if region.empty():
           continue
-        cut = vol.download(region)[..., 0]
+        if vol.graphene is not None:
+          # the skeletons are keyed by proofread ROOT ids — a raw
+          # download would yield supervoxels and an always-empty mask
+          cut = vol.download(
+            region, agglomerate=True, timestamp=self.timestamp
+          )[..., 0]
+        else:
+          cut = vol.download(region)[..., 0]
         if self.fill_holes:
           # same mask semantics as the original pass (execute fills holes
           # before measuring); an unfilled cavity would shrink repaired
@@ -224,13 +231,20 @@ class SkeletonTask(RegisteredTask):
           window=ctx, vertex_mask=vmask,
           smoothing_window=self.csa_smoothing_window,
         )
-        # a clean (positive) recompute wins; a still-negative one means
-        # the section genuinely reaches the dataset boundary — keep the
-        # flagged lower bound if it grew
-        pos = repaired > 0
-        areas[members] = np.where(
-          pos[members], repaired[members],
-          np.minimum(areas[members], repaired[members]),
+        # a clean (positive) recompute wins — but the full slice always
+        # CONTAINS the clipped slice, so a repaired area below the
+        # flagged lower bound means the repair view diverged (e.g. a
+        # cavity that the original whole-cutout fill_holes closed but the
+        # ±ctx crop leaves open at its border); reject those rather than
+        # silently shrink. A still-negative recompute means the section
+        # genuinely reaches the dataset boundary — keep whichever lower
+        # bound is larger.
+        m = members
+        accept = (repaired[m] > 0) & (
+          repaired[m] >= -areas[m] * (1.0 - 1e-6)
+        )
+        areas[m] = np.where(
+          accept, repaired[m], np.minimum(areas[m], repaired[m])
         )
       skel.extra_attributes["cross_sectional_area"] = areas
 

@@ -149,6 +149,45 @@ def test_graphene_skeleton_autapse_fix(tmp_path):
   assert (vx < 470).any() and (vx > 490).any()
 
 
+def test_graphene_csa_repair_uses_root_ids(tmp_path):
+  """Cross-section contact repair on a graphene volume must download
+  AGGLOMERATED ids: the skeletons are keyed by root ids, so a raw
+  supervoxel download would make every repair mask empty and leave all
+  task-boundary slices flagged negative (regression)."""
+  data = np.zeros((64, 16, 16), np.uint64)
+  data[2:32, 5:11, 5:11] = 7
+  data[32:62, 5:11, 5:11] = 8
+  gpath = make_graphene_volume(
+    tmp_path, data, edges=[(7, 8)], chunk_size=(32, 16, 16)
+  )
+  run(tc.create_skeletonizing_tasks(
+    gpath, shape=(32, 16, 16), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 50},
+    cross_sectional_area=True,
+  ))
+  vol = Volume(gpath)
+  sdir = vol.info["skeletons"]
+  from igneous_tpu.skeleton_io import Skeleton
+
+  info = vol.cf.get_json(f"{sdir}/info")
+  keys = [k for k in vol.cf.list(f"{sdir}/") if k.endswith(".sk")]
+  assert keys
+  saw_vertex = False
+  for k in keys:
+    ske = Skeleton.from_precomputed(
+      vol.cf.get(k), vertex_attributes=info["vertex_attributes"]
+    )
+    areas = ske.extra_attributes.get("cross_sectional_area")
+    if areas is None or not len(areas):
+      continue
+    saw_vertex = True
+    # every slice is interior to the VOLUME (the bar ends inside it), so
+    # after repair no vertex may stay flagged: the task-boundary clips at
+    # x=32 must have been recomputed against the agglomerated context
+    assert (areas > 0).all(), areas[areas <= 0]
+  assert saw_vertex
+
+
 def test_graphene_mesh_forge_l2(tmp_path):
   data = np.zeros((64, 32, 32), np.uint64)
   data[4:60, 10:22, 10:22] = 5

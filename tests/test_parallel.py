@@ -155,12 +155,15 @@ def test_marching_tetrahedra_batch_matches_solo(rng):
     assert np.array_equal(bf, sf)
 
 
-def test_batched_ccl_faces_matches_task_path(rng, tmp_path):
+def test_batched_ccl_faces_matches_task_path(rng, tmp_path, monkeypatch):
   from igneous_tpu import task_creation as tc
   from igneous_tpu.parallel.batch_runner import batched_ccl_faces
   from igneous_tpu.queues import LocalTaskQueue
   from igneous_tpu.volume import Volume
 
+  # force the device kernel: on CPU hosts batched_ccl_faces falls back to
+  # solo native execution (tested separately below)
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "device")
   img = (rng.random((192, 64, 64)) < 0.3).astype(np.uint8) * 200
   pa = f"file://{tmp_path}/a"
   pb = f"file://{tmp_path}/b"
@@ -177,6 +180,35 @@ def test_batched_ccl_faces_matches_task_path(rng, tmp_path):
   keys_a = sorted(k for k in va.cf.list("") if "/faces/" in k)
   keys_b = sorted(k for k in vb.cf.list("") if "/faces/" in k)
   assert keys_a and [k for k in keys_a] == [k for k in keys_b]
+  for k in keys_a:
+    assert va.cf.get(k) == vb.cf.get(k), k
+
+
+def test_batched_ccl_faces_native_fallback(rng, tmp_path, monkeypatch):
+  """On CPU-only hosts the batched forge must run the solo native path
+  (the device kernel on XLA CPU is a ~1000x pessimization), with outputs
+  identical to the task path."""
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "native")
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.parallel.batch_runner import batched_ccl_faces
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.volume import Volume
+
+  img = (rng.random((128, 48, 48)) < 0.3).astype(np.uint8) * 200
+  pa = f"file://{tmp_path}/a"
+  pb = f"file://{tmp_path}/b"
+  for p in (pa, pb):
+    Volume.from_numpy(img, p, resolution=(8, 8, 8), chunk_size=(64, 48, 48))
+  LocalTaskQueue(parallel=1, progress=False).insert(
+    tc.create_ccl_face_tasks(pa, shape=(64, 48, 48), threshold_gte=100)
+  )
+  stats = batched_ccl_faces(
+    pb, shape=(64, 48, 48), threshold_gte=100, batch_size=4
+  )
+  assert stats["batched_cutouts"] == 0 and stats["dispatches"] == 0
+  va, vb = Volume(pa), Volume(pb)
+  keys_a = sorted(k for k in va.cf.list("") if "/faces/" in k)
+  assert keys_a
   for k in keys_a:
     assert va.cf.get(k) == vb.cf.get(k), k
 

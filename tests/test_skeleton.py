@@ -71,6 +71,20 @@ def test_edt_backends_agree_on_adversarial_runs(rng, backend, monkeypatch):
 
 
 @pytest.mark.parametrize("backend", ["device", "native", "numpy"])
+def test_edt_signed_negative_labels(rng, backend, monkeypatch):
+  """Signed inputs with negative labels: zero must stay BACKGROUND even
+  though it is not the smallest value (regression: the device relabel
+  once shifted zero to a foreground id whenever negatives were present)."""
+  monkeypatch.setenv("IGNEOUS_EDT_BACKEND", backend)
+  _require_native(backend)
+  lab = (rng.integers(-2, 3, (18, 15, 9)) * 7).astype(np.int32)
+  got = edt(lab, (2, 3, 5))
+  exp = scipy_multilabel_edt(lab, (2, 3, 5))
+  assert np.allclose(got, exp, atol=1e-3)
+  assert np.all(got[lab == 0] == 0)
+
+
+@pytest.mark.parametrize("backend", ["device", "native", "numpy"])
 def test_edt_black_border(backend, monkeypatch):
   monkeypatch.setenv("IGNEOUS_EDT_BACKEND", backend)
   _require_native(backend)
