@@ -126,6 +126,22 @@ def pooling_lib() -> Optional[ctypes.CDLL]:
   return lib
 
 
+def dijkstra_lib() -> Optional[ctypes.CDLL]:
+  lib = load("dijkstra")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.igdij_update.restype = ctypes.c_int
+    lib.igdij_update.argtypes = [
+      ctypes.c_int64,
+      ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib._configured = True
+  return lib
+
+
 def cseg_lib() -> Optional[ctypes.CDLL]:
   lib = load("cseg")
   if lib is None:

@@ -70,6 +70,40 @@ def test_edt_backends_agree_on_adversarial_runs(rng, backend, monkeypatch):
   assert np.allclose(got, exp, atol=1e-3)
 
 
+def test_incremental_dijkstra_matches_scipy(rng):
+  """The native warm-field multi-source update must equal a cold scipy
+  recompute from the cumulative source set after every batch — this is
+  the invariant fix_branching's per-path forest regrow relies on."""
+  from scipy.sparse import coo_matrix
+  from scipy.sparse.csgraph import dijkstra as sp_dijkstra
+
+  from igneous_tpu.ops.skeletonize import _IncrementalDijkstra
+
+  n, m = 2000, 8000
+  rows = rng.integers(0, n, m)
+  cols = rng.integers(0, n, m)
+  vals = rng.random(m) + 0.01
+  g = coo_matrix((vals, (rows, cols)), shape=(n, n)).tocsr()
+  chain = coo_matrix(
+    (np.full(n - 1, 0.5), (np.arange(n - 1), np.arange(1, n))), shape=(n, n)
+  ).tocsr()
+  g = g + g.T + chain + chain.T
+
+  inc = _IncrementalDijkstra(g)
+  if inc.lib is None:
+    pytest.fail("native dijkstra lib failed to build")
+  sources = []
+  for batch in ([0], [17, 99], list(rng.integers(0, n, 10))):
+    sources += list(batch)
+    inc.update(batch)
+    ref = sp_dijkstra(g, indices=sorted(set(sources)), min_only=True)
+    assert np.allclose(inc.dist, ref, atol=1e-9)
+    # pred consistency: every predecessor edge realizes the distance
+    for v in np.flatnonzero(inc.pred >= 0)[:200]:
+      u = int(inc.pred[v])
+      assert abs(inc.dist[v] - (inc.dist[u] + g[u, v])) < 1e-9
+
+
 @pytest.mark.parametrize("backend", ["device", "native", "numpy"])
 def test_edt_signed_negative_labels(rng, backend, monkeypatch):
   """Signed inputs with negative labels: zero must stay BACKGROUND even
