@@ -314,13 +314,18 @@ def _roots_to_components(roots: np.ndarray) -> np.ndarray:
 _BATCH_EXECUTORS = {}
 
 
-def _batch_executor(connectivity: int):
-  key = (connectivity, _device_algo())
+def _batch_executor(connectivity: int, mesh=None):
+  algo = _device_algo()
+  mesh_key = (
+    None if mesh is None
+    else (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+  )
+  key = (connectivity, algo, mesh_key)
   if key not in _BATCH_EXECUTORS:
     from ..parallel.executor import BatchKernelExecutor
 
     _BATCH_EXECUTORS[key] = BatchKernelExecutor(
-      partial(_ccl_kernel, connectivity=connectivity, algo=key[1])
+      partial(_ccl_kernel, connectivity=connectivity, algo=algo), mesh=mesh
     )
   return _BATCH_EXECUTORS[key]
 

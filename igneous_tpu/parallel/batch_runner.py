@@ -29,7 +29,7 @@ from ..ops.pooling import (
   _split_u64_planes,
   _to_device_layout,
 )
-from .executor import ChunkExecutor, make_mesh
+from .executor import ChunkExecutor, cached_chunk_executor, make_mesh
 
 # single source of truth for the (x,y,z,c) <-> (c,z,y,x) convention
 _to_batch_layout = _to_device_layout
@@ -86,7 +86,9 @@ def batched_downsample(
 
   mesh = mesh if mesh is not None else make_mesh()
   is_u64_mode = method == "mode" and vol.dtype.itemsize == 8
-  executor = ChunkExecutor(
+  # shared instance: a fresh ChunkExecutor per call would recompile the
+  # pyramid on every lease batch
+  executor = cached_chunk_executor(
     mesh, factors=tuple(factors), method=method, sparse=sparse,
     planes=2 if is_u64_mode else 1,
   )
@@ -107,7 +109,8 @@ def batched_downsample(
         dest_box = Bbox.intersection(dest_box, vol.meta.bounds(dest_mip))
         sl = tuple(slice(0, int(s)) for s in dest_box.size3())
         futures.append(io_pool.submit(
-          vol.upload, dest_box, arr[sl].astype(vol.dtype), dest_mip, compress
+          vol.upload, dest_box, arr[sl].astype(vol.dtype, copy=False),
+          dest_mip, compress,
         ))
     return futures
 
@@ -204,9 +207,8 @@ def batched_ccl_faces(
   falls back to the per-task path.
   """
   from ..ops.ccl import (
+    _batch_executor,
     _ccl_backend,
-    _ccl_kernel,
-    _device_algo,
     connected_components_batch,
   )
   from ..storage import CloudFiles
@@ -217,8 +219,6 @@ def batched_ccl_faces(
     ccl_scratch_path,
     store_ccl_faces,
   )
-  from .executor import BatchKernelExecutor
-
   tasks = list(create_ccl_face_tasks(
     src_path, mip=mip, shape=shape, threshold_gte=threshold_gte,
     threshold_lte=threshold_lte, fill_missing=fill_missing,
@@ -234,9 +234,8 @@ def batched_ccl_faces(
     return stats
   files = CloudFiles(src_path)
   scratch = ccl_scratch_path(src_path, mip)
-  executor = BatchKernelExecutor(
-    partial(_ccl_kernel, algo=_device_algo()), mesh=mesh
-  )
+  # module-cached: a fresh executor per call would recompile per run
+  executor = _batch_executor(6, mesh=mesh)
 
   # geometric pre-partition by PREDICTED cutout shape: boundary tasks
   # clamped along the same dataset faces share shapes and batch together;

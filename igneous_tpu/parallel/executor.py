@@ -27,6 +27,35 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "chunks") -> Mesh:
   return Mesh(np.asarray(devices), (axis,))
 
 
+_CHUNK_EXECUTOR_CACHE = {}
+
+
+def cached_chunk_executor(
+  mesh: Optional[Mesh] = None,
+  factors: Sequence[Tuple[int, int, int]] = ((2, 2, 1),),
+  method: str = "average",
+  sparse: bool = False,
+  planes: int = 1,
+) -> "ChunkExecutor":
+  """ChunkExecutor instances keyed by (devices, axis, pyramid config).
+
+  Each instance owns a fresh shard_map'd jit closure, so constructing one
+  per call recompiles the pyramid every time — repeat callers
+  (batched_downsample per lease batch) must share instances to hit the
+  jit cache."""
+  mesh = mesh if mesh is not None else make_mesh()
+  key = (
+    tuple(d.id for d in mesh.devices.flat), mesh.axis_names,
+    tuple(tuple(int(v) for v in f) for f in factors), method, sparse,
+    int(planes),
+  )
+  if key not in _CHUNK_EXECUTOR_CACHE:
+    _CHUNK_EXECUTOR_CACHE[key] = ChunkExecutor(
+      mesh, factors=factors, method=method, sparse=sparse, planes=planes
+    )
+  return _CHUNK_EXECUTOR_CACHE[key]
+
+
 class BatchKernelExecutor:
   """shard_map + vmap wrapper for ANY per-chunk device kernel.
 
