@@ -148,6 +148,9 @@ class TransferTask(RegisteredTask):
     if bounds.empty():
       return
 
+    if self._try_raw_copy(src, dest, bounds):
+      return
+
     with telemetry.stage("download"):
       image = src.download(
         bounds, agglomerate=self.agglomerate,
@@ -171,6 +174,53 @@ class TransferTask(RegisteredTask):
         method=self.downsample_method,
         compress=self.compress,
       )
+
+
+  def _try_raw_copy(self, src, dest, bounds: Bbox) -> bool:
+    """Most efficient transfer type: when the grids, dtype, and encoding
+    line up exactly and no resampling/remapping is requested, copy the
+    stored chunk objects without decoding a single voxel (reference
+    image.py:483-497 `transfer_to` fast path)."""
+    mip = self.mip
+    sm, dm = src.meta, dest.meta
+    eligible = (
+      self.skip_downsamples
+      and not self.agglomerate
+      and self.stop_layer is None
+      and tuple(int(v) for v in self.translate) == (0, 0, 0)
+      and not sm.is_sharded(mip) and not dm.is_sharded(mip)
+      and np.all(sm.chunk_size(mip) == dm.chunk_size(mip))
+      and np.all(sm.voxel_offset(mip) == dm.voxel_offset(mip))
+      and src.dtype == dest.dtype
+      and sm.encoding(mip) == dm.encoding(mip)
+      and (
+        sm.encoding(mip) != "compressed_segmentation"
+        or np.all(sm.cseg_block_size(mip) == dm.cseg_block_size(mip))
+      )
+      and bounds == Bbox.intersection(
+        bounds.expand_to_chunk_size(sm.chunk_size(mip), sm.voxel_offset(mip)),
+        src.bounds,
+      )
+    )
+    if not eligible:
+      return False
+    from ..lib import chunk_bboxes
+    from ..storage import CloudFiles
+
+    src_cf = CloudFiles(self.src_path)
+    dest_cf = CloudFiles(self.dest_path)
+    with telemetry.stage("raw_copy"):
+      for gc in chunk_bboxes(
+        bounds, sm.chunk_size(mip), offset=sm.voxel_offset(mip), clamp=False
+      ):
+        c = Bbox.intersection(gc, src.bounds)
+        if c.empty():
+          continue
+        data = src_cf.get(sm.chunk_name(mip, c))
+        if data is None:
+          continue  # missing chunks stay missing, like transfer_to
+        dest_cf.put(dm.chunk_name(mip, c), data, compress=self.compress)
+    return True
 
 
 class DownsampleTask(TransferTask):

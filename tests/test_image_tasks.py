@@ -143,6 +143,41 @@ def test_transfer_rechunk_and_mips(tmp_path):
   assert prov["processing"][-1]["method"]["task"] == "TransferTask"
 
 
+def test_transfer_raw_copy_fast_path(tmp_path, monkeypatch):
+  """Aligned same-layout transfers must copy stored chunk objects without
+  decoding a single voxel (reference image.py:483-497); any layout
+  mismatch falls back to the decode path."""
+  import igneous_tpu.codecs as codecs_mod
+
+  src_path = f"file://{tmp_path}/src"
+  vol, data = make_image_vol(src_path, shape=(128, 128, 64))
+
+  decodes = {"n": 0}
+  real = codecs_mod.decode
+  def spy(*a, **k):
+    decodes["n"] += 1
+    return real(*a, **k)
+  monkeypatch.setattr(codecs_mod, "decode", spy)
+
+  fast_dest = f"file://{tmp_path}/fast"
+  run(tc.create_transfer_tasks(
+    src_path, fast_dest, shape=(128, 128, 64), skip_downsamples=True,
+  ))
+  assert decodes["n"] == 0, "fast path decoded voxels"
+  dest = Volume(fast_dest)
+  assert np.array_equal(dest[dest.bounds][..., 0], data)
+
+  # rechunking breaks eligibility -> decode path
+  slow_dest = f"file://{tmp_path}/slow"
+  run(tc.create_transfer_tasks(
+    src_path, slow_dest, chunk_size=(32, 32, 32), shape=(128, 128, 64),
+    skip_downsamples=True,
+  ))
+  assert decodes["n"] > 0
+  dest = Volume(slow_dest)
+  assert np.array_equal(dest[dest.bounds][..., 0], data)
+
+
 def test_transfer_translate_and_encoding(tmp_path):
   src_path = f"file://{tmp_path}/src"
   dest_path = f"file://{tmp_path}/dest"
