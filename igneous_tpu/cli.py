@@ -94,10 +94,19 @@ def image():
 @click.option("--encoding", default=None)
 @click.option("--memory", "memory_target", default=int(3.5e9), show_default=True)
 @click.option("--method", "downsample_method", default="auto", show_default=True)
+@click.option("--batched", is_flag=True,
+              help="Run on this host's device mesh now (K cutouts per "
+                   "shard_map dispatch, double-buffered IO) instead of "
+                   "enqueuing per-cutout tasks.")
+@click.option("--batch-size", default=8, show_default=True,
+              help="Cutouts per device dispatch with --batched.")
+@click.option("--shape", type=TUPLE3, default=(256, 256, 64),
+              show_default=True, help="Cutout shape with --batched.")
 @click.pass_context
 def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
                      sparse, sharded, fill_missing, chunk_size, encoding,
-                     memory_target, downsample_method):
+                     memory_target, downsample_method, batched, batch_size,
+                     shape):
   """Build the downsample pyramid of PATH."""
   from . import task_creation as tc
 
@@ -105,6 +114,24 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
     if factor is not None:
       raise click.UsageError("--isotropic and --factor are exclusive")
     factor = "isotropic"
+  if batched:
+    if sharded or queue:
+      raise click.UsageError("--batched runs unsharded on this host (no -q)")
+    if factor == "isotropic":
+      raise click.UsageError("--batched uses one fixed --factor")
+    from .parallel.batch_runner import batched_downsample
+
+    stats = batched_downsample(
+      path, mip=mip, num_mips=num_mips, shape=shape,
+      batch_size=batch_size, factor=factor or (2, 2, 1), sparse=sparse,
+      fill_missing=fill_missing,
+    )
+    click.echo(
+      f"batched: {stats['batched_cutouts']} cutouts in "
+      f"{stats['dispatches']} dispatches, {stats['edge_cutouts']} edge "
+      f"cutouts via the task path"
+    )
+    return
   if sharded:
     tasks = tc.create_image_shard_downsample_tasks(
       path, mip=mip, fill_missing=fill_missing, sparse=sparse,
