@@ -171,6 +171,24 @@ def test_cli_end_to_end(tmp_path, rng):
   r = runner.invoke(main, ["design", "ds-shape", f"file://{tmp_path}/vol"])
   assert r.exit_code == 0 and "," in r.output
 
+  # --batched: the on-host mesh-sharded driver, oracle-identical output
+  r = runner.invoke(main, [
+    "image", "create", str(npy), f"file://{tmp_path}/volb",
+    "--resolution", "4,4,40", "--chunk-size", "32,32,32",
+  ])
+  assert r.exit_code == 0, r.output
+  r = runner.invoke(main, [
+    "image", "downsample", f"file://{tmp_path}/volb",
+    "--batched", "--num-mips", "1", "--shape", "64,64,32",
+  ])
+  assert r.exit_code == 0, r.output
+  assert "dispatches" in r.output
+  vb = Volume(f"file://{tmp_path}/volb", mip=1)
+  va = Volume(f"file://{tmp_path}/vol", mip=1)
+  assert np.array_equal(
+    vb.download(vb.bounds), va.download(va.bounds)
+  )
+
   r = runner.invoke(main, [
     "design", "bounds", f"file://{tmp_path}/vol"])
   assert r.exit_code == 0 and "chunks:" in r.output
