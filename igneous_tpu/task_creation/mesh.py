@@ -157,6 +157,7 @@ def create_unsharded_multires_mesh_tasks(
   mesh_dir: Optional[str] = None,
   num_lods: int = 2,
   encoding: str = "draco",
+  parallel: int = 1,
 ) -> Iterator:
   """Legacy fragments → unsharded multires (reference :481-546)."""
   from ..tasks.mesh import mesh_dir_for
@@ -175,6 +176,7 @@ def create_unsharded_multires_mesh_tasks(
       mesh_dir=out,
       num_lods=num_lods,
       encoding=encoding,
+      parallel=parallel,
     )
 
 
@@ -201,6 +203,7 @@ def create_sharded_multires_mesh_tasks(
   mesh_dir: Optional[str] = None,
   num_lods: int = 2,
   encoding: str = "draco",
+  parallel: int = 1,
 ) -> Iterator:
   """Sharded stage-1 .frags → sharded multires: census labels via the
   spatial index, solve shard bits, write the info, one task per shard
@@ -222,6 +225,7 @@ def create_sharded_multires_mesh_tasks(
       mesh_dir=mdir,
       num_lods=num_lods,
       encoding=encoding,
+      parallel=parallel,
     )
 
 
@@ -231,6 +235,7 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
   mesh_dir: Optional[str] = None,
   num_lods: int = 2,
   encoding: str = "draco",
+  parallel: int = 1,
 ) -> Iterator:
   """Legacy unsharded meshes → sharded multires (reference :590-704)."""
   from ..tasks.mesh import mesh_dir_for
@@ -254,6 +259,7 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
       mesh_dir=out,
       num_lods=num_lods,
       encoding=encoding,
+      parallel=parallel,
     )
 
 

@@ -54,6 +54,20 @@ def _fetch_legacy_label_mesh(cf, src_dir: str, label: int) -> Optional[Mesh]:
   return Mesh.concatenate(*pieces).consolidate()
 
 
+def _map_labels(fn, labels, parallel: int):
+  """Per-label merge work threaded across cores: every stage is numpy or
+  a GIL-releasing ctypes call (the QEM collapse inside process_mesh), and
+  results are keyed by label, so outputs are order-independent and
+  byte-identical to the serial path."""
+  labels = list(labels)
+  if int(parallel) > 1 and len(labels) > 1:
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=int(parallel)) as ex:
+      return list(ex.map(fn, labels))
+  return [fn(l) for l in labels]
+
+
 class MultiResUnshardedMeshMergeTask(RegisteredTask):
   """Legacy fragments → unsharded multires: per label ``<label>.index``
   manifest + ``<label>`` fragment file (reference :44-81)."""
@@ -66,6 +80,7 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     mesh_dir: Optional[str] = None,
     num_lods: int = 2,
     encoding: str = "draco",
+    parallel: int = 1,
   ):
     self.cloudpath = cloudpath
     self.prefix = str(prefix)
@@ -73,6 +88,7 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     self.mesh_dir = mesh_dir
     self.num_lods = int(num_lods)
     self.encoding = encoding
+    self.parallel = int(parallel)
 
   def execute(self):
     vol = Volume(self.cloudpath)
@@ -80,13 +96,22 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     out_dir = self.mesh_dir or f"{src_dir}_multires"
     cf = CloudFiles(vol.cloudpath)
 
-    for label in legacy_manifest_labels(cf, src_dir, self.prefix):
+    def one(label):
       mesh = _fetch_legacy_label_mesh(cf, src_dir, label)
       if mesh is None or len(mesh.faces) == 0:
-        continue
+        return None
       manifest, frags = process_mesh(
         mesh, num_lods=self.num_lods, encoding=self.encoding
       )
+      return label, manifest, frags
+
+    done = _map_labels(
+      one, legacy_manifest_labels(cf, src_dir, self.prefix), self.parallel
+    )
+    for item in done:
+      if item is None:
+        continue
+      label, manifest, frags = item
       cf.put(f"{out_dir}/{label}.index", manifest)
       cf.put(f"{out_dir}/{label}", frags)
 
@@ -104,12 +129,14 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
     mesh_dir: Optional[str] = None,
     num_lods: int = 2,
     encoding: str = "draco",
+    parallel: int = 1,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
     self.mesh_dir = mesh_dir
     self.num_lods = int(num_lods)
     self.encoding = encoding
+    self.parallel = int(parallel)
 
   def execute(self):
     from ..sharding import ShardingSpecification
@@ -136,24 +163,30 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
       if data is not None:
         fragmaps.append(FragMap.frombytes(data))
 
-    manifests = {}
-    preambles = {}
-    for label in mine.tolist():
+    def one(label):
       pieces = []
       for fm in fragmaps:
         blob = fm.get(label)
         if blob is not None:
           pieces.append(Mesh.from_precomputed(blob))
       if not pieces:
-        continue
+        return None
       mesh = Mesh.concatenate(*pieces).consolidate()
       if len(mesh.faces) == 0:
-        continue
+        return None
       manifest, frags = process_mesh(
         mesh, num_lods=self.num_lods, encoding=self.encoding
       )
-      manifests[int(label)] = manifest
-      preambles[int(label)] = frags
+      return int(label), manifest, frags
+
+    manifests = {}
+    preambles = {}
+    for item in _map_labels(one, mine.tolist(), self.parallel):
+      if item is None:
+        continue
+      label, manifest, frags = item
+      manifests[label] = manifest
+      preambles[label] = frags
 
     if manifests:
       files = spec.synthesize_shard_files(manifests, preambles=preambles)
@@ -172,6 +205,7 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
     mesh_dir: str,
     num_lods: int = 2,
     encoding: str = "draco",
+    parallel: int = 1,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
@@ -179,6 +213,7 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
     self.mesh_dir = mesh_dir
     self.num_lods = int(num_lods)
     self.encoding = encoding
+    self.parallel = int(parallel)
 
   def execute(self):
     from ..sharding import ShardingSpecification
@@ -195,17 +230,23 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
       return
     mine = labels[spec.shard_number(labels) == self.shard_no]
 
-    manifests = {}
-    preambles = {}
-    for label in mine.tolist():
+    def one(label):
       mesh = _fetch_legacy_label_mesh(cf, self.src_mesh_dir, label)
       if mesh is None or len(mesh.faces) == 0:
-        continue
+        return None
       manifest, frags = process_mesh(
         mesh, num_lods=self.num_lods, encoding=self.encoding
       )
-      manifests[int(label)] = manifest
-      preambles[int(label)] = frags
+      return int(label), manifest, frags
+
+    manifests = {}
+    preambles = {}
+    for item in _map_labels(one, mine.tolist(), self.parallel):
+      if item is None:
+        continue
+      label, manifest, frags = item
+      manifests[label] = manifest
+      preambles[label] = frags
 
     if manifests:
       files = spec.synthesize_shard_files(manifests, preambles=preambles)

@@ -174,6 +174,20 @@ def test_unsharded_multires_merge(tmp_path):
     assert sum(int(s) for _, sizes in lods for s in sizes) == len(frags)
 
 
+def test_sharded_multires_merge_parallel_identical(tmp_path):
+  """parallel=N threads the per-label LOD/encode work; shard files must
+  be byte-identical to the serial path."""
+  pa = make_forged_layer(tmp_path / "a", sharded=True)
+  pb = make_forged_layer(tmp_path / "b", sharded=True)
+  run(tc.create_sharded_multires_mesh_tasks(pa, num_lods=2))
+  run(tc.create_sharded_multires_mesh_tasks(pb, num_lods=2, parallel=4))
+  va, vb = Volume(pa), Volume(pb)
+  keys = sorted(k for k in va.cf.list("mesh/") if k.endswith(".shard"))
+  assert keys
+  for k in keys:
+    assert va.cf.get(k) == vb.cf.get(k), k
+
+
 def test_sharded_multires_merge(tmp_path):
   from igneous_tpu.sharding import ShardReader, ShardingSpecification
 
