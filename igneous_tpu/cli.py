@@ -119,12 +119,17 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
       raise click.UsageError("--batched runs unsharded on this host (no -q)")
     if factor == "isotropic":
       raise click.UsageError("--batched uses one fixed --factor")
+    if encoding or chunk_size:
+      raise click.UsageError(
+        "--batched downsamples in place; --encoding/--chunk-size apply "
+        "only to the task factories"
+      )
     from .parallel.batch_runner import batched_downsample
 
     stats = batched_downsample(
       path, mip=mip, num_mips=num_mips, shape=shape,
       batch_size=batch_size, factor=factor or (2, 2, 1), sparse=sparse,
-      fill_missing=fill_missing,
+      fill_missing=fill_missing, method=downsample_method,
     )
     click.echo(
       f"batched: {stats['batched_cutouts']} cutouts in "

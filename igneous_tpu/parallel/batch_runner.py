@@ -29,7 +29,7 @@ from ..ops.pooling import (
   _split_u64_planes,
   _to_device_layout,
 )
-from .executor import ChunkExecutor, cached_chunk_executor, make_mesh
+from .executor import cached_chunk_executor, make_mesh
 
 # single source of truth for the (x,y,z,c) <-> (c,z,y,x) convention
 _to_batch_layout = _to_device_layout
@@ -47,6 +47,7 @@ def batched_downsample(
   fill_missing: bool = False,
   compress="gzip",
   mesh=None,
+  method: str = "auto",
 ) -> dict:
   """Downsample a whole layer with batched device dispatches.
 
@@ -69,7 +70,7 @@ def batched_downsample(
   create_downsample_scales(vol.meta, mip, shape, factor, num_mips=len(factors))
   vol.commit_info()
 
-  method = pooling.method_for_layer(vol.layer_type, "auto")
+  method = pooling.method_for_layer(vol.layer_type, method)
   bounds = get_bounds(vol, None, mip, mip)
   shape = Vec(*shape)
 
@@ -172,6 +173,7 @@ def batched_downsample(
         num_mips=len(factors),
         factor=tuple(factor),
         compress=compress,
+        downsample_method=method,
       ).execute()
       stats["edge_cutouts"] += 1
 

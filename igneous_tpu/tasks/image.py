@@ -185,9 +185,17 @@ class TransferTask(RegisteredTask):
     sm, dm = src.meta, dest.meta
     eligible = (
       self.skip_downsamples
+      and not self.skip_first  # skip_first + skip_downsamples = no-op
       and not self.agglomerate
       and self.stop_layer is None
+      # fill_missing's decode path writes explicit zero chunks for holes;
+      # a raw copy would silently leave them missing
+      and not self.fill_missing
       and tuple(int(v) for v in self.translate) == (0, 0, 0)
+      # equal bounds: edge chunks are clamped to the volume bounds in
+      # their NAMES — differing extents would file src-clamped chunks
+      # under keys dest readers never request
+      and src.bounds == dest.bounds
       and not sm.is_sharded(mip) and not dm.is_sharded(mip)
       and np.all(sm.chunk_size(mip) == dm.chunk_size(mip))
       and np.all(sm.voxel_offset(mip) == dm.voxel_offset(mip))

@@ -97,23 +97,22 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     cf = CloudFiles(vol.cloudpath)
 
     def one(label):
+      # writes happen inside the worker: per-label outputs are
+      # independent files, so streaming keeps peak memory at
+      # O(parallel labels) instead of O(all labels)
       mesh = _fetch_legacy_label_mesh(cf, src_dir, label)
       if mesh is None or len(mesh.faces) == 0:
         return None
       manifest, frags = process_mesh(
         mesh, num_lods=self.num_lods, encoding=self.encoding
       )
-      return label, manifest, frags
-
-    done = _map_labels(
-      one, legacy_manifest_labels(cf, src_dir, self.prefix), self.parallel
-    )
-    for item in done:
-      if item is None:
-        continue
-      label, manifest, frags = item
       cf.put(f"{out_dir}/{label}.index", manifest)
       cf.put(f"{out_dir}/{label}", frags)
+      return None
+
+    _map_labels(
+      one, legacy_manifest_labels(cf, src_dir, self.prefix), self.parallel
+    )
 
 
 class MultiResShardedMeshMergeTask(RegisteredTask):
