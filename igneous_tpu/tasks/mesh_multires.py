@@ -156,11 +156,15 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
       return
 
     needed = sorted({f for lbl in mine for f in locations[int(lbl)]})
-    fragmaps = []
-    for spatial_key in needed:
-      data = cf.get(spatial_key.replace(".spatial", ".frags"))
-      if data is not None:
-        fragmaps.append(FragMap.frombytes(data))
+    # concurrent container fetches (reference: ThreadPoolExecutor in
+    # collect_mesh_fragments, multires.py:459); order preserved
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+      datas = list(ex.map(
+        lambda k: cf.get(k.replace(".spatial", ".frags")), needed
+      ))
+    fragmaps = [FragMap.frombytes(d) for d in datas if d is not None]
 
     def one(label):
       pieces = []

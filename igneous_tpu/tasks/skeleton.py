@@ -577,12 +577,16 @@ class ShardedSkeletonMergeTask(RegisteredTask):
     needed_files = sorted({
       f for lbl in mine for f in locations[int(lbl)]
     })
-    fragmaps = []
-    for spatial_key in needed_files:
-      frag_key = spatial_key.replace(".spatial", ".frags")
-      data = cf.get(frag_key)
-      if data is not None:
-        fragmaps.append(FragMap.frombytes(data))
+    # fetch containers concurrently (reference fetches fragments via a
+    # ThreadPoolExecutor, multires.py:459); order preserved for
+    # deterministic merge input ordering
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+      datas = list(ex.map(
+        lambda k: cf.get(k.replace(".spatial", ".frags")), needed_files
+      ))
+    fragmaps = [FragMap.frombytes(d) for d in datas if d is not None]
 
     attrs = skel_info.get("vertex_attributes")
     out = {}
