@@ -133,6 +133,65 @@ def test_filequeue_basic_lifecycle(tmp_path):
   assert q.enqueued == 2 and q.completed == 1
 
 
+def test_worker_killed_midtask_recovers(tmp_path):
+  """Real fault injection: a worker process is SIGKILLed while holding a
+  lease; after the lease expires, a fresh worker completes the pipeline
+  and the output is byte-correct. (The reference trusts this property to
+  its task-queue library; here it is exercised end to end.)"""
+  import signal
+  import subprocess
+  import sys
+  import time as time_mod
+
+  import numpy as np
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+  from igneous_tpu.ops import oracle
+
+  path = f"file://{tmp_path}/vol"
+  data = np.random.default_rng(5).integers(0, 255, (256, 256, 64)).astype(np.uint8)
+  Volume.from_numpy(data, path, chunk_size=(32, 32, 32))
+  qurl = f"fq://{tmp_path}/q"
+  q = TaskQueue(qurl)
+  q.insert(tc.create_downsampling_tasks(
+    path, mip=0, num_mips=2, memory_target=int(1e6)
+  ))
+  inserted = q.inserted
+  assert inserted >= 4
+
+  # worker 1: slowed to ~1 task/s via a sitecustomize sleep hook on task
+  # execution is overkill — simply SIGKILL it almost immediately; with
+  # spawn+jit warmup it will be mid-lease on its first task
+  env = dict(os.environ)
+  env["LEASE_SECONDS"] = "2"
+  w1 = subprocess.Popen(
+    [sys.executable, "-m", "igneous_tpu.cli", "execute", qurl,
+     "--lease-sec", "2"],
+    env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+  )
+  deadline = time_mod.time() + 60
+  while time_mod.time() < deadline and q.leased == 0 and q.completed == 0:
+    time_mod.sleep(0.05)
+  w1.send_signal(signal.SIGKILL)
+  w1.wait()
+
+  # lease expires -> task recycles -> a fresh worker drains the queue
+  time_mod.sleep(2.1)
+  w2 = subprocess.run(
+    [sys.executable, "-m", "igneous_tpu.cli", "execute", qurl,
+     "--exit-on-empty", "--lease-sec", "60"],
+    env=env, capture_output=True, text=True, timeout=300,
+    cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+  )
+  assert w2.returncode == 0, w2.stderr[-2000:]
+  assert q.is_empty()
+  vol = Volume(path, mip=1)
+  got = vol.download(vol.bounds)[..., 0]
+  exp = oracle.np_downsample_with_averaging(data, (2, 2, 1), 1)[0]
+  assert np.array_equal(got, exp)
+
+
 def test_filequeue_lease_expiry_recycles(tmp_path):
   q = FileQueue(f"fq://{tmp_path}/q")
   q.insert(TouchFileTask(path=str(tmp_path / "x")))
