@@ -41,6 +41,42 @@ def make_handler(cf: CloudFiles):
         self._cors()
         self.end_headers()
         return
+      # HTTP Range support: Neuroglancer's sharded reader fetches the
+      # fixed index, minishard indices, and fragment payloads via
+      # `Range: bytes=a-b` — without 206 responses every shard read
+      # would pull the whole (possibly multi-GB) shard file
+      rng = self.headers.get("Range")
+      if rng and rng.startswith("bytes="):
+        try:
+          start_s, end_s = rng[len("bytes="):].split("-", 1)
+          start = int(start_s)
+          length = (int(end_s) - start + 1) if end_s else None
+        except ValueError:
+          start, length = 0, None
+        data = (
+          cf.get_range(key, start, length)
+          if length is not None else None
+        )
+        if data is None:
+          # open-ended range, or a gzip-stored key that ranged raw reads
+          # cannot serve: fall back to a full get + slice
+          full = cf.get(key)
+          if full is None:
+            self.send_response(404)
+            self._cors()
+            self.end_headers()
+            return
+          data = full[start:] if length is None else full[start:start + length]
+        self.send_response(206)
+        self._cors()
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header(
+          "Content-Range", f"bytes {start}-{start + len(data) - 1}/*"
+        )
+        self.end_headers()
+        self.wfile.write(data)
+        return
       data = cf.get(key)
       if data is None:
         self.send_response(404)

@@ -95,6 +95,16 @@ def test_view_server(tmp_path, rng):
       assert len(chunk) == 64**3
     with pytest.raises(urllib.error.HTTPError):
       urllib.request.urlopen(f"http://localhost:{port}/nope")
+    # ranged reads (the sharded-format access pattern): 206 + exact slice
+    req = urllib.request.Request(
+      f"http://localhost:{port}/info", headers={"Range": "bytes=2-5"}
+    )
+    with urllib.request.urlopen(req) as r:
+      assert r.status == 206
+      body = r.read()
+      assert len(body) == 4
+      with urllib.request.urlopen(f"http://localhost:{port}/info") as full:
+        assert body == full.read()[2:6]
   finally:
     httpd.shutdown()
   url = neuroglancer_url(1337, "vol", "image")
