@@ -388,6 +388,26 @@ def bench_edt_kernel():
   return lab.size / dt
 
 
+def bench_host_kernels(img, seg):
+  """The production path on an accelerator-less host: the native C++
+  pooling kernels threaded across every core — exactly what
+  ops.pooling.downsample_auto dispatches to when no TPU is attached (the
+  same deal as the reference's tinybrain-on-CPU workers). None when the
+  native lib is unavailable."""
+  from igneous_tpu.ops import pooling
+
+  warm = pooling.host_downsample(
+    img, (2, 2, 1), NUM_MIPS, method="average", parallel=0
+  )
+  if warm is None:
+    return None
+  t0 = time.perf_counter()
+  pooling.host_downsample(img, (2, 2, 1), NUM_MIPS, method="average", parallel=0)
+  pooling.host_downsample(seg, (2, 2, 1), NUM_MIPS, method="mode", parallel=0)
+  dt = time.perf_counter() - t0
+  return (img.size + seg.size) / dt
+
+
 def run_bench(platform: str):
   if platform == "tpu":
     # Never report CPU numbers as TPU: a fast axon-init failure silently
@@ -398,6 +418,7 @@ def run_bench(platform: str):
     assert backend in ("axon", "tpu"), f"tpu child got backend {backend!r}"
   img, seg = make_data()
   dev_kernel = bench_device_kernels(img, seg)
+  host_kernel = None if platform == "tpu" else bench_host_kernels(img, seg)
   cpu1, baseline_kind = bench_cpu_kernels(img, seg)
   cpu8 = cpu1 * 8.0
   e2e = bench_e2e(img, seg)
@@ -412,14 +433,26 @@ def run_bench(platform: str):
   pool_ab = bench_pool_ab() if platform == "tpu" else None
   edt_rate = bench_edt_kernel()
 
+  # Headline = the framework's production kernel path on this platform:
+  # device pyramid on TPU; on the CPU fallback, the native threaded host
+  # path that downsample_auto actually dispatches to here (the XLA-CPU
+  # device-kernel rate stays in detail for reference).
+  headline = dev_kernel if host_kernel is None else host_kernel
   result = {
     "metric": "downsample_kernel_mip0to4_voxels_per_sec",
-    "value": round(dev_kernel, 1),
+    "value": round(headline, 1),
     "unit": "vox/s",
-    "vs_baseline": round(dev_kernel / cpu8, 3),
+    "vs_baseline": round(headline / cpu8, 3),
     "detail": {
       "img_shape": list(IMG_SHAPE),
       "seg_shape": list(SEG_SHAPE),
+      "device_kernel_voxps": round(dev_kernel, 1),
+      "host_native_kernel_voxps": (
+        round(host_kernel, 1) if host_kernel is not None else None
+      ),
+      # the baseline credits the reference with 8 cores; on a smaller
+      # fallback host the per-core ratio is the informative comparison
+      "host_cores": len(os.sched_getaffinity(0)),
       "cpu_1core_kernel_voxps": round(cpu1, 1),
       "cpu8_baseline_voxps": round(cpu8, 1),
       "e2e_pipeline_voxps": round(e2e, 1),

@@ -15,6 +15,7 @@ queue tooling stay instant.
 
 from __future__ import annotations
 
+import os
 import sys
 
 import click
@@ -877,6 +878,11 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   if parallel > 1:
     import multiprocessing as mp
 
+    # divide cores among workers for native kernel threading (same
+    # oversubscription hygiene as the reference's cv2.setNumThreads(0))
+    os.environ.setdefault(
+      "IGNEOUS_POOL_THREADS", str(max(1, (os.cpu_count() or 1) // parallel))
+    )
     ctx_mp = mp.get_context("spawn")
     procs = [
       ctx_mp.Process(

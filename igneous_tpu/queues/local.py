@@ -12,6 +12,7 @@ is outright unsafe.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 from typing import Iterable, Optional
 
 from tqdm import tqdm
@@ -26,6 +27,14 @@ def _execute_payload(payload: str):
   task = deserialize(payload)
   task.execute()
   return True
+
+
+def _worker_init(pool_threads: int):
+  """Spawned-worker setup: N process-parallel workers each get 1/N of the
+  cores for their native kernel threading (same oversubscription hygiene as
+  the reference's cv2.setNumThreads(0),
+  /root/reference/igneous/tasks/image/image.py:177-180)."""
+  os.environ.setdefault("IGNEOUS_POOL_THREADS", str(pool_threads))
 
 
 class LocalTaskQueue:
@@ -50,7 +59,10 @@ class LocalTaskQueue:
         bar.update(1)
     else:
       ctx = mp.get_context("spawn")
-      with ctx.Pool(self.parallel) as pool:
+      threads = max(1, (os.cpu_count() or 1) // self.parallel)
+      with ctx.Pool(
+        self.parallel, initializer=_worker_init, initargs=(threads,)
+      ) as pool:
         for _ in pool.imap_unordered(_execute_payload, payloads, chunksize=1):
           self.inserted += 1
           self.completed += 1

@@ -1,0 +1,108 @@
+"""Host production pooling path (native C++ kernels) vs the device path.
+
+An accelerator-less worker dispatches downsample_auto to the native
+kernels (ops/pooling.py host path); these tests pin that path to the
+device kernels' exact semantics across dtypes, odd shapes, channels,
+per-mip factors, and sparse mode — so the dispatch can never change
+results, only speed. Reference parity target: tinybrain's C kernels on
+the reference's CPU workers (SURVEY.md §2.3).
+"""
+
+import numpy as np
+import pytest
+
+from igneous_tpu.ops import pooling
+
+
+def _host(img, factor, num_mips, **kw):
+  out = pooling.host_downsample(img, factor, num_mips, **kw)
+  if out is None:
+    pytest.skip("native pooling lib unavailable (no toolchain)")
+  return out
+
+
+def _check(host_outs, dev_outs):
+  assert len(host_outs) == len(dev_outs)
+  for h, d in zip(host_outs, dev_outs):
+    assert h.dtype == d.dtype
+    assert h.shape == d.shape
+    np.testing.assert_array_equal(h, d)
+
+
+def test_average_u8_odd_shapes(rng):
+  img = rng.integers(0, 256, size=(33, 21, 17), dtype=np.uint8)
+  h = _host(img, (2, 2, 1), 3, method="average")
+  d = pooling.downsample(img, (2, 2, 1), 3, method="average")
+  _check(h, d)
+
+
+def test_average_u8_multichannel(rng):
+  img = rng.integers(0, 256, size=(16, 12, 9, 2), dtype=np.uint8)
+  h = _host(img, (2, 2, 2), 2, method="average")
+  d = pooling.downsample(img, (2, 2, 2), 2, method="average")
+  _check(h, d)
+
+
+def test_average_per_mip_factors(rng):
+  img = rng.integers(0, 256, size=(32, 32, 12), dtype=np.uint8)
+  factors = [(2, 2, 1), (2, 2, 2), (1, 1, 2)]
+  h = _host(img, factors, 3, method="average")
+  d = pooling.downsample(img, factors, 3, method="average")
+  _check(h, d)
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_mode_u64(rng, sparse):
+  img = rng.integers(0, 5, size=(17, 14, 11)).astype(np.uint64)
+  img[img == 3] += np.uint64(2**40)  # exercise the high word
+  h = _host(img, (2, 2, 2), 2, method="mode", sparse=sparse)
+  d = pooling.downsample(img, (2, 2, 2), 2, method="mode", sparse=sparse)
+  _check(h, d)
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.uint16, np.int32, np.int64])
+def test_mode_dtypes(rng, dtype):
+  img = rng.integers(0, 7, size=(13, 10, 8)).astype(dtype)
+  if np.dtype(dtype).kind == "i":
+    img[img == 5] *= -1  # negative labels survive the u64 value mapping
+  h = _host(img, (2, 2, 1), 2, method="mode")
+  d = pooling.downsample(img, (2, 2, 1), 2, method="mode")
+  _check(h, d)
+
+
+def test_mode_bool(rng):
+  img = rng.random((12, 9, 7)) < 0.4
+  h = _host(img, (2, 2, 2), 1, method="mode")
+  d = pooling.downsample(img, (2, 2, 2), 1, method="mode")
+  _check(h, d)
+
+
+def test_striding(rng):
+  img = rng.integers(0, 256, size=(21, 14, 9), dtype=np.uint8)
+  h = _host(img, (2, 2, 2), 2, method="striding")
+  d = pooling.downsample(img, (2, 2, 2), 2, method="striding")
+  _check(h, d)
+
+
+def test_unsupported_returns_none(rng):
+  img = rng.random((8, 8, 8)).astype(np.float32)
+  assert pooling.host_downsample(img, (2, 2, 1), 1, method="average") is None
+  assert pooling.host_downsample(img, (2, 2, 1), 1, method="min") is None
+
+
+def test_downsample_auto_dispatch(rng, monkeypatch):
+  img = rng.integers(0, 256, size=(19, 15, 10), dtype=np.uint8)
+  d = pooling.downsample(img, (2, 2, 1), 2, method="average")
+  for mode in ("auto", "1", "0"):
+    monkeypatch.setenv("IGNEOUS_POOL_HOST", mode)
+    a = pooling.downsample_auto(img, (2, 2, 1), 2, method="average")
+    _check(a, d)
+
+
+def test_downsample_auto_seg_parity(rng, monkeypatch):
+  """The exact call shape the task layer makes for segmentation layers."""
+  img = rng.integers(0, 9, size=(22, 18, 13)).astype(np.uint64)
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "1")
+  a = pooling.downsample_auto(img, (2, 2, 1), 3, method="mode", sparse=True)
+  d = pooling.downsample(img, (2, 2, 1), 3, method="mode", sparse=True)
+  _check(a, d)
