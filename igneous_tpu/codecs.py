@@ -105,7 +105,7 @@ def decode_png(data: bytes, shape, dtype) -> np.ndarray:
 
 def encode(
   img: np.ndarray, encoding: str, block_size=(8, 8, 8),
-  jpeg_quality: int = JPEG_DEFAULT_QUALITY,
+  jpeg_quality: int = JPEG_DEFAULT_QUALITY, png_level: int = 6,
 ) -> bytes:
   if img.ndim == 3:
     img = img[..., np.newaxis]
@@ -116,7 +116,7 @@ def encode(
   if encoding == "jpeg":
     return encode_jpeg(img, quality=jpeg_quality)
   if encoding == "png":
-    return encode_png(img)
+    return encode_png(img, compress_level=png_level)
   raise NotImplementedError(f"Encoding not supported: {encoding}")
 
 

@@ -171,11 +171,30 @@ class PrecomputedMetadata:
   def encoding(self, mip: int) -> str:
     return self.scale(mip)["encoding"]
 
-  def set_encoding(self, mip: int, encoding: str):
+  def set_encoding(self, mip: int, encoding: Optional[str],
+                   encoding_level: Optional[int] = None,
+                   encoding_effort: Optional[int] = None):
+    """Set a scale's encoding and its quality knob (reference
+    task_creation/common.py:215-236: encoding_level maps to jpeg quality
+    or png compression level, recorded in the scale like cloud-volume
+    does so uploads pick it up)."""
     scale = self.scale(mip)
-    scale["encoding"] = encoding
-    if encoding == "compressed_segmentation":
-      scale.setdefault("compressed_segmentation_block_size", [8, 8, 8])
+    if encoding is not None:
+      scale["encoding"] = encoding
+      if encoding == "compressed_segmentation":
+        scale.setdefault("compressed_segmentation_block_size", [8, 8, 8])
+    if encoding_level is None:
+      return
+    encoding = encoding or scale["encoding"]
+    if encoding == "jpeg":
+      scale["jpeg_quality"] = int(encoding_level)
+    elif encoding == "png":
+      scale["png_level"] = int(encoding_level)
+    elif encoding in ("jxl", "fpzip", "zfpc"):
+      raise NotImplementedError(
+        f"encoding {encoding!r} is not shipped (no offline oracle to "
+        f"validate its bitstream against; see ROADMAP.md)"
+      )
 
   def cseg_block_size(self, mip: int) -> Vec:
     return Vec(*self.scale(mip).get("compressed_segmentation_block_size", [8, 8, 8]))

@@ -91,6 +91,8 @@ def create_downsampling_tasks(
   sparse: bool = False,
   chunk_size: Optional[Sequence[int]] = None,
   encoding: Optional[str] = None,
+  encoding_level: Optional[int] = None,
+  encoding_effort: Optional[int] = None,
   delete_black_uploads: bool = False,
   background_color: int = 0,
   compress="gzip",
@@ -127,6 +129,9 @@ def create_downsampling_tasks(
     chunk_size=chunk_size,
     encoding=encoding,
   )
+  if encoding_level is not None or encoding_effort is not None:
+    for m in range(mip + 1, mip + 1 + len(factors)):
+      vol.meta.set_encoding(m, None, encoding_level, encoding_effort)
   vol.commit_info()
 
   task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
@@ -180,6 +185,8 @@ def create_transfer_tasks(
   sparse: bool = False,
   compress="gzip",
   encoding: Optional[str] = None,
+  encoding_level: Optional[int] = None,
+  encoding_effort: Optional[int] = None,
   num_mips: int = 0,
   factor: Optional[Sequence[int]] = None,
   memory_target: int = MEMORY_TARGET,
@@ -187,11 +194,24 @@ def create_transfer_tasks(
   agglomerate: bool = False,
   timestamp: Optional[float] = None,
   stop_layer: Optional[int] = None,
+  clean_info: bool = False,
+  no_src_update: bool = False,
+  truncate_scales: bool = True,
+  cutout: bool = False,
+  use_https_for_source: bool = False,
 ):
   """Grid of TransferTasks; creates/extends the destination info
   (reference: task_creation/image.py:921-1170). ``agglomerate``/
   ``timestamp``/``stop_layer`` materialize a graphene volume's proofread
-  root (or L2) ids while copying."""
+  root (or L2) ids while copying.
+
+  ``cutout`` restricts a NEWLY created destination's bounds to ``bounds``;
+  ``truncate_scales`` drops scales above ``mip`` from a new destination;
+  ``clean_info`` scrubs mesh/skeleton fields from a new destination;
+  ``no_src_update`` skips the source provenance note (all per reference
+  :943-1033). ``use_https_for_source`` is accepted for interface parity;
+  this build has no https storage backend, so it only implies
+  ``no_src_update`` like the reference (:1033)."""
   src = Volume(src_layer_path, mip=mip)
   if factor is None:
     factor = DEFAULT_FACTOR
@@ -238,6 +258,10 @@ def create_transfer_tasks(
     volume_size=base_scale["size"],
     chunk_size=dest_chunk,
   )
+  if use_https_for_source:
+    # no https storage backend in this build; match the reference's one
+    # hard semantic (a read-only source gets no provenance note, :1033)
+    no_src_update = True
   try:
     dest = Volume(dest_layer_path)  # existing destination info wins
     if materialize_ids and dest.meta.data_type != "uint64":
@@ -254,6 +278,30 @@ def create_transfer_tasks(
         chunk_size=dest_chunk,
         encoding=encoding or src.meta.encoding(m),
       )
+    if not truncate_scales:
+      # keep the source's scale structure above `mip` too (reference
+      # truncate_scales=False, :904-905 inverted)
+      for m in range(mip + 1, src.meta.num_mips):
+        dest.meta.add_scale(
+          np.asarray(src.meta.downsample_ratio(m)),
+          chunk_size=dest_chunk,
+          encoding=encoding or src.meta.encoding(m),
+        )
+    if cutout and bounds is not None:
+      # restrict the new volume to the requested bounds (reference :879-886)
+      bounds_res = np.asarray(src.meta.resolution(bounds_mip), dtype=float)
+      for i in range(len(dest.info["scales"])):
+        ratio = bounds_res / np.asarray(dest.meta.resolution(i), dtype=float)
+        sc = dest.info["scales"][i]
+        sc["voxel_offset"] = [
+          int(v) for v in np.asarray(bounds.minpt, dtype=float) * ratio
+        ]
+        sc["size"] = [
+          int(np.ceil(v)) for v in np.asarray(bounds.size3(), float) * ratio
+        ]
+    if clean_info:
+      for key in ("mesh", "meshing", "skeletons"):
+        dest.info.pop(key, None)
 
   if shape is None:
     shape = downsample_shape_from_memory_target(
@@ -271,6 +319,9 @@ def create_transfer_tasks(
       dest.meta, mip, shape, factor, num_mips=len(factors),
       chunk_size=dest_chunk, encoding=encoding,
     )
+  if encoding_level is not None or encoding_effort is not None:
+    for m in range(mip, len(dest.info["scales"])):
+      dest.meta.set_encoding(m, None, encoding_level, encoding_effort)
   dest.commit_info()
 
   task_bounds = get_bounds(src, bounds, mip, bounds_mip)
@@ -308,6 +359,14 @@ def create_transfer_tasks(
       "translate": list(translate),
       "bounds": task_bounds.to_list(),
     })
+    if not no_src_update:
+      # note the outbound copy on the source too (reference :1166)
+      _provenance(src, {
+        "task": "TransferTask",
+        "transferred_to": dest_layer_path,
+        "mip": mip,
+        "bounds": task_bounds.to_list(),
+      })
 
   return GridTaskIterator(task_bounds, shape, make_task, finish)
 

@@ -54,9 +54,10 @@ def downsample_and_upload(
     return
 
   method = pooling.method_for_layer(vol.layer_type, method)
-  # uint64 labels are handled natively (hi/lo uint32 planes on device)
+  # uint64 labels are handled natively (hi/lo uint32 planes on device);
+  # hosts with no accelerator dispatch to the native C++ kernels instead
   with telemetry.stage("device_pool"):
-    mips_out = pooling.downsample(
+    mips_out = pooling.downsample_auto(
       image, factors, len(factors), method=method, sparse=sparse
     )
 

@@ -91,7 +91,7 @@ class ImageShardDownsampleTask(RegisteredTask):
       return
     img = vol.download(bounds)
     method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
-    mipped = pooling.downsample(
+    mipped = pooling.downsample_auto(
       img, tuple(int(v) for v in self.factor), 1, method=method,
       sparse=self.sparse,
     )[0]

@@ -447,6 +447,14 @@ class Volume:
 
     encoding = self.meta.encoding(mip)
     block_size = self.meta.cseg_block_size(mip)
+    # per-scale quality knobs (meta.set_encoding; reference
+    # task_creation/common.py:215-236 records them in the scale)
+    enc_kw = {}
+    scale = self.meta.scale(mip)
+    if encoding == "jpeg" and "jpeg_quality" in scale:
+      enc_kw["jpeg_quality"] = int(scale["jpeg_quality"])
+    elif encoding == "png" and "png_level" in scale:
+      enc_kw["png_level"] = int(scale["png_level"])
     puts = []
     deletes = []
     for gchunk in chunk_bboxes(bbox, cs, offset=offset, clamp=False):
@@ -481,7 +489,9 @@ class Volume:
       if self.delete_black_uploads and np.all(cutout == self.background_color):
         deletes.append(key)
         continue
-      puts.append((key, codecs.encode(cutout, encoding, block_size=block_size)))
+      puts.append((key, codecs.encode(
+        cutout, encoding, block_size=block_size, **enc_kw
+      )))
 
     self._parallel_put(puts, compress, parallel)
     if deletes:
