@@ -76,10 +76,11 @@ def load_nrrd(path: str) -> np.ndarray:
   return arr.reshape(sizes, order="F").astype(dtype, copy=False)
 
 
-def load_hdf5(path: str) -> np.ndarray:
-  """HDF5 ingest (reference cli.py:1867-1875 via h5py): read the dataset
-  named ``main`` when present (the conventional EM-volume dataset name),
-  otherwise the first dataset in the file."""
+def load_hdf5(path: str, dataset: str = "main") -> np.ndarray:
+  """HDF5 ingest (reference cli.py:1867-1875 via h5py): read the named
+  dataset when present (``main`` is the conventional EM-volume dataset
+  name; reference --h5-dataset), otherwise the first dataset in the
+  file."""
   try:
     import h5py
   except ImportError as e:  # pragma: no cover - present in this image
@@ -87,8 +88,8 @@ def load_hdf5(path: str) -> np.ndarray:
       "HDF5 ingest needs h5py; convert to .npy first (np.save(...))"
     ) from e
   with h5py.File(path, "r") as f:
-    if "main" in f and isinstance(f["main"], h5py.Dataset):
-      return f["main"][:]
+    if dataset in f and isinstance(f[dataset], h5py.Dataset):
+      return f[dataset][:]
     for key in f:
       if isinstance(f[key], h5py.Dataset):
         return f[key][:]
@@ -138,7 +139,7 @@ def load_nifti(path: str) -> np.ndarray:
   return arr.reshape(shape, order="F").astype(dtypes[datatype], copy=False)
 
 
-def load_volume_file(path: str) -> np.ndarray:
+def load_volume_file(path: str, h5_dataset: str = "main") -> np.ndarray:
   """Route an ingest file by extension (reference cli.py:1852-1923)."""
   low = path.lower()
   if low.endswith(".npy"):
@@ -153,7 +154,7 @@ def load_volume_file(path: str) -> np.ndarray:
   if low.endswith((".nii", ".nii.gz")):
     return load_nifti(path)
   if low.endswith((".h5", ".hdf5")):
-    return load_hdf5(path)
+    return load_hdf5(path, dataset=h5_dataset)
   if low.endswith(".ckl"):
     raise ValueError(
       "crackle (.ckl) ingest needs the crackle-codec package; decompress "

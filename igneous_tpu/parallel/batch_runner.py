@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 from functools import partial
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -48,11 +48,13 @@ def batched_downsample(
   compress="gzip",
   mesh=None,
   method: str = "auto",
+  bounds: Optional[Bbox] = None,
 ) -> dict:
   """Downsample a whole layer with batched device dispatches.
 
   Creates destination scales (like create_downsampling_tasks), then
   processes the grid in K-cutout batches. Returns run statistics.
+  ``bounds`` (at ``mip``) restricts the processed region.
   """
   from ..downsample_scales import create_downsample_scales
   from ..ops import pooling
@@ -71,7 +73,7 @@ def batched_downsample(
   vol.commit_info()
 
   method = pooling.method_for_layer(vol.layer_type, method)
-  bounds = get_bounds(vol, None, mip, mip)
+  bounds = get_bounds(vol, bounds, mip, mip)
   shape = Vec(*shape)
 
   full_boxes = []

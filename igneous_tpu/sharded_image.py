@@ -69,6 +69,13 @@ def upload_shard(vol, bbox: Bbox, img: np.ndarray, mip: int):
   encoding = meta.encoding(mip)
   block_size = meta.cseg_block_size(mip)
   bounds = meta.bounds(mip)
+  # per-scale quality knobs, same contract as Volume.upload
+  enc_kw = {}
+  scale = meta.scale(mip)
+  if encoding == "jpeg" and "jpeg_quality" in scale:
+    enc_kw["jpeg_quality"] = int(scale["jpeg_quality"])
+  elif encoding == "png" and "png_level" in scale:
+    enc_kw["png_level"] = int(scale["png_level"])
 
   chunks: Dict[int, bytes] = {}
   for gchunk in chunk_bboxes(
@@ -87,7 +94,9 @@ def upload_shard(vol, bbox: Bbox, img: np.ndarray, mip: int):
       for a, b in zip(chunk_bbx.minpt - bbox.minpt, chunk_bbx.maxpt - bbox.minpt)
     )
     cid = chunk_morton_id(vol, gchunk, mip)
-    chunks[cid] = codecs.encode(img[sl], encoding, block_size=block_size)
+    chunks[cid] = codecs.encode(
+      img[sl], encoding, block_size=block_size, **enc_kw
+    )
 
   files = spec.synthesize_shard_files(chunks)
   prefix = meta.key(mip)

@@ -68,6 +68,7 @@ def create_ccl_face_tasks(
   threshold_gte: Optional[float] = None,
   threshold_lte: Optional[float] = None,
   bounds: Optional[Bbox] = None,
+  dust_threshold: int = 0,
 ):
   vol = Volume(src_path, mip=mip)
   task_bounds, shape, grid_size = _grid(vol, mip, shape, bounds)
@@ -77,6 +78,7 @@ def create_ccl_face_tasks(
       fill_missing=fill_missing,
       threshold_gte=threshold_gte,
       threshold_lte=threshold_lte,
+      dust_threshold=dust_threshold,
     ),
   )
 
@@ -89,6 +91,7 @@ def create_ccl_equivalence_tasks(
   threshold_gte: Optional[float] = None,
   threshold_lte: Optional[float] = None,
   bounds: Optional[Bbox] = None,
+  dust_threshold: int = 0,
 ):
   vol = Volume(src_path, mip=mip)
   task_bounds, shape, grid_size = _grid(vol, mip, shape, bounds)
@@ -99,6 +102,7 @@ def create_ccl_equivalence_tasks(
       fill_missing=fill_missing,
       threshold_gte=threshold_gte,
       threshold_lte=threshold_lte,
+      dust_threshold=dust_threshold,
     ),
   )
 
@@ -114,6 +118,7 @@ def create_ccl_relabel_tasks(
   bounds: Optional[Bbox] = None,
   encoding: str = "compressed_segmentation",
   chunk_size: Optional[Sequence[int]] = None,
+  dust_threshold: int = 0,
 ):
   """Creates the destination segmentation layer and the pass-4 grid.
   Requires create_relabeling to have produced max_label.json."""
@@ -168,6 +173,7 @@ def create_ccl_relabel_tasks(
       fill_missing=fill_missing,
       threshold_gte=threshold_gte,
       threshold_lte=threshold_lte,
+      dust_threshold=dust_threshold,
     ),
   )
 

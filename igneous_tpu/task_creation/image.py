@@ -377,6 +377,8 @@ def create_image_shard_transfer_tasks(
   mip: int = 0,
   chunk_size: Optional[Sequence[int]] = None,
   encoding: Optional[str] = None,
+  encoding_level: Optional[int] = None,
+  encoding_effort: Optional[int] = None,
   translate: Sequence[int] = (0, 0, 0),
   dest_voxel_offset: Optional[Sequence[int]] = None,
   fill_missing: bool = False,
@@ -436,6 +438,8 @@ def create_image_shard_transfer_tasks(
   # the computed sharding spec always lands on the scale tasks write to —
   # including when the destination layer already existed
   dest.meta.scale(mip)["sharding"] = spec
+  if encoding_level is not None or encoding_effort is not None:
+    dest.meta.set_encoding(mip, None, encoding_level, encoding_effort)
   dest.commit_info()
 
   shape = Vec(*image_shard_shape_from_spec(
@@ -478,6 +482,8 @@ def create_image_shard_downsample_tasks(
   sparse: bool = False,
   chunk_size: Optional[Sequence[int]] = None,
   encoding: Optional[str] = None,
+  encoding_level: Optional[int] = None,
+  encoding_effort: Optional[int] = None,
   factor: Sequence[int] = (2, 2, 1),
   bounds: Optional[Bbox] = None,
   bounds_mip: int = 0,
@@ -513,6 +519,15 @@ def create_image_shard_downsample_tasks(
     base_ratio * np.asarray(factor), chunk_size=cs,
     encoding=encoding, sharding=spec,
   )
+  dest_mip_key = "_".join(
+    str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
+    * base_ratio * np.asarray(factor)
+  )
+  if encoding_level is not None or encoding_effort is not None:
+    vol.meta.set_encoding(
+      vol.meta.mip_from_key(dest_mip_key), None, encoding_level,
+      encoding_effort,
+    )
   vol.commit_info()
   dest_mip = vol.meta.mip_from_key("_".join(
     str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
@@ -643,6 +658,7 @@ def create_luminance_levels_tasks(
   coverage_factor: float = 0.01,
   shape: Optional[Sequence[int]] = None,
   bounds: Optional[Bbox] = None,
+  bounds_mip: Optional[int] = None,
   fill_missing: bool = False,
 ):
   """Phase 1 of contrast correction: per-z histograms
@@ -650,7 +666,9 @@ def create_luminance_levels_tasks(
   from ..tasks.contrast import LuminanceLevelsTask
 
   vol = Volume(src_path, mip=mip)
-  task_bounds = get_bounds(vol, bounds, mip, mip)
+  task_bounds = get_bounds(
+    vol, bounds, mip, mip if bounds_mip is None else bounds_mip
+  )
   if shape is None:
     sz3 = task_bounds.size3()
     shape = (int(sz3.x), int(sz3.y), 1)
@@ -677,6 +695,7 @@ def create_contrast_normalization_tasks(
   shape: Optional[Sequence[int]] = None,
   translate: Sequence[int] = (0, 0, 0),
   bounds: Optional[Bbox] = None,
+  bounds_mip: Optional[int] = None,
   fill_missing: bool = False,
   minval: int = 0,
   maxval: int = 255,
@@ -703,7 +722,9 @@ def create_contrast_normalization_tasks(
   except FileNotFoundError:
     dest = Volume.create(dest_path, info)
 
-  task_bounds = get_bounds(src, bounds, mip, mip)
+  task_bounds = get_bounds(
+    src, bounds, mip, mip if bounds_mip is None else bounds_mip
+  )
   if shape is None:
     cs = dest.meta.chunk_size(0)
     shape = (int(cs.x) * 8, int(cs.y) * 8, int(cs.z))
@@ -738,9 +759,10 @@ def create_clahe_tasks(
   dest_path: str,
   mip: int = 0,
   clip_limit: float = 40.0,
-  tile_grid_size: int = 8,
+  tile_grid_size=8,
   shape: Sequence[int] = (2048, 2048, 64),
   bounds: Optional[Bbox] = None,
+  bounds_mip: Optional[int] = None,
   fill_missing: bool = False,
   chunk_size: Optional[Sequence[int]] = None,
 ):
@@ -763,7 +785,9 @@ def create_clahe_tasks(
   except FileNotFoundError:
     dest = Volume.create(dest_path, info)
 
-  task_bounds = get_bounds(src, bounds, mip, mip)
+  task_bounds = get_bounds(
+    src, bounds, mip, mip if bounds_mip is None else bounds_mip
+  )
   shape = Vec(*shape)
 
   def make_task(shape_: Vec, offset: Vec):
@@ -848,6 +872,12 @@ def create_reordering_tasks(
   mapping: dict,
   mip: int = 0,
   z_per_task: int = 16,
+  fill_missing: bool = False,
+  encoding: Optional[str] = None,
+  encoding_level: Optional[int] = None,
+  compress="gzip",
+  delete_black_uploads: bool = False,
+  background_color: int = 0,
 ):
   """Z-slice shuffle into a fresh layer (reference :1193)."""
   from ..tasks.stats import ReorderTask
@@ -858,7 +888,7 @@ def create_reordering_tasks(
     num_channels=src.num_channels,
     layer_type=src.layer_type,
     data_type=src.meta.data_type,
-    encoding=scale["encoding"],
+    encoding=encoding or scale["encoding"],
     resolution=scale["resolution"],
     voxel_offset=scale.get("voxel_offset", [0, 0, 0]),
     volume_size=scale["size"],
@@ -867,7 +897,10 @@ def create_reordering_tasks(
   try:
     Volume(dest_path)
   except FileNotFoundError:
-    Volume.create(dest_path, info)
+    dest = Volume.create(dest_path, info)
+    if encoding_level is not None:
+      dest.meta.set_encoding(0, None, encoding_level)
+      dest.commit_info()
 
   z0 = int(src.bounds.minpt.z)
   z1 = int(src.bounds.maxpt.z)
@@ -879,6 +912,10 @@ def create_reordering_tasks(
       z_start=zs,
       z_end=min(zs + z_per_task, z1),
       mapping=mapping,
+      fill_missing=fill_missing,
+      compress=compress,
+      delete_black_uploads=delete_black_uploads,
+      background_color=background_color,
     )
 
 
@@ -923,29 +960,69 @@ def compute_rois(
   mip: Optional[int] = None,
   threshold: float = 0.0,
   dust_threshold: int = 100,
+  suppress_faint_voxels: int = 0,
+  max_axial_length: int = 512,
+  z_step: Optional[int] = None,
+  progress: bool = False,
 ) -> list:
   """Detect tissue regions-of-interest: CCL over the coarsest mip's
   foreground, returning physical-space bounding boxes
-  (reference :2032-2095 capability)."""
+  (reference :2032-2095).
+
+  ``suppress_faint_voxels`` zeroes values ≤ that level first;
+  ``max_axial_length`` downsamples in memory until XY fits that square
+  (reference :2050-2065); ``z_step`` evaluates ROIs per z-slab so thin
+  tissue at different depths yields separate boxes."""
   from scipy import ndimage as ndi
 
   vol = Volume(cloudpath)
   mip = vol.meta.num_mips - 1 if mip is None else mip
   img = vol.download(vol.meta.bounds(mip), mip=mip)[..., 0]
-  fg = img > threshold
-  labeled, n = ndi.label(fg)
-  rois = []
   res = np.asarray(vol.meta.resolution(mip), dtype=np.int64)
   offset = np.asarray(vol.meta.voxel_offset(mip), dtype=np.int64)
-  for sl in ndi.find_objects(labeled):
-    if sl is None:
-      continue
-    size = np.prod([s.stop - s.start for s in sl])
-    if size < dust_threshold:
-      continue
-    mn = (np.asarray([s.start for s in sl]) + offset) * res
-    mx = (np.asarray([s.stop for s in sl]) + offset) * res
-    rois.append(Bbox(mn, mx))
+
+  # in-memory 2x2x1 average downsample until the XY plane fits the budget
+  # (reference :2050-2065); ROI coords scale back up through `scale_xy`
+  scale_xy = 1
+  while img.shape[0] * img.shape[1] > max_axial_length ** 2:
+    from ..ops import pooling
+
+    ds = pooling.host_downsample(
+      np.ascontiguousarray(img), (2, 2, 1), 1, method="average"
+    )
+    img = (
+      ds[0] if ds is not None
+      else pooling.downsample(img, (2, 2, 1), 1, method="average")[0]
+    )
+    scale_xy *= 2
+
+  if suppress_faint_voxels:
+    img = np.where(img <= suppress_faint_voxels, 0, img)
+  fg = img > threshold
+
+  nz = img.shape[2]
+  z_step = nz if not z_step else int(z_step)
+  rois = []
+  z_starts = range(0, nz, z_step)
+  if progress:
+    from tqdm import tqdm
+
+    z_starts = tqdm(z_starts, desc="ROI z-slabs")
+  vx_scale = np.asarray([scale_xy, scale_xy, 1], dtype=np.int64)
+  for z0 in z_starts:
+    slab = fg[:, :, z0:z0 + z_step]
+    labeled, _ = ndi.label(slab)
+    for sl in ndi.find_objects(labeled):
+      if sl is None:
+        continue
+      size = np.prod([s.stop - s.start for s in sl])
+      if size < dust_threshold:
+        continue
+      mn = np.asarray([s.start for s in sl]) + [0, 0, z0]
+      mx = np.asarray([s.stop for s in sl]) + [0, 0, z0]
+      mn = (mn * vx_scale + offset) * res
+      mx = (mx * vx_scale + offset) * res
+      rois.append(Bbox(mn, mx))
   return rois
 
 

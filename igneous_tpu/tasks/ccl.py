@@ -67,18 +67,22 @@ def _download_and_ccl(
   fill_missing: bool,
   threshold_gte: Optional[float],
   threshold_lte: Optional[float],
+  dust_threshold: int = 0,
 ) -> Tuple[np.ndarray, Bbox, Bbox]:
   """The deterministic shared pass: cutout+1 → threshold → rails blackout
-  → device CCL → +offset. Returns (labels_u64, cutout_bbox, core_bbox)."""
+  → dust → device CCL → +offset. Returns (labels_u64, cutout_bbox,
+  core_bbox)."""
   img, cutout, core = _prep_ccl_image(
-    src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte
+    src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte,
+    dust_threshold,
   )
   cc = connected_components(img)
   return _offset_components(cc, task_num, shape), cutout, core
 
 
 def _prep_ccl_image(
-  src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte
+  src_path, mip, shape, offset, fill_missing, threshold_gte, threshold_lte,
+  dust_threshold: int = 0,
 ) -> Tuple[np.ndarray, Bbox, Bbox]:
   """Download + threshold + rails blackout (everything before the CCL
   kernel) — the batched driver runs this per task and dispatches the CCL
@@ -101,6 +105,12 @@ def _prep_ccl_image(
       ext[tuple(sl)] = 1
       ext_counts += ext
   img[ext_counts >= 2] = 0
+  if dust_threshold:
+    # dust BEFORE the CCL so every pass recomputes identical labels
+    # (reference ccl.py:167-171)
+    from ..ops.ccl import dust
+
+    img = dust(img, dust_threshold, connectivity=6, in_place=True)
   return img, cutout, core
 
 
@@ -135,6 +145,7 @@ class CCLFacesTask(RegisteredTask):
     fill_missing: bool = False,
     threshold_gte: Optional[float] = None,
     threshold_lte: Optional[float] = None,
+    dust_threshold: int = 0,
   ):
     self.src_path = src_path
     self.mip = int(mip)
@@ -144,11 +155,13 @@ class CCLFacesTask(RegisteredTask):
     self.fill_missing = fill_missing
     self.threshold_gte = threshold_gte
     self.threshold_lte = threshold_lte
+    self.dust_threshold = int(dust_threshold)
 
   def execute(self):
     cc, cutout, core = _download_and_ccl(
       self.src_path, self.mip, self.shape, self.offset, self.task_num,
       self.fill_missing, self.threshold_gte, self.threshold_lte,
+      self.dust_threshold,
     )
     store_ccl_faces(
       cc, cutout, core, self.task_num, CloudFiles(self.src_path),
@@ -171,6 +184,7 @@ class CCLEquivalancesTask(RegisteredTask):
     fill_missing: bool = False,
     threshold_gte: Optional[float] = None,
     threshold_lte: Optional[float] = None,
+    dust_threshold: int = 0,
   ):
     self.src_path = src_path
     self.mip = int(mip)
@@ -181,11 +195,13 @@ class CCLEquivalancesTask(RegisteredTask):
     self.fill_missing = fill_missing
     self.threshold_gte = threshold_gte
     self.threshold_lte = threshold_lte
+    self.dust_threshold = int(dust_threshold)
 
   def execute(self):
     cc, cutout, core = _download_and_ccl(
       self.src_path, self.mip, self.shape, self.offset, self.task_num,
       self.fill_missing, self.threshold_gte, self.threshold_lte,
+      self.dust_threshold,
     )
     cf = CloudFiles(self.src_path)
     scratch = ccl_scratch_path(self.src_path, self.mip)
@@ -226,10 +242,13 @@ class CCLEquivalancesTask(RegisteredTask):
     )
 
 
-def create_relabeling(src_path: str, mip: int = 0) -> int:
+def create_relabeling(src_path: str, mip: int = 0, shape=None) -> int:
   """Pass 3 (single machine, reference ccl.py:358-420): global union-find
   over all equivalence files → per-task relabel maps + max_label.json.
-  Returns the final component count."""
+  Returns the final component count. ``shape`` is accepted for signature
+  parity with the reference; the equivalence listing already determines
+  the grid here."""
+  del shape
   cf = CloudFiles(src_path)
   scratch = ccl_scratch_path(src_path, mip)
   ds = DisjointSet()
@@ -268,6 +287,7 @@ class RelabelCCLTask(RegisteredTask):
     fill_missing: bool = False,
     threshold_gte: Optional[float] = None,
     threshold_lte: Optional[float] = None,
+    dust_threshold: int = 0,
   ):
     self.src_path = src_path
     self.dest_path = dest_path
@@ -278,11 +298,13 @@ class RelabelCCLTask(RegisteredTask):
     self.fill_missing = fill_missing
     self.threshold_gte = threshold_gte
     self.threshold_lte = threshold_lte
+    self.dust_threshold = int(dust_threshold)
 
   def execute(self):
     cc, cutout, core = _download_and_ccl(
       self.src_path, self.mip, self.shape, self.offset, self.task_num,
       self.fill_missing, self.threshold_gte, self.threshold_lte,
+      self.dust_threshold,
     )
     cf = CloudFiles(self.src_path)
     scratch = ccl_scratch_path(self.src_path, self.mip)

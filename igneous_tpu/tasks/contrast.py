@@ -198,7 +198,7 @@ class CLAHETask(RegisteredTask):
     offset: Sequence[int],
     mip: int = 0,
     clip_limit: float = 40.0,
-    tile_grid_size: int = 8,
+    tile_grid_size=8,
     fill_missing: bool = False,
   ):
     self.src_path = src_path
@@ -207,7 +207,11 @@ class CLAHETask(RegisteredTask):
     self.offset = Vec(*offset)
     self.mip = int(mip)
     self.clip_limit = float(clip_limit)
-    self.tile_grid_size = int(tile_grid_size)
+    # int or (gx, gy) pair (reference --tile-grid-size is a Tuple2)
+    if isinstance(tile_grid_size, (list, tuple)):
+      self.tile_grid_size = [int(v) for v in tile_grid_size]
+    else:
+      self.tile_grid_size = [int(tile_grid_size)] * 2
     self.fill_missing = fill_missing
 
   def execute(self):
@@ -224,7 +228,7 @@ class CLAHETask(RegisteredTask):
       return
     # overlap-pad x/y by one CLAHE tile so tile boundaries don't show at
     # task seams (reference :192-197)
-    tile = np.asarray(core.size3()[:2]) // self.tile_grid_size
+    tile = np.asarray(core.size3()[:2]) // np.asarray(self.tile_grid_size)
     pad = Vec(int(tile[0]), int(tile[1]), 0)
     cutout = Bbox.intersection(
       Bbox(core.minpt - pad, core.maxpt + pad), src.bounds
@@ -233,7 +237,7 @@ class CLAHETask(RegisteredTask):
 
     clahe = cv2.createCLAHE(
       clipLimit=self.clip_limit,
-      tileGridSize=(self.tile_grid_size, self.tile_grid_size),
+      tileGridSize=tuple(self.tile_grid_size),
     )
     out = np.empty_like(img)
     for dz in range(img.shape[2]):

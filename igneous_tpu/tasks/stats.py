@@ -61,10 +61,15 @@ class CountVoxelsTask(RegisteredTask):
     )
 
 
-def accumulate_voxel_counts(cloudpath: str, mip: int = 0) -> Dict[int, int]:
+def accumulate_voxel_counts(
+  cloudpath: str, mip: int = 0, compress: str = "gzip",
+  additional_output: Optional[str] = None,
+) -> Dict[int, int]:
   """Single-machine reduce: sum all census JSONs → ``voxel_counts.im``
   (a FragMap of uint64 counts — the mapbuffer-format equivalent of the
-  reference's IntMap, task_creation/image.py:1975-2030). Returns totals."""
+  reference's IntMap, task_creation/image.py:1975-2030). Returns totals.
+  ``additional_output`` also writes the FragMap to a local path (the
+  reference CLI's -o, cli.py:527-540)."""
   cf = CloudFiles(cloudpath)
   totals: Dict[int, int] = defaultdict(int)
   for key in cf.list(f"{VOXEL_COUNT_DIR}/{mip}/"):
@@ -76,7 +81,11 @@ def accumulate_voxel_counts(cloudpath: str, mip: int = 0) -> Dict[int, int]:
   payload = {
     label: struct.pack("<Q", count) for label, count in totals.items()
   }
-  cf.put(f"{VOXEL_COUNT_DIR}/{mip}/voxel_counts.im", FragMap.tobytes(payload))
+  blob = FragMap.tobytes(payload)
+  cf.put(f"{VOXEL_COUNT_DIR}/{mip}/voxel_counts.im", blob, compress=compress)
+  if additional_output:
+    with open(additional_output, "wb") as f:
+      f.write(blob)
   return dict(totals)
 
 
@@ -84,6 +93,32 @@ def load_voxel_counts(cloudpath: str, mip: int = 0) -> Optional[FragMap]:
   cf = CloudFiles(cloudpath)
   data = cf.get(f"{VOXEL_COUNT_DIR}/{mip}/voxel_counts.im")
   return None if data is None else FragMap.frombytes(data)
+
+
+def globally_small_labels(
+  cloudpath: str, mip: int, labels, threshold: float,
+) -> list:
+  """Labels whose GLOBAL voxel count (from the voxel_counts.im census)
+  falls below ``threshold`` — the dust_global primitive shared by
+  SkeletonTask and MeshTask (reference tasks/skeleton.py:722-755 and
+  tasks/mesh/mesh.py:313-355). Raises if the census has not been built."""
+  counts = load_voxel_counts(cloudpath, mip)
+  if counts is None:
+    raise ValueError(
+      "dust_global requires the voxel-count census: run "
+      "`igneous-tpu image voxels count` then `... voxels sum` (or "
+      "tasks.stats.accumulate_voxel_counts) on this layer first."
+    )
+  small = []
+  for label in labels:
+    label = int(label)
+    if label == 0:
+      continue
+    blob = counts.get(label)
+    total = struct.unpack("<Q", blob)[0] if blob else 0
+    if total < threshold:
+      small.append(label)
+  return small
 
 
 class SpatialIndexTask(RegisteredTask):
@@ -148,6 +183,9 @@ class ReorderTask(RegisteredTask):
     z_end: int,
     mapping: Dict,
     fill_missing: bool = False,
+    compress="gzip",
+    delete_black_uploads: bool = False,
+    background_color: int = 0,
   ):
     self.src_path = src_path
     self.dest_path = dest_path
@@ -156,10 +194,17 @@ class ReorderTask(RegisteredTask):
     self.z_end = int(z_end)
     self.mapping = {int(k): int(v) for k, v in mapping.items()}
     self.fill_missing = fill_missing
+    self.compress = compress
+    self.delete_black_uploads = bool(delete_black_uploads)
+    self.background_color = int(background_color)
 
   def execute(self):
     src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
-    dest = Volume(self.dest_path, mip=self.mip)
+    dest = Volume(
+      self.dest_path, mip=self.mip,
+      delete_black_uploads=self.delete_black_uploads,
+      background_color=self.background_color,
+    )
     bounds = src.bounds
     for z in range(self.z_start, self.z_end):
       src_z = self.mapping.get(z, z)
@@ -171,4 +216,4 @@ class ReorderTask(RegisteredTask):
         (bounds.minpt.x, bounds.minpt.y, z),
         (bounds.maxpt.x, bounds.maxpt.y, z + 1),
       )
-      dest.upload(dl, src.download(sl))
+      dest.upload(dl, src.download(sl), compress=self.compress)

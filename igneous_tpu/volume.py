@@ -115,7 +115,9 @@ class Volume:
     chunk_size: Sequence[int] = (64, 64, 64),
     layer_type: Optional[str] = None,
     encoding: str = "raw",
+    encoding_level: Optional[int] = None,
     max_mip: int = 0,
+    compress="gzip",
   ) -> "Volume":
     if arr.ndim == 3:
       arr = arr[..., np.newaxis]
@@ -139,7 +141,11 @@ class Volume:
         "max_mip: build mips with create_downsampling_tasks after ingest"
       )
     vol = cls.create(cloudpath, info)
-    vol[vol.meta.bounds(0)] = arr
+    if encoding_level is not None:
+      # must precede the upload: the quality knob lives in the scale
+      vol.meta.set_encoding(0, None, encoding_level)
+      vol.commit_info()
+    vol.upload(vol.meta.bounds(0), arr, mip=0, compress=compress)
     return vol
 
   # -- properties -----------------------------------------------------------
