@@ -244,6 +244,7 @@ def process_mesh(
   chunk_size: Optional[Sequence[float]] = None,
   encoding: str = "draco",
   quantization_bits: int = 16,
+  min_chunk_size: Optional[Sequence[float]] = None,
 ) -> Tuple[bytes, bytes]:
   """One label's mesh → (manifest bytes, concatenated fragment bytes).
 
@@ -260,6 +261,14 @@ def process_mesh(
     raise ValueError("empty mesh")
   mn = mesh.vertices.min(axis=0)
   mx = mesh.vertices.max(axis=0)
+  if min_chunk_size is not None:
+    # cap the LOD count so the finest fragment cell is at least
+    # min_chunk_size (same units as the vertices) — reference
+    # multires.py:102-104 derives max_lod from mesh_shape/min_chunk_size
+    ext = np.maximum(np.asarray(mx - mn, dtype=np.float64), 1e-9)
+    ratio = ext / np.maximum(np.asarray(min_chunk_size, np.float64), 1e-9)
+    cap = 1 + max(int(np.floor(np.min(np.log2(np.maximum(ratio, 1.0))))), 0)
+    num_lods = max(1, min(num_lods, cap))
   if chunk_size is None:
     # one chunk at the coarsest lod
     chunk_size = (mx - mn) / (2 ** (num_lods - 1)) + 1e-3

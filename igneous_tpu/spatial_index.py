@@ -54,10 +54,13 @@ class SpatialIndex:
           out.add(int(label))
     return out
 
-  def to_sqlite(self, db_path: str) -> int:
+  def to_sqlite(
+    self, db_path: str, progress: bool = False, allow_missing: bool = False,
+  ) -> int:
     """Materialize the index into a sqlite db for fast repeated queries
     (reference `igneous mesh spatial-index db`, cli.py capability).
-    Returns the number of (label, cell) rows."""
+    Returns the number of (label, cell) rows. ``allow_missing`` tolerates
+    unreadable/absent index cells instead of failing the export."""
     import sqlite3
 
     conn = sqlite3.connect(db_path)
@@ -71,9 +74,20 @@ class SpatialIndex:
       " maxx REAL, maxy REAL, maxz REAL)"
     )
     n = 0
-    for key in self.index_files():
+    keys = self.index_files()
+    if progress:
+      from tqdm import tqdm
+
+      keys = tqdm(keys, desc="spatial index cells")
+    for key in keys:
       doc = self.cf.get_json(key)
       if not doc:
+        if doc is None and not allow_missing:
+          conn.close()
+          raise FileNotFoundError(
+            f"unreadable spatial index cell {key!r} "
+            "(pass allow_missing=True to skip)"
+          )
         continue
       rows = [
         (str(int(label)), key, *map(float, mn), *map(float, mx))

@@ -34,6 +34,7 @@ def create_meshing_tasks(
   max_simplification_error: int = 40,
   mesh_dir: Optional[str] = None,
   dust_threshold: Optional[int] = None,
+  dust_global: bool = False,
   object_ids: Optional[Sequence[int]] = None,
   exclude_object_ids: Optional[Sequence[int]] = None,
   remap_table: Optional[dict] = None,
@@ -46,6 +47,7 @@ def create_meshing_tasks(
   fill_holes: int = 0,
   mesher: str = "cubes",
   parallel: int = 1,
+  compress: str = "gzip",
 ):
   """Stage-1 mesh forge grid; creates the mesh info
   (reference task_creation/mesh.py:158-267)."""
@@ -84,6 +86,7 @@ def create_meshing_tasks(
       max_simplification_error=max_simplification_error,
       mesh_dir=mesh_dir,
       dust_threshold=dust_threshold,
+      dust_global=dust_global,
       object_ids=list(object_ids) if object_ids else None,
       exclude_object_ids=(
         list(exclude_object_ids) if exclude_object_ids else None
@@ -97,6 +100,7 @@ def create_meshing_tasks(
       fill_holes=fill_holes,
       mesher=mesher,
       parallel=parallel,
+      compress=compress,
     )
 
   def finish():
@@ -138,15 +142,20 @@ def configure_multires_info(
   (reference task_creation/mesh.py:437-479)."""
   from ..mesh_multires import multires_info
 
-  vol = Volume(cloudpath)
+  from ..storage import CloudFiles
+
   info = multires_info(
     vertex_quantization_bits=vertex_quantization_bits,
     sharding=sharding,
     mip=mip,
   )
-  vol.cf.put_json(f"{mesh_dir}/info", info)
-  vol.info["mesh"] = mesh_dir
-  vol.commit_info()
+  CloudFiles(cloudpath).put_json(f"{mesh_dir}/info", info)
+  try:
+    vol = Volume(cloudpath)
+    vol.info["mesh"] = mesh_dir
+    vol.commit_info()
+  except FileNotFoundError:
+    pass  # mesh-only bucket: no volume info to update
   return info
 
 
@@ -158,6 +167,9 @@ def create_unsharded_multires_mesh_tasks(
   num_lods: int = 2,
   encoding: str = "draco",
   parallel: int = 1,
+  vertex_quantization_bits: int = 16,
+  min_chunk_size: Optional[Sequence[int]] = None,
+  draco_compression_level: int = 7,
 ) -> Iterator:
   """Legacy fragments → unsharded multires (reference :481-546)."""
   from ..tasks.mesh import mesh_dir_for
@@ -167,7 +179,9 @@ def create_unsharded_multires_mesh_tasks(
   vol = Volume(cloudpath)
   src = mesh_dir_for(vol, src_mesh_dir)  # raises if nothing is configured
   out = mesh_dir or f"{src}_multires"
-  configure_multires_info(cloudpath, out)
+  configure_multires_info(
+    cloudpath, out, vertex_quantization_bits=vertex_quantization_bits,
+  )
   for prefix in label_prefixes(magnitude):
     yield MultiResUnshardedMeshMergeTask(
       cloudpath=cloudpath,
@@ -177,23 +191,41 @@ def create_unsharded_multires_mesh_tasks(
       num_lods=num_lods,
       encoding=encoding,
       parallel=parallel,
+      min_chunk_size=min_chunk_size,
+      draco_compression_level=draco_compression_level,
     )
 
 
-def _multires_shard_spec(num_labels: int):
+def _multires_shard_spec(
+  num_labels: int,
+  shard_index_bytes: int = 2**13,
+  minishard_index_bytes: int = 2**15,
+  min_shards: int = 1,
+  max_labels_per_shard: Optional[int] = None,
+  minishard_index_encoding: str = "gzip",
+):
   from ..sharding import ShardingSpecification, compute_shard_params_for_hashed
 
+  if max_labels_per_shard and num_labels > 0:
+    # bound the average shard population (reference
+    # task_creation/mesh.py:737-741)
+    min_shards = max(
+      min_shards, int(np.ceil(num_labels / max_labels_per_shard))
+    )
   shard_bits, minishard_bits, preshift_bits = compute_shard_params_for_hashed(
-    num_labels
+    num_labels,
+    shard_index_bytes=shard_index_bytes,
+    minishard_index_bytes=minishard_index_bytes,
+    min_shards=min_shards,
   )
   return ShardingSpecification(
     preshift_bits=preshift_bits,
     hash="murmurhash3_x86_128",
     minishard_bits=minishard_bits,
     shard_bits=shard_bits,
-    # raw: fragment ranges inside the shard are read by offset; the
+    # raw data: fragment ranges inside the shard are read by offset; the
     # multires fragment-before-manifest layout requires it
-    minishard_index_encoding="gzip",
+    minishard_index_encoding=minishard_index_encoding,
     data_encoding="raw",
   )
 
@@ -204,19 +236,41 @@ def create_sharded_multires_mesh_tasks(
   num_lods: int = 2,
   encoding: str = "draco",
   parallel: int = 1,
+  vertex_quantization_bits: int = 16,
+  min_chunk_size: Optional[Sequence[int]] = None,
+  draco_compression_level: int = 7,
+  shard_index_bytes: int = 2**13,
+  minishard_index_bytes: int = 2**15,
+  minishard_index_encoding: str = "gzip",
+  min_shards: int = 1,
+  max_labels_per_shard: Optional[int] = None,
+  spatial_index_db: Optional[str] = None,
 ) -> Iterator:
   """Sharded stage-1 .frags → sharded multires: census labels via the
-  spatial index, solve shard bits, write the info, one task per shard
-  (reference :706-813)."""
+  spatial index (or a pre-materialized sqlite db), solve shard bits,
+  write the info, one task per shard (reference :706-813)."""
   from ..spatial_index import SpatialIndex
   from ..tasks.mesh import mesh_dir_for
   from ..tasks.mesh_multires import MultiResShardedMeshMergeTask
 
   vol = Volume(cloudpath)
   mdir = mesh_dir_for(vol, mesh_dir)
-  labels = SpatialIndex(vol.cf, mdir).query()
-  spec = _multires_shard_spec(len(labels))
-  configure_multires_info(cloudpath, mdir, sharding=spec.to_dict())
+  if spatial_index_db:
+    labels = SpatialIndex.query_sqlite(spatial_index_db)
+  else:
+    labels = SpatialIndex(vol.cf, mdir).query()
+  spec = _multires_shard_spec(
+    len(labels),
+    shard_index_bytes=shard_index_bytes,
+    minishard_index_bytes=minishard_index_bytes,
+    min_shards=min_shards,
+    max_labels_per_shard=max_labels_per_shard,
+    minishard_index_encoding=minishard_index_encoding,
+  )
+  configure_multires_info(
+    cloudpath, mdir, sharding=spec.to_dict(),
+    vertex_quantization_bits=vertex_quantization_bits,
+  )
 
   for shard_no in range(2**spec.shard_bits):
     yield MultiResShardedMeshMergeTask(
@@ -226,18 +280,25 @@ def create_sharded_multires_mesh_tasks(
       num_lods=num_lods,
       encoding=encoding,
       parallel=parallel,
+      min_chunk_size=min_chunk_size,
+      draco_compression_level=draco_compression_level,
     )
 
 
 def create_sharded_multires_mesh_from_unsharded_tasks(
   cloudpath: str,
+  dest_cloudpath: Optional[str] = None,
   src_mesh_dir: Optional[str] = None,
   mesh_dir: Optional[str] = None,
   num_lods: int = 2,
   encoding: str = "draco",
   parallel: int = 1,
+  vertex_quantization_bits: int = 16,
+  min_chunk_size: Optional[Sequence[int]] = None,
 ) -> Iterator:
-  """Legacy unsharded meshes → sharded multires (reference :590-704)."""
+  """Legacy unsharded meshes → sharded multires (reference :590-704).
+  ``dest_cloudpath`` writes the converted meshes into a different volume
+  (the `mesh xfer --sharded` path, reference cli.py:1001-1007)."""
   from ..tasks.mesh import mesh_dir_for
   from ..tasks.mesh_multires import (
     MultiResShardedFromUnshardedMeshMergeTask,
@@ -249,7 +310,10 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
   out = mesh_dir or f"{src}_multires"
   labels = legacy_manifest_labels(vol.cf, src)
   spec = _multires_shard_spec(len(labels))
-  configure_multires_info(cloudpath, out, sharding=spec.to_dict())
+  configure_multires_info(
+    dest_cloudpath or cloudpath, out, sharding=spec.to_dict(),
+    vertex_quantization_bits=vertex_quantization_bits,
+  )
 
   for shard_no in range(2**spec.shard_bits):
     yield MultiResShardedFromUnshardedMeshMergeTask(
@@ -260,6 +324,8 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
       num_lods=num_lods,
       encoding=encoding,
       parallel=parallel,
+      min_chunk_size=min_chunk_size,
+      dest_cloudpath=dest_cloudpath,
     )
 
 

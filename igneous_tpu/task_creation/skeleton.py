@@ -41,22 +41,28 @@ def create_skeletonizing_tasks(
   skel_dir: Optional[str] = None,
   spatial_index: bool = True,
   fix_borders: bool = True,
-  fill_holes: bool = False,
+  fill_holes: int = 0,
   fix_branching: bool = True,
   fix_avocados: bool = False,
+  fix_autapses: bool = False,
   cross_sectional_area: bool = False,
   csa_smoothing_window: int = 1,
+  csa_repair_sec_per_label: int = -1,
   low_memory_csa: bool = False,
   synapses: Optional[dict] = None,
   parallel: int = 1,
   bounds: Optional[Bbox] = None,
   timestamp: Optional[float] = None,
+  frag_path: Optional[str] = None,
+  root_ids_cloudpath: Optional[str] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
   vertex_attributes (reference :68-388)."""
   vol = Volume(cloudpath, mip=mip)
   if vol.layer_type != "segmentation":
     raise ValueError("Skeletonization requires a segmentation layer")
+  if fix_autapses and vol.graphene is None:
+    raise ValueError("fix_autapses requires a graphene:// volume")
 
   if skel_dir is None:
     skel_dir = vol.info.get("skeletons") or f"skeletons_mip_{mip}"
@@ -159,12 +165,16 @@ def create_skeletonizing_tasks(
       fill_holes=fill_holes,
       fix_branching=fix_branching,
       fix_avocados=fix_avocados,
+      fix_autapses=fix_autapses,
       cross_sectional_area=cross_sectional_area,
       csa_smoothing_window=csa_smoothing_window,
+      csa_repair_sec_per_label=csa_repair_sec_per_label,
       low_memory_csa=low_memory_csa,
       extra_targets=task_targets(offset, shape_),
       parallel=parallel,
       timestamp=timestamp,
+      frag_path=frag_path,
+      root_ids_cloudpath=root_ids_cloudpath,
     )
 
   def finish():
@@ -216,6 +226,10 @@ def create_sharded_skeleton_merge_tasks(
   minishard_index_bytes: int = 40000,
   min_shards: int = 1,
   max_cable_length: Optional[float] = None,
+  max_labels_per_shard: Optional[int] = None,
+  minishard_index_encoding: str = "gzip",
+  data_encoding: str = "gzip",
+  spatial_index_db: Optional[str] = None,
 ) -> Iterator:
   """Stage-2 sharded merge: census labels via the spatial index, solve
   shard parameters, attach the sharding spec to the skeleton info, and
@@ -225,8 +239,16 @@ def create_sharded_skeleton_merge_tasks(
 
   vol = Volume(cloudpath)
   sdir = skel_dir_for(vol, skel_dir)
-  si = SpatialIndex(vol.cf, sdir)
-  labels = si.query()
+  if spatial_index_db:
+    labels = SpatialIndex.query_sqlite(spatial_index_db)
+  else:
+    labels = SpatialIndex(vol.cf, sdir).query()
+  if max_labels_per_shard and len(labels) > 0:
+    # bound the average shard population (reference
+    # task_creation/skeleton.py:472-476)
+    min_shards = max(
+      min_shards, int(np.ceil(len(labels) / max_labels_per_shard))
+    )
   shard_bits, minishard_bits, preshift_bits = compute_shard_params_for_hashed(
     num_labels=len(labels),
     shard_index_bytes=shard_index_bytes,
@@ -238,6 +260,8 @@ def create_sharded_skeleton_merge_tasks(
     hash="murmurhash3_x86_128",
     minishard_bits=minishard_bits,
     shard_bits=shard_bits,
+    minishard_index_encoding=minishard_index_encoding,
+    data_encoding=data_encoding,
   )
   skel_info = vol.cf.get_json(f"{sdir}/info") or {}
   skel_info["sharding"] = spec.to_dict()
@@ -256,11 +280,13 @@ def create_sharded_skeleton_merge_tasks(
 
 def create_sharded_from_unsharded_skeleton_merge_tasks(
   cloudpath: str,
+  dest_cloudpath: Optional[str] = None,
   src_skel_dir: Optional[str] = None,
   skel_dir: Optional[str] = None,
 ) -> Iterator:
   """Re-pack finished unsharded skeletons into shard files
-  (reference :659-754)."""
+  (reference :659-754). ``dest_cloudpath`` writes them into a different
+  volume (`skeleton xfer --sharded`)."""
   from ..sharding import ShardingSpecification, compute_shard_params_for_hashed
   from ..skeleton_io import DEFAULT_ATTRIBUTES as _ATTRS
   from ..tasks.skeleton import ShardedFromUnshardedSkeletonMergeTask
@@ -288,9 +314,20 @@ def create_sharded_from_unsharded_skeleton_merge_tasks(
     "vertex_attributes": _ATTRS,
   }
   src_info["sharding"] = spec.to_dict()
-  vol.cf.put_json(f"{out}/info", src_info)
-  vol.info["skeletons"] = out
-  vol.commit_info()
+  if dest_cloudpath:
+    from ..storage import CloudFiles as _CF
+
+    _CF(dest_cloudpath).put_json(f"{out}/info", src_info)
+    try:
+      dest = Volume(dest_cloudpath)
+      dest.info["skeletons"] = out
+      dest.commit_info()
+    except FileNotFoundError:
+      pass  # skeleton-only bucket
+  else:
+    vol.cf.put_json(f"{out}/info", src_info)
+    vol.info["skeletons"] = out
+    vol.commit_info()
 
   for shard_no in range(2**shard_bits):
     yield ShardedFromUnshardedSkeletonMergeTask(
@@ -298,6 +335,7 @@ def create_sharded_from_unsharded_skeleton_merge_tasks(
       shard_no=shard_no,
       src_skel_dir=src,
       skel_dir=out,
+      dest_cloudpath=dest_cloudpath,
     )
 
 

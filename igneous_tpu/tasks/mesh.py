@@ -53,6 +53,7 @@ class MeshTask(RegisteredTask):
     max_simplification_error: int = 40,
     mesh_dir: Optional[str] = None,
     dust_threshold: Optional[int] = None,
+    dust_global: bool = False,
     object_ids: Optional[Sequence[int]] = None,
     exclude_object_ids: Optional[Sequence[int]] = None,
     remap_table: Optional[dict] = None,
@@ -65,6 +66,7 @@ class MeshTask(RegisteredTask):
     timestamp: Optional[float] = None,
     mesher: str = "cubes",
     parallel: int = 1,
+    compress: str = "gzip",
   ):
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
@@ -74,6 +76,7 @@ class MeshTask(RegisteredTask):
     self.max_simplification_error = max_simplification_error
     self.mesh_dir = mesh_dir
     self.dust_threshold = dust_threshold
+    self.dust_global = bool(dust_global)
     self.object_ids = list(object_ids) if object_ids else None
     self.exclude_object_ids = (
       list(exclude_object_ids) if exclude_object_ids else None
@@ -95,6 +98,7 @@ class MeshTask(RegisteredTask):
     if mesher not in ("cubes", "tetrahedra"):
       raise ValueError(f"mesher must be 'cubes' or 'tetrahedra': {mesher!r}")
     self.mesher = mesher
+    self.compress = compress or None
     # label-level threading for the simplification stage, mirroring
     # SkeletonTask's parallel= (the native QEM collapse is a ctypes call
     # that releases the GIL; results are per-label independent and
@@ -161,7 +165,16 @@ class MeshTask(RegisteredTask):
 
     labels, counts = np.unique(img, return_counts=True)
     sel = labels != 0
-    if self.dust_threshold:
+    if self.dust_threshold and self.dust_global:
+      # dust by GLOBAL voxel counts so objects straddling task borders
+      # are not wrongly dusted (reference mesh.py:313-355 dust_global)
+      from .stats import globally_small_labels
+
+      small = set(globally_small_labels(
+        self.layer_path, self.mip, labels[sel], self.dust_threshold,
+      ))
+      sel &= np.asarray([int(l) not in small for l in labels])
+    elif self.dust_threshold:
       sel &= counts >= self.dust_threshold
     labels = labels[sel]
     if len(labels) == 0:
@@ -253,7 +266,7 @@ class MeshTask(RegisteredTask):
         cf.put(
           f"{mdir}/{label}:0:{core.to_filename()}",
           encode_mesh(m, self.encoding),
-          compress="gzip",
+          compress=self.compress,
         )
 
     if self.spatial_index and label_bounds is not None:

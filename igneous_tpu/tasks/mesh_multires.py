@@ -29,23 +29,39 @@ from ..spatial_index import SpatialIndex
 from .mesh import mesh_dir_for
 
 
-def legacy_manifest_labels(cf, src_dir: str, prefix: str = "") -> list:
-  """Labels present as legacy ``<label>:0`` manifests under ``src_dir``."""
-  labels = set()
+def legacy_label_fragments(cf, src_dir: str, prefix: str = "") -> dict:
+  """{label: [fragment filenames]} under ``src_dir`` discovered from the
+  ``<label>:0:<bbox>`` fragment files themselves (the reference's
+  get_mesh_filenames_subset, multires.py:367-383 — no manifest pass is
+  required between forge and a multires merge) plus any legacy
+  ``<label>:0`` manifests."""
+  out = {}
   for key in cf.list(f"{src_dir}/{prefix}"):
-    parts = key.split("/")[-1].split(":")
-    if len(parts) == 2 and parts[1] == "0":
-      labels.add(int(parts[0]))
-  return sorted(labels)
+    name = key.split("/")[-1]
+    parts = name.split(":")
+    if len(parts) == 3 and parts[1] == "0":
+      out.setdefault(int(parts[0]), set()).add(name)
+    elif len(parts) == 2 and parts[1] == "0":
+      out.setdefault(int(parts[0]), set())
+  return {label: sorted(names) for label, names in out.items()}
 
 
-def _fetch_legacy_label_mesh(cf, src_dir: str, label: int) -> Optional[Mesh]:
-  """Assemble one label's mesh from legacy manifest + fragment files."""
+def legacy_manifest_labels(cf, src_dir: str, prefix: str = "") -> list:
+  """Labels present as legacy manifests OR raw fragment files."""
+  return sorted(legacy_label_fragments(cf, src_dir, prefix).keys())
+
+
+def _fetch_legacy_label_mesh(
+  cf, src_dir: str, label: int, fragments=None,
+) -> Optional[Mesh]:
+  """Assemble one label's mesh from its fragment files (listed directly
+  and/or via a legacy ``<label>:0`` manifest)."""
+  names = set(fragments or [])
   manifest = cf.get_json(f"{src_dir}/{label}:0")
-  if manifest is None:
-    return None
+  if manifest is not None:
+    names.update(manifest.get("fragments", []))
   pieces = []
-  for frag in manifest.get("fragments", []):
+  for frag in sorted(names):
     data = cf.get(f"{src_dir}/{frag}")
     if data is not None:
       pieces.append(Mesh.from_precomputed(data))
@@ -68,6 +84,20 @@ def _map_labels(fn, labels, parallel: int):
   return [fn(l) for l in labels]
 
 
+def _multires_process_kw(vol, info, min_chunk_size):
+  """Per-label process_mesh kwargs derived from the multires info:
+  quantization bits from the info file; min_chunk_size (voxels) scaled to
+  physical units by the info's mip resolution (reference multires.py
+  divides vertices by resolution instead; same cap either way)."""
+  kw = {"quantization_bits": int(info.get("vertex_quantization_bits", 16))}
+  if min_chunk_size is not None:
+    import numpy as _np
+
+    res = _np.asarray(vol.meta.resolution(int(info.get("mip", 0))))
+    kw["min_chunk_size"] = (_np.asarray(min_chunk_size) * res).tolist()
+  return kw
+
+
 class MultiResUnshardedMeshMergeTask(RegisteredTask):
   """Legacy fragments → unsharded multires: per label ``<label>.index``
   manifest + ``<label>`` fragment file (reference :44-81)."""
@@ -81,6 +111,8 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     num_lods: int = 2,
     encoding: str = "draco",
     parallel: int = 1,
+    min_chunk_size=None,
+    draco_compression_level: int = 7,
   ):
     self.cloudpath = cloudpath
     self.prefix = str(prefix)
@@ -89,30 +121,40 @@ class MultiResUnshardedMeshMergeTask(RegisteredTask):
     self.num_lods = int(num_lods)
     self.encoding = encoding
     self.parallel = int(parallel)
+    self.min_chunk_size = (
+      [int(v) for v in min_chunk_size] if min_chunk_size else None
+    )
+    # interface parity: this build's draco encoder is fixed
+    # sequential-method, so the level knob is recorded but inert
+    self.draco_compression_level = int(draco_compression_level)
 
   def execute(self):
     vol = Volume(self.cloudpath)
     src_dir = self.src_mesh_dir or mesh_dir_for(vol, None)
     out_dir = self.mesh_dir or f"{src_dir}_multires"
     cf = CloudFiles(vol.cloudpath)
+    info = cf.get_json(f"{out_dir}/info") or {}
+    pkw = _multires_process_kw(vol, info, self.min_chunk_size)
+
+    per_label = legacy_label_fragments(cf, src_dir, self.prefix)
 
     def one(label):
       # writes happen inside the worker: per-label outputs are
       # independent files, so streaming keeps peak memory at
       # O(parallel labels) instead of O(all labels)
-      mesh = _fetch_legacy_label_mesh(cf, src_dir, label)
+      mesh = _fetch_legacy_label_mesh(
+        cf, src_dir, label, fragments=per_label.get(label)
+      )
       if mesh is None or len(mesh.faces) == 0:
         return None
       manifest, frags = process_mesh(
-        mesh, num_lods=self.num_lods, encoding=self.encoding
+        mesh, num_lods=self.num_lods, encoding=self.encoding, **pkw
       )
       cf.put(f"{out_dir}/{label}.index", manifest)
       cf.put(f"{out_dir}/{label}", frags)
       return None
 
-    _map_labels(
-      one, legacy_manifest_labels(cf, src_dir, self.prefix), self.parallel
-    )
+    _map_labels(one, sorted(per_label.keys()), self.parallel)
 
 
 class MultiResShardedMeshMergeTask(RegisteredTask):
@@ -129,6 +171,8 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
     num_lods: int = 2,
     encoding: str = "draco",
     parallel: int = 1,
+    min_chunk_size=None,
+    draco_compression_level: int = 7,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
@@ -136,6 +180,10 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
     self.num_lods = int(num_lods)
     self.encoding = encoding
     self.parallel = int(parallel)
+    self.min_chunk_size = (
+      [int(v) for v in min_chunk_size] if min_chunk_size else None
+    )
+    self.draco_compression_level = int(draco_compression_level)
 
   def execute(self):
     from ..sharding import ShardingSpecification
@@ -145,6 +193,7 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
     cf = CloudFiles(vol.cloudpath)
     info = cf.get_json(f"{mdir}/info") or {}
     spec = ShardingSpecification.from_dict(info["sharding"])
+    pkw = _multires_process_kw(vol, info, self.min_chunk_size)
 
     si = SpatialIndex(cf, mdir)
     locations = si.file_locations_per_label()
@@ -178,7 +227,7 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
       if len(mesh.faces) == 0:
         return None
       manifest, frags = process_mesh(
-        mesh, num_lods=self.num_lods, encoding=self.encoding
+        mesh, num_lods=self.num_lods, encoding=self.encoding, **pkw
       )
       return int(label), manifest, frags
 
@@ -198,7 +247,9 @@ class MultiResShardedMeshMergeTask(RegisteredTask):
 
 
 class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
-  """Legacy unsharded meshes → one multires shard (reference :262-306)."""
+  """Legacy unsharded meshes → one multires shard (reference :262-306).
+  ``dest_cloudpath`` writes the shard into a different volume (the
+  `mesh xfer --sharded` conversion path, reference cli.py:1001-1007)."""
 
   def __init__(
     self,
@@ -209,6 +260,9 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
     num_lods: int = 2,
     encoding: str = "draco",
     parallel: int = 1,
+    min_chunk_size=None,
+    draco_compression_level: int = 7,
+    dest_cloudpath: Optional[str] = None,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
@@ -217,28 +271,36 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
     self.num_lods = int(num_lods)
     self.encoding = encoding
     self.parallel = int(parallel)
+    self.min_chunk_size = (
+      [int(v) for v in min_chunk_size] if min_chunk_size else None
+    )
+    self.draco_compression_level = int(draco_compression_level)
+    self.dest_cloudpath = dest_cloudpath
 
   def execute(self):
     from ..sharding import ShardingSpecification
 
     vol = Volume(self.cloudpath)
     cf = CloudFiles(vol.cloudpath)
-    info = cf.get_json(f"{self.mesh_dir}/info") or {}
+    out_cf = CloudFiles(self.dest_cloudpath or self.cloudpath)
+    info = out_cf.get_json(f"{self.mesh_dir}/info") or {}
     spec = ShardingSpecification.from_dict(info["sharding"])
+    pkw = _multires_process_kw(vol, info, self.min_chunk_size)
 
-    labels = np.array(
-      legacy_manifest_labels(cf, self.src_mesh_dir), dtype=np.uint64
-    )
+    per_label = legacy_label_fragments(cf, self.src_mesh_dir)
+    labels = np.array(sorted(per_label.keys()), dtype=np.uint64)
     if len(labels) == 0:
       return
     mine = labels[spec.shard_number(labels) == self.shard_no]
 
     def one(label):
-      mesh = _fetch_legacy_label_mesh(cf, self.src_mesh_dir, label)
+      mesh = _fetch_legacy_label_mesh(
+        cf, self.src_mesh_dir, label, fragments=per_label.get(int(label))
+      )
       if mesh is None or len(mesh.faces) == 0:
         return None
       manifest, frags = process_mesh(
-        mesh, num_lods=self.num_lods, encoding=self.encoding
+        mesh, num_lods=self.num_lods, encoding=self.encoding, **pkw
       )
       return int(label), manifest, frags
 
@@ -254,4 +316,4 @@ class MultiResShardedFromUnshardedMeshMergeTask(RegisteredTask):
     if manifests:
       files = spec.synthesize_shard_files(manifests, preambles=preambles)
       for filename, data in files.items():
-        cf.put(f"{self.mesh_dir}/{filename}", data, compress=None)
+        out_cf.put(f"{self.mesh_dir}/{filename}", data, compress=None)

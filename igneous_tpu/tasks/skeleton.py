@@ -98,15 +98,19 @@ class SkeletonTask(RegisteredTask):
     skel_dir: Optional[str] = None,
     spatial_index: bool = True,
     fix_borders: bool = True,
-    fill_holes: bool = False,
+    fill_holes: int = 0,
     fix_branching: bool = True,
     fix_avocados: bool = False,
+    fix_autapses: bool = False,
     cross_sectional_area: bool = False,
     csa_smoothing_window: int = 1,
+    csa_repair_sec_per_label: int = -1,
     low_memory_csa: bool = False,
     extra_targets: Optional[Dict] = None,
     parallel: int = 1,
     timestamp: Optional[float] = None,
+    frag_path: Optional[str] = None,
+    root_ids_cloudpath: Optional[str] = None,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
@@ -122,13 +126,23 @@ class SkeletonTask(RegisteredTask):
     self.skel_dir = skel_dir
     self.spatial_index = spatial_index
     self.fix_borders = fix_borders
-    self.fill_holes = bool(fill_holes)
+    # hole-filling aggressiveness ladder (reference --fill-holes int:
+    # 0 off, 1 fill cavities, 2 +fix borders, 3 +morphological closing);
+    # bool True from older payloads means level 1
+    self.fill_holes = int(fill_holes)
     self.fix_branching = bool(fix_branching)
     self.fix_avocados = bool(fix_avocados)
+    # reference --fix-autapses (cli.py:1274): graphene-only, opt-in —
+    # constrains TEASAR to the chunk graph's connectivity
+    self.fix_autapses = bool(fix_autapses)
     self.cross_sectional_area = bool(cross_sectional_area)
     # moving-average window over slice normals (reference kimimaro
     # cross_sectional_area smoothing_window, tasks/skeleton.py:449-457)
     self.csa_smoothing_window = int(csa_smoothing_window)
+    # per-label repair time budget in seconds: -1 unlimited, 0 disables
+    # the contact-repair pass (reference --cross-section-label-repair-sec,
+    # cli.py:1290 — its default is 0/off; ours stays -1/on)
+    self.csa_repair_sec_per_label = int(csa_repair_sec_per_label)
     self.low_memory_csa = bool(low_memory_csa)
     # {label: [[x,y,z(,swc_label)] global voxel coords]} — synapse/marker
     # points that must become skeleton vertices, optionally typed for SWC
@@ -143,29 +157,20 @@ class SkeletonTask(RegisteredTask):
     }
     self.parallel = int(parallel)
     self.timestamp = timestamp
+    # write stage-1 fragments/spatial cells to a different bucket
+    # (reference --output/frag_path, tasks/skeleton.py frag_path)
+    self.frag_path = frag_path
+    # materialized root-id layer: cheaper than graphene server lookups
+    # (reference --root-ids, cli.py:1293)
+    self.root_ids_cloudpath = root_ids_cloudpath
 
   def _apply_global_dust(self, labels: np.ndarray) -> np.ndarray:
-    import struct as _struct
+    from .stats import globally_small_labels
 
-    from .stats import load_voxel_counts
-
-    counts = load_voxel_counts(self.cloudpath, self.mip)
-    if counts is None:
-      raise ValueError(
-        "dust_global requires the voxel-count census: run "
-        "`igneous-tpu image voxels count` then `... voxels sum` (or "
-        "tasks.stats.accumulate_voxel_counts) on this layer first."
-      )
-    present = fastremap.unique(labels)
-    small = []
-    for label in present:
-      label = int(label)
-      if label == 0:
-        continue
-      blob = counts.get(label)
-      total = _struct.unpack("<Q", blob)[0] if blob else 0
-      if total < self.dust_threshold:
-        small.append(label)
+    small = globally_small_labels(
+      self.cloudpath, self.mip, fastremap.unique(labels),
+      self.dust_threshold,
+    )
     if small:
       labels = fastremap.mask(labels, small)
     return labels
@@ -184,10 +189,14 @@ class SkeletonTask(RegisteredTask):
     from ..ops.cross_section import cross_sectional_area as _csa
     from ..ops.dbscan import dbscan
 
+    import time as _time
+
     anis = np.asarray(vol.resolution, dtype=np.float32)
     ctx = self.CSA_REPAIR_CONTEXT
     eps = float(2 * ctx * anis.min())  # one download per nearby group
+    budget = self.csa_repair_sec_per_label
     for label, skel in skels.items():
+      deadline = _time.monotonic() + budget if budget > 0 else None
       areas = skel.extra_attributes.get("cross_sectional_area")
       if areas is None or not len(skel.vertices):
         continue
@@ -199,6 +208,8 @@ class SkeletonTask(RegisteredTask):
         continue
       clusters = dbscan(skel.vertices[bad], eps=eps, min_samples=1)
       for c in np.unique(clusters):
+        if deadline is not None and _time.monotonic() > deadline:
+          break  # per-label budget spent; remaining flags stay negative
         members = bad[clusters == c]
         vox = np.round(
           skel.vertices[members] / anis
@@ -221,7 +232,7 @@ class SkeletonTask(RegisteredTask):
           # areas relative to unflagged neighbors
           from ..ops.morphology import fill_holes as _fill_holes
 
-          cut = _fill_holes(cut)
+          cut = _fill_holes(cut, level=self.fill_holes)
         mask = np.ascontiguousarray(cut == label)
         vmask = np.zeros(len(skel.vertices), dtype=bool)
         vmask[members] = True
@@ -260,7 +271,15 @@ class SkeletonTask(RegisteredTask):
     # +1 overlap: adjacent tasks share their boundary plane
     # (reference tasks/skeleton.py:68-69)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
-    if vol.graphene is not None:
+    if vol.graphene is not None and self.root_ids_cloudpath:
+      # a materialized root-id layer replaces per-supervoxel graphene
+      # lookups (reference tasks/skeleton.py root_ids_cloudpath use)
+      roots_vol = Volume(
+        self.root_ids_cloudpath, mip=self.mip,
+        fill_missing=self.fill_missing, bounded=False,
+      )
+      labels = roots_vol.download(cutout)[..., 0]
+    elif vol.graphene is not None:
       # proofreading volume: skeletonize the agglomerated root objects as
       # of the pinned timestamp (reference tasks/skeleton.py:159-164).
       # One raw download serves both the root mapping here and the
@@ -288,7 +307,7 @@ class SkeletonTask(RegisteredTask):
       # (reference tasks/skeleton.py:268-301)
       from ..ops.morphology import fill_holes as _fill_holes
 
-      labels = _fill_holes(labels)
+      labels = _fill_holes(labels, level=self.fill_holes)
     return labels, cutout, core, bounds, local_dust
 
   def execute(self, _prepared=None, _edt_field=None):
@@ -330,7 +349,9 @@ class SkeletonTask(RegisteredTask):
         targets[label] = merged
     targets = targets or None
     voxel_graph = None
-    if vol.graphene is not None:
+    if self.fix_autapses and vol.graphene is None:
+      raise ValueError("fix_autapses requires a graphene:// volume")
+    if self.fix_autapses and vol.graphene is not None:
       # autapse fix (reference tasks/skeleton.py:337-398): constrain
       # TEASAR moves to the chunk graph — two supervoxels that touch
       # geometrically but share no active edge (a self-contact, or a
@@ -426,10 +447,11 @@ class SkeletonTask(RegisteredTask):
             smoothing_window=self.csa_smoothing_window,
           )
           skel.extra_attributes["cross_sectional_area"] = areas
-      self._repair_csa_contacts(vol, skels, bounds)
+      if self.csa_repair_sec_per_label != 0:
+        self._repair_csa_contacts(vol, skels, bounds)
 
     sdir = skel_dir_for(vol, self.skel_dir)
-    cf = CloudFiles(vol.cloudpath)
+    cf = CloudFiles(self.frag_path or vol.cloudpath)
     res = np.asarray(vol.resolution, dtype=np.int64)
     # .frags and .spatial share the physical bbox name so merge tasks map
     # spatial-index cells to their fragment containers by rename alone
@@ -621,18 +643,22 @@ class ShardedFromUnshardedSkeletonMergeTask(RegisteredTask):
     shard_no: int,
     src_skel_dir: str,
     skel_dir: str,
+    dest_cloudpath: "str | None" = None,
   ):
     self.cloudpath = cloudpath
     self.shard_no = int(shard_no)
     self.src_skel_dir = src_skel_dir
     self.skel_dir = skel_dir
+    # write the shard into a different volume (`skeleton xfer --sharded`)
+    self.dest_cloudpath = dest_cloudpath
 
   def execute(self):
     from ..sharding import ShardingSpecification
 
     vol = Volume(self.cloudpath)
     cf = CloudFiles(vol.cloudpath)
-    skel_info = cf.get_json(f"{self.skel_dir}/info") or {}
+    out_cf = CloudFiles(self.dest_cloudpath or self.cloudpath)
+    skel_info = out_cf.get_json(f"{self.skel_dir}/info") or {}
     spec = ShardingSpecification.from_dict(skel_info["sharding"])
 
     labels = []
@@ -653,7 +679,7 @@ class ShardedFromUnshardedSkeletonMergeTask(RegisteredTask):
     if out:
       files = spec.synthesize_shard_files(out)
       for filename, data in files.items():
-        cf.put(f"{self.skel_dir}/{filename}", data, compress=None)
+        out_cf.put(f"{self.skel_dir}/{filename}", data, compress=None)
 
 
 @queueable
