@@ -1,13 +1,17 @@
 """The ``igneous-tpu`` command line interface.
 
-Command-tree parity with the reference CLI
-(/root/reference/igneous_cli/cli.py:185-214):
-  image {downsample, xfer, create, rm, ccl {faces,links,calc-labels,
-         relabel,clean,auto}}
-  mesh {forge, merge, xfer, rm}
-  skeleton {forge, merge, merge-sharded, xfer, rm}
-  execute | queue {status,release,purge,rezero} | design {ds-memory,
-  ds-shape, bounds} | license
+Command-tree AND option-level parity with the reference CLI
+(/root/reference/igneous_cli/cli.py:185-214; audited programmatically —
+every reference command and --option has a counterpart here):
+  image {downsample, xfer, create, rm, roi, reorder,
+         voxels {count,sum}, contrast {histogram,equalize,clahe},
+         ccl {faces,links,calc-labels,relabel,clean,auto}}
+  mesh {forge, merge, merge-sharded, xfer, rm, clean,
+        spatial-index {create,db}}
+  skeleton {forge, merge, merge-sharded, xfer, rm, clean, convert,
+            spatial-index {create,db}}
+  execute | queue {status,wait,release,rezero,purge,cp,mv,fsck}
+  design {ds-memory, ds-shape, bounds} | view | license
 
 Heavy imports (jax, task modules) happen inside commands so --help and
 queue tooling stay instant.
@@ -39,6 +43,57 @@ class Tuple3(click.ParamType):
 
 
 TUPLE3 = Tuple3()
+
+
+class Tuple2(click.ParamType):
+  """'0,1024' → (0, 1024) (reference cli.py:114-124)."""
+
+  name = "tuple2"
+
+  def convert(self, value, param, ctx):
+    if isinstance(value, (tuple, list)):
+      return tuple(int(v) for v in value)
+    try:
+      parts = [int(v) for v in str(value).split(",")]
+    except ValueError:
+      self.fail(f"{value!r} is not an int pair like 0,1024", param, ctx)
+    if len(parts) != 2:
+      self.fail(f"{value!r} must have exactly 2 components", param, ctx)
+    return tuple(parts)
+
+
+TUPLE2 = Tuple2()
+
+
+def range_opts(fn):
+  """Shared --xrange/--yrange/--zrange bounds restriction (reference
+  cli.py:254-256 et al.)."""
+  for opt in (
+    click.option("--zrange", type=TUPLE2, default=None,
+                 help="Restrict z-bounds (in the bounds mip), e.g. 0,1"),
+    click.option("--yrange", type=TUPLE2, default=None,
+                 help="Restrict y-bounds (in the bounds mip), e.g. 0,1024"),
+    click.option("--xrange", type=TUPLE2, default=None,
+                 help="Restrict x-bounds (in the bounds mip), e.g. 0,1024"),
+  ):
+    fn = opt(fn)
+  return fn
+
+
+def compute_cli_bounds(path, mip, xrange, yrange, zrange):
+  """Bbox from the volume bounds at ``mip`` with any provided axis ranges
+  overridden (reference cli.py:164-183); None when no range given."""
+  if not (xrange or yrange or zrange):
+    return None
+  from .volume import Volume
+
+  bounds = Volume(path).meta.bounds(mip or 0)
+  for axis, rng in enumerate((xrange, yrange, zrange)):
+    if rng:
+      lo, hi = sorted(rng)
+      bounds.minpt[axis] = lo
+      bounds.maxpt[axis] = hi
+  return bounds
 
 
 def parse_id_list(value):
@@ -80,12 +135,24 @@ def image():
   """Downsample, transfer, ingest, delete image/segmentation layers."""
 
 
+def _resolve_compress(compress, encoding):
+  """'none'/'false' → False; lossy/self-compressed encodings skip the
+  second-stage compressor (reference cli.py:283-287)."""
+  if isinstance(compress, str) and compress.lower() in ("none", "false"):
+    compress = False
+  if encoding and str(encoding).lower() in ("jpeg", "jxl", "png", "fpzip",
+                                            "zfpc"):
+    compress = False
+  return compress
+
+
 @image.command("downsample")
 @click.argument("path")
 @click.option("--queue", "-q", default=None, help="fq:// queue (local if omitted)")
 @click.option("--mip", default=0, show_default=True)
 @click.option("--num-mips", default=5, show_default=True)
 @click.option("--factor", type=TUPLE3, default=None, help="e.g. 2,2,1")
+@click.option("--volumetric", is_flag=True, help="Use 2x2x2 downsampling.")
 @click.option("--isotropic", is_flag=True,
               help="Per-mip factors driving the resolution toward isotropy.")
 @click.option("--sparse", is_flag=True)
@@ -93,8 +160,18 @@ def image():
 @click.option("--fill-missing", is_flag=True)
 @click.option("--chunk-size", type=TUPLE3, default=None)
 @click.option("--encoding", default=None)
+@click.option("--encoding-level", type=int, default=None,
+              help="png level / jpeg quality.")
+@click.option("--encoding-effort", type=int, default=None,
+              help="(jpeg xl) accepted for parity; jxl is not shipped.")
+@click.option("--compress", default="gzip", show_default=True,
+              help="Chunk compression: none, gzip, br.")
+@click.option("--delete-bg", is_flag=True,
+              help="Delete background tiles instead of uploading them.")
+@click.option("--bg-color", default=0, show_default=True)
 @click.option("--memory", "memory_target", default=int(3.5e9), show_default=True)
 @click.option("--method", "downsample_method", default="auto", show_default=True)
+@range_opts
 @click.option("--batched", is_flag=True,
               help="Run on this host's device mesh now (K cutouts per "
                    "shard_map dispatch, double-buffered IO) instead of "
@@ -104,17 +181,24 @@ def image():
 @click.option("--shape", type=TUPLE3, default=(256, 256, 64),
               show_default=True, help="Cutout shape with --batched.")
 @click.pass_context
-def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
-                     sparse, sharded, fill_missing, chunk_size, encoding,
-                     memory_target, downsample_method, batched, batch_size,
-                     shape):
+def image_downsample(ctx, path, queue, mip, num_mips, factor, volumetric,
+                     isotropic, sparse, sharded, fill_missing, chunk_size,
+                     encoding, encoding_level, encoding_effort, compress,
+                     delete_bg, bg_color, memory_target, downsample_method,
+                     xrange, yrange, zrange, batched, batch_size, shape):
   """Build the downsample pyramid of PATH."""
   from . import task_creation as tc
 
   if isotropic:
-    if factor is not None:
-      raise click.UsageError("--isotropic and --factor are exclusive")
+    if factor is not None or volumetric:
+      raise click.UsageError("--isotropic excludes --factor/--volumetric")
     factor = "isotropic"
+  elif volumetric:
+    if factor is not None:
+      raise click.UsageError("--volumetric and --factor are exclusive")
+    factor = (2, 2, 2)
+  compress = _resolve_compress(compress, encoding)
+  bounds = compute_cli_bounds(path, mip, xrange, yrange, zrange)
   if batched:
     if sharded or queue:
       raise click.UsageError("--batched runs unsharded on this host (no -q)")
@@ -130,7 +214,7 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
     stats = batched_downsample(
       path, mip=mip, num_mips=num_mips, shape=shape,
       batch_size=batch_size, factor=factor or (2, 2, 1), sparse=sparse,
-      fill_missing=fill_missing, method=downsample_method,
+      fill_missing=fill_missing, method=downsample_method, bounds=bounds,
     )
     click.echo(
       f"batched: {stats['batched_cutouts']} cutouts in "
@@ -142,15 +226,18 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
     tasks = tc.create_image_shard_downsample_tasks(
       path, mip=mip, fill_missing=fill_missing, sparse=sparse,
       chunk_size=chunk_size, encoding=encoding,
+      encoding_level=encoding_level, encoding_effort=encoding_effort,
       factor=factor or (2, 2, 1), memory_target=memory_target,
-      downsample_method=downsample_method,
+      downsample_method=downsample_method, bounds=bounds, bounds_mip=mip,
     )
   else:
     tasks = tc.create_downsampling_tasks(
       path, mip=mip, num_mips=num_mips, fill_missing=fill_missing,
       sparse=sparse, chunk_size=chunk_size, encoding=encoding,
-      factor=factor, memory_target=memory_target,
-      downsample_method=downsample_method,
+      encoding_level=encoding_level, encoding_effort=encoding_effort,
+      delete_black_uploads=delete_bg, background_color=bg_color,
+      compress=compress, factor=factor, memory_target=memory_target,
+      downsample_method=downsample_method, bounds=bounds, bounds_mip=mip,
     )
   enqueue(queue, tasks, ctx.obj["parallel"])
 
@@ -161,28 +248,89 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, isotropic,
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--chunk-size", type=TUPLE3, default=None)
-@click.option("--shape", type=TUPLE3, default=None)
+@click.option("--shape", type=TUPLE3, default=None,
+              help="(overrides --memory) Task shape in voxels.")
 @click.option("--translate", type=TUPLE3, default=(0, 0, 0))
 @click.option("--fill-missing", is_flag=True)
 @click.option("--sharded", is_flag=True)
 @click.option("--encoding", default=None)
-@click.option("--num-mips", default=0, show_default=True)
+@click.option("--encoding-level", type=int, default=None,
+              help="png level / jpeg quality.")
+@click.option("--encoding-effort", type=int, default=None,
+              help="(jpeg xl) accepted for parity; jxl is not shipped.")
+@click.option("--compress", default="gzip", show_default=True,
+              help="Chunk compression: none, gzip, br.")
+@click.option("--downsample/--skip-downsample", "do_downsample", default=True,
+              show_default=True,
+              help="Produce downsamples from transfer tiles.")
+@click.option("--max-mips", default=5, show_default=True,
+              help="Maximum number of additional pyramid levels.")
+@click.option("--num-mips", default=None, type=int,
+              help="Deprecated alias for --max-mips.")
+@click.option("--memory", "memory_target", default=int(3.5e9),
+              show_default=True)
+@click.option("--sparse", is_flag=True)
+@click.option("--volumetric", is_flag=True, help="Use 2x2x2 downsampling.")
+@click.option("--method", "--downsample-method", "downsample_method",
+              default="auto", show_default=True)
+@click.option("--delete-bg", is_flag=True)
+@click.option("--bg-color", default=0, show_default=True)
+@click.option("--dest-voxel-offset", type=TUPLE3, default=None,
+              help="Set the new volume's global origin.")
+@click.option("--clean-info", is_flag=True,
+              help="Scrub mesh/skeleton fields from the new info.")
+@click.option("--no-src-update", is_flag=True,
+              help="Skip the source provenance note.")
+@click.option("--truncate-scales/--no-truncate-scales", default=True,
+              show_default=True,
+              help="Drop source scales above --mip in the new info.")
+@click.option("--use-https-src", is_flag=True,
+              help="Parity flag: implies --no-src-update (no https "
+                   "backend in this build).")
+@range_opts
+@click.option("--bounds-mip", default=None, type=int,
+              help="Mip the ranges are specified in [default: --mip].")
+@click.option("--cutout", is_flag=True,
+              help="Restrict a newly created volume to the given bounds.")
 @click.pass_context
 def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
-               fill_missing, sharded, encoding, num_mips):
+               fill_missing, sharded, encoding, encoding_level,
+               encoding_effort, compress, do_downsample, max_mips, num_mips,
+               memory_target, sparse, volumetric, downsample_method,
+               delete_bg, bg_color, dest_voxel_offset, clean_info,
+               no_src_update, truncate_scales, use_https_src, xrange, yrange,
+               zrange, bounds_mip, cutout):
   """Transfer/rechunk/re-encode SRC into DEST."""
   from . import task_creation as tc
 
+  compress = _resolve_compress(compress, encoding)
+  bounds_mip = mip if bounds_mip is None else bounds_mip
+  bounds = compute_cli_bounds(src, bounds_mip, xrange, yrange, zrange)
+  factor = (2, 2, 2) if volumetric else None
+  if num_mips is not None:
+    max_mips = num_mips
+  if not do_downsample:
+    max_mips = 0
   if sharded:
     tasks = tc.create_image_shard_transfer_tasks(
       src, dest, mip=mip, chunk_size=chunk_size, encoding=encoding,
+      encoding_level=encoding_level, encoding_effort=encoding_effort,
       translate=translate, fill_missing=fill_missing,
+      dest_voxel_offset=dest_voxel_offset, bounds=bounds,
+      bounds_mip=bounds_mip,
     )
   else:
     tasks = tc.create_transfer_tasks(
       src, dest, chunk_size=chunk_size, shape=shape, mip=mip,
       translate=translate, fill_missing=fill_missing, encoding=encoding,
-      num_mips=num_mips,
+      encoding_level=encoding_level, encoding_effort=encoding_effort,
+      compress=compress, num_mips=max_mips, memory_target=memory_target,
+      sparse=sparse, factor=factor, downsample_method=downsample_method,
+      delete_black_uploads=delete_bg, background_color=bg_color,
+      dest_voxel_offset=dest_voxel_offset, clean_info=clean_info,
+      no_src_update=no_src_update, truncate_scales=truncate_scales,
+      use_https_for_source=use_https_src, bounds=bounds,
+      bounds_mip=bounds_mip, cutout=cutout,
     )
   enqueue(queue, tasks, ctx.obj["parallel"])
 
@@ -195,21 +343,37 @@ def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
 @click.option("--chunk-size", type=TUPLE3, default=(64, 64, 64), show_default=True)
 @click.option("--layer-type", default=None,
               type=click.Choice(["image", "segmentation"]))
+@click.option("--seg", is_flag=True,
+              help="Shorthand for --layer-type segmentation.")
 @click.option("--encoding", default="raw", show_default=True)
-def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding):
+@click.option("--encoding-level", type=int, default=None,
+              help="png level / jpeg quality.")
+@click.option("--encoding-effort", type=int, default=None,
+              help="(jpeg xl) accepted for parity; jxl is not shipped.")
+@click.option("--compress", default="gzip", show_default=True,
+              help="Chunk compression: none, gzip, br.")
+@click.option("--h5-dataset", default="main", show_default=True,
+              help="Which h5 dataset to access (hdf5 imports only).")
+def image_create(src, dest, resolution, offset, chunk_size, layer_type, seg,
+                 encoding, encoding_level, encoding_effort, compress,
+                 h5_dataset):
   """Ingest an array file (npy/npy.gz/h5/nrrd/nii/nii.gz) as a Precomputed
   layer (reference `igneous image create`, cli.py:1852-1923; ckl needs
   the crackle library and fails with instructions)."""
   from .formats import load_volume_file
   from .volume import Volume
 
+  if seg:
+    layer_type = "segmentation"
   try:
-    arr = load_volume_file(src)
+    arr = load_volume_file(src, h5_dataset=h5_dataset)
   except (ValueError, OSError) as e:  # OSError: corrupt gzip members
     raise click.UsageError(str(e))
   Volume.from_numpy(
     arr, dest, resolution=resolution, voxel_offset=offset,
     chunk_size=chunk_size, layer_type=layer_type, encoding=encoding,
+    encoding_level=encoding_level,
+    compress=_resolve_compress(compress, encoding),
   )
   click.echo(f"Created {dest} from {src} {arr.shape} {arr.dtype}")
 
@@ -219,13 +383,19 @@ def image_create(src, dest, resolution, offset, chunk_size, layer_type, encoding
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--num-mips", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=None,
+              help="Task shape in voxels.")
+@range_opts
 @click.pass_context
-def image_rm(ctx, path, queue, mip, num_mips):
+def image_rm(ctx, path, queue, mip, num_mips, shape, xrange, yrange, zrange):
   """Delete image chunks at mip (… mip+num-mips)."""
   from . import task_creation as tc
 
-  enqueue(queue, tc.create_deletion_tasks(path, mip=mip, num_mips=num_mips),
-          ctx.obj["parallel"])
+  bounds = compute_cli_bounds(path, mip, xrange, yrange, zrange)
+  enqueue(queue, tc.create_deletion_tasks(
+    path, mip=mip, num_mips=num_mips, shape=shape, bounds=bounds,
+    bounds_mip=mip,
+  ), ctx.obj["parallel"])
 
 
 # -- image contrast ----------------------------------------------------------
@@ -241,13 +411,22 @@ def image_contrast():
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--coverage", default=0.01, show_default=True)
+@click.option("--fill-missing", is_flag=True)
+@range_opts
+@click.option("--bounds-mip", default=None, type=int,
+              help="Mip the ranges are specified in [default: --mip].")
 @click.pass_context
-def contrast_histogram(ctx, path, queue, mip, coverage):
+def contrast_histogram(ctx, path, queue, mip, coverage, fill_missing,
+                       xrange, yrange, zrange, bounds_mip):
   """Phase 1: per-z luminance histograms."""
   from . import task_creation as tc
 
+  bounds_mip = mip if bounds_mip is None else bounds_mip
+  bounds = compute_cli_bounds(path, bounds_mip, xrange, yrange, zrange)
   enqueue(queue, tc.create_luminance_levels_tasks(
-    path, mip=mip, coverage_factor=coverage), ctx.obj["parallel"])
+    path, mip=mip, coverage_factor=coverage, fill_missing=fill_missing,
+    bounds=bounds, bounds_mip=bounds_mip,
+  ), ctx.obj["parallel"])
 
 
 @image_contrast.command("equalize")
@@ -257,14 +436,48 @@ def contrast_histogram(ctx, path, queue, mip, coverage):
 @click.option("--mip", default=0, show_default=True)
 @click.option("--clip-fraction", default=0.01, show_default=True)
 @click.option("--shape", type=TUPLE3, default=None)
+@click.option("--fill-missing", is_flag=True)
+@click.option("--minval", type=int, default=0, show_default=True,
+              help="Stretch floor value.")
+@click.option("--maxval", type=int, default=255, show_default=True,
+              help="Stretch ceiling value.")
+@click.option("--translate", type=TUPLE3, default=(0, 0, 0))
+@range_opts
+@click.option("--bounds-mip", default=None, type=int,
+              help="Mip the ranges are specified in [default: --mip].")
 @click.pass_context
-def contrast_equalize(ctx, src, dest, queue, mip, clip_fraction, shape):
+def contrast_equalize(ctx, src, dest, queue, mip, clip_fraction, shape,
+                      fill_missing, minval, maxval, translate, xrange,
+                      yrange, zrange, bounds_mip):
   """Phase 2: histogram stretch using phase-1 levels."""
   from . import task_creation as tc
 
+  bounds_mip = mip if bounds_mip is None else bounds_mip
+  bounds = compute_cli_bounds(src, bounds_mip, xrange, yrange, zrange)
   enqueue(queue, tc.create_contrast_normalization_tasks(
     src, dest, mip=mip, clip_fraction=clip_fraction, shape=shape,
+    fill_missing=fill_missing, minval=minval, maxval=maxval,
+    translate=translate, bounds=bounds, bounds_mip=bounds_mip,
   ), ctx.obj["parallel"])
+
+
+class Tuple2Or1(click.ParamType):
+  """'8' or '8,8' → (8, 8)."""
+
+  name = "tuple2or1"
+
+  def convert(self, value, param, ctx):
+    if isinstance(value, (tuple, list)):
+      return tuple(int(v) for v in value)
+    try:
+      parts = [int(v) for v in str(value).split(",")]
+    except ValueError:
+      self.fail(f"{value!r} is not like 8 or 8,8", param, ctx)
+    if len(parts) == 1:
+      parts = parts * 2
+    if len(parts) != 2:
+      self.fail(f"{value!r} must have 1 or 2 components", param, ctx)
+    return tuple(parts)
 
 
 @image_contrast.command("clahe")
@@ -273,15 +486,25 @@ def contrast_equalize(ctx, src, dest, queue, mip, clip_fraction, shape):
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--clip-limit", default=40.0, show_default=True)
-@click.option("--tile-grid", default=8, show_default=True)
+@click.option("--tile-grid-size", "--tile-grid", "tile_grid",
+              type=Tuple2Or1(), default=(8, 8), show_default=True,
+              help="Size of the adaptive grid.")
 @click.option("--shape", type=TUPLE3, default=(2048, 2048, 64), show_default=True)
+@click.option("--fill-missing", is_flag=True)
+@range_opts
+@click.option("--bounds-mip", default=None, type=int,
+              help="Mip the ranges are specified in [default: --mip].")
 @click.pass_context
-def contrast_clahe(ctx, src, dest, queue, mip, clip_limit, tile_grid, shape):
+def contrast_clahe(ctx, src, dest, queue, mip, clip_limit, tile_grid, shape,
+                   fill_missing, xrange, yrange, zrange, bounds_mip):
   from . import task_creation as tc
 
+  bounds_mip = mip if bounds_mip is None else bounds_mip
+  bounds = compute_cli_bounds(src, bounds_mip, xrange, yrange, zrange)
   enqueue(queue, tc.create_clahe_tasks(
     src, dest, mip=mip, clip_limit=clip_limit, tile_grid_size=tile_grid,
-    shape=shape,
+    shape=shape, fill_missing=fill_missing, bounds=bounds,
+    bounds_mip=bounds_mip,
   ), ctx.obj["parallel"])
 
 
@@ -298,55 +521,96 @@ def image_voxels():
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
+@click.option("--fill-missing", is_flag=True)
 @click.pass_context
-def voxels_count(ctx, path, queue, mip, shape):
+def voxels_count(ctx, path, queue, mip, shape, fill_missing):
   """Census phase; run `voxels sum` afterwards."""
   from . import task_creation as tc
 
-  enqueue(queue, tc.create_voxel_counting_tasks(path, mip=mip, shape=shape),
-          ctx.obj["parallel"])
+  enqueue(queue, tc.create_voxel_counting_tasks(
+    path, mip=mip, shape=shape, fill_missing=fill_missing,
+  ), ctx.obj["parallel"])
 
 
 @image_voxels.command("sum")
 @click.argument("path")
 @click.option("--mip", default=0, show_default=True)
-def voxels_sum(path, mip):
+@click.option("--compress", default="gzip", show_default=True,
+              help="Compression for the stored voxel_counts.im.")
+@click.option("-o", "--output", default=None,
+              help="Also write the IntMap file locally at this path.")
+def voxels_sum(path, mip, compress, output):
   """Reduce census files into voxel_counts.im."""
   from . import task_creation as tc
 
-  totals = tc.accumulate_voxel_counts(path, mip)
+  compress = _resolve_compress(compress, None) or None
+  totals = tc.accumulate_voxel_counts(
+    path, mip, compress=compress, additional_output=output,
+  )
   click.echo(f"labels: {len(totals)}")
 
 
 @image.command("roi")
 @click.argument("path")
 @click.option("--threshold", default=0.0, show_default=True)
-@click.option("--dust", default=100, show_default=True)
-def image_roi(path, threshold, dust):
+@click.option("--dust", default=100, show_default=True,
+              help="Suppress components smaller than this many voxels.")
+@click.option("--suppress-faint", default=0, show_default=True,
+              help="Voxels at or below this value become background.")
+@click.option("--max-axial-len", default=512, show_default=True,
+              help="Downsample in memory until XY fits this square.")
+@click.option("--z-step", type=int, default=None,
+              help="Evaluate ROIs per z-slab of this depth.")
+@click.option("--progress", is_flag=True)
+def image_roi(path, threshold, dust, suppress_faint, max_axial_len, z_step,
+              progress):
   """Detect tissue regions of interest at the coarsest mip."""
   from . import task_creation as tc
 
-  for roi in tc.compute_rois(path, threshold=threshold, dust_threshold=dust):
+  for roi in tc.compute_rois(
+    path, threshold=threshold, dust_threshold=dust,
+    suppress_faint_voxels=suppress_faint, max_axial_length=max_axial_len,
+    z_step=z_step, progress=progress,
+  ):
     click.echo(str(roi))
 
 
 @image.command("reorder")
 @click.argument("src")
 @click.argument("dest")
-@click.argument("mapping_json", type=click.Path(exists=True))
+@click.argument("mapping_json", type=click.Path(exists=True), required=False)
+@click.option("--mapping-file", type=click.Path(exists=True), default=None,
+              help="JSON file of {dest_z: src_z} (reference flag form).")
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
+@click.option("--fill-missing", is_flag=True)
+@click.option("--encoding", default=None)
+@click.option("--encoding-level", type=int, default=None)
+@click.option("--encoding-effort", type=int, default=None,
+              help="(jpeg xl) accepted for parity; jxl is not shipped.")
+@click.option("--compress", default="gzip", show_default=True)
+@click.option("--delete-bg", is_flag=True)
+@click.option("--bg-color", default=0, show_default=True)
 @click.pass_context
-def image_reorder(ctx, src, dest, mapping_json, queue, mip):
+def image_reorder(ctx, src, dest, mapping_json, mapping_file, queue, mip,
+                  fill_missing, encoding, encoding_level, encoding_effort,
+                  compress, delete_bg, bg_color):
   """Shuffle z-slices per a {dest_z: src_z} JSON mapping."""
   import json as json_mod
 
   from . import task_creation as tc
 
-  with open(mapping_json) as f:
+  path = mapping_json or mapping_file
+  if not path:
+    raise click.UsageError("provide MAPPING_JSON or --mapping-file")
+  with open(path) as f:
     mapping = json_mod.load(f)
-  enqueue(queue, tc.create_reordering_tasks(src, dest, mapping, mip=mip),
-          ctx.obj["parallel"])
+  enqueue(queue, tc.create_reordering_tasks(
+    src, dest, mapping, mip=mip, fill_missing=fill_missing,
+    encoding=encoding, encoding_level=encoding_level,
+    compress=_resolve_compress(compress, encoding),
+    delete_black_uploads=delete_bg, background_color=bg_color,
+  ), ctx.obj["parallel"])
 
 
 # -- image ccl ---------------------------------------------------------------
@@ -363,6 +627,9 @@ _CCL_OPTS = [
   click.option("--threshold-gte", type=float, default=None),
   click.option("--threshold-lte", type=float, default=None),
   click.option("--fill-missing", is_flag=True),
+  click.option("--dust", "dust_threshold", default=0, show_default=True,
+               help="Delete objects smaller than this many voxels "
+                    "within a cutout."),
 ]
 
 
@@ -378,11 +645,12 @@ def ccl_opts(fn):
 @ccl_opts
 @click.pass_context
 def ccl_faces(ctx, path, queue, mip, shape, threshold_gte, threshold_lte,
-              fill_missing):
+              fill_missing, dust_threshold):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_ccl_face_tasks(
     path, mip, shape, fill_missing, threshold_gte, threshold_lte,
+    dust_threshold=dust_threshold,
   ), ctx.obj["parallel"])
 
 
@@ -392,22 +660,27 @@ def ccl_faces(ctx, path, queue, mip, shape, threshold_gte, threshold_lte,
 @ccl_opts
 @click.pass_context
 def ccl_links(ctx, path, queue, mip, shape, threshold_gte, threshold_lte,
-              fill_missing):
+              fill_missing, dust_threshold):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_ccl_equivalence_tasks(
     path, mip, shape, fill_missing, threshold_gte, threshold_lte,
+    dust_threshold=dust_threshold,
   ), ctx.obj["parallel"])
 
 
 @image_ccl.command("calc-labels")
 @click.argument("path")
 @click.option("--mip", default=0, show_default=True)
-def ccl_calc_labels(path, mip):
+@click.option("--shape", type=TUPLE3, default=(448, 448, 448),
+              show_default=True,
+              help="Accepted for parity; the stored equivalence files "
+                   "already determine the task grid.")
+def ccl_calc_labels(path, mip, shape):
   """Single-machine global union-find (pass 3)."""
   from . import task_creation as tc
 
-  max_label = tc.create_relabeling(path, mip)
+  max_label = tc.create_relabeling(path, mip, shape)
   click.echo(f"max_label: {max_label}")
 
 
@@ -417,14 +690,17 @@ def ccl_calc_labels(path, mip):
 @click.option("--queue", "-q", default=None)
 @ccl_opts
 @click.option("--encoding", default="compressed_segmentation", show_default=True)
+@click.option("--chunk-size", type=TUPLE3, default=None,
+              help="Chunk size of the destination layer.")
 @click.pass_context
 def ccl_relabel(ctx, path, dest, queue, mip, shape, threshold_gte,
-                threshold_lte, fill_missing, encoding):
+                threshold_lte, fill_missing, dust_threshold, encoding,
+                chunk_size):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_ccl_relabel_tasks(
     path, dest, mip, shape, fill_missing, threshold_gte, threshold_lte,
-    encoding=encoding,
+    encoding=encoding, chunk_size=chunk_size, dust_threshold=dust_threshold,
   ), ctx.obj["parallel"])
 
 
@@ -440,20 +716,32 @@ def ccl_clean(path, mip):
 @image_ccl.command("auto")
 @click.argument("path")
 @click.argument("dest")
+@click.option("--queue", "-q", default=None,
+              help="Lease-based queue to drain each pass through "
+                   "(local execution if omitted).")
 @ccl_opts
 @click.option("--encoding", default="compressed_segmentation", show_default=True)
+@click.option("--chunk-size", type=TUPLE3, default=None,
+              help="Chunk size of the destination layer.")
+@click.option("--clean/--no-clean", default=True, show_default=True,
+              help="Delete scratch files afterwards.")
 @click.pass_context
-def ccl_auto_cmd(ctx, path, dest, mip, shape, threshold_gte, threshold_lte,
-                 fill_missing, encoding):
+def ccl_auto_cmd(ctx, path, dest, queue, mip, shape, threshold_gte,
+                 threshold_lte, fill_missing, dust_threshold, encoding,
+                 chunk_size, clean):
   """All four passes locally (reference cli.py:799-852)."""
   from . import task_creation as tc
-  from .queues import LocalTaskQueue
+  from .queues import LocalTaskQueue, TaskQueue
 
+  tq = (
+    TaskQueue(queue) if queue
+    else LocalTaskQueue(parallel=ctx.obj["parallel"], progress=False)
+  )
   max_label = tc.ccl_auto(
-    path, dest, mip=mip, shape=shape,
-    queue=LocalTaskQueue(parallel=ctx.obj["parallel"], progress=False),
+    path, dest, mip=mip, shape=shape, queue=tq,
     threshold_gte=threshold_gte, threshold_lte=threshold_lte,
-    fill_missing=fill_missing, encoding=encoding,
+    fill_missing=fill_missing, encoding=encoding, chunk_size=chunk_size,
+    clean=clean, dust_threshold=dust_threshold,
   )
   click.echo(f"components: {max_label}")
 
@@ -472,37 +760,60 @@ def mesh():
 @click.option("--queue", "-q", default=None)
 @click.option("--mip", default=0, show_default=True)
 @click.option("--shape", type=TUPLE3, default=(448, 448, 448), show_default=True)
+@click.option("--simplify/--skip-simplify", "simplify", default=True,
+              show_default=True, help="Enable mesh simplification.")
 @click.option("--simplify-factor", default=100, show_default=True)
 @click.option("--max-error", default=40, show_default=True)
-@click.option("--mesh-dir", default=None)
-@click.option("--dust-threshold", type=int, default=None)
+@click.option("--mesh-dir", "--dir", "mesh_dir", default=None,
+              help="Write meshes into this directory instead of the one "
+                   "in the info file.")
+@click.option("--dust-threshold", "--dust", "dust_threshold", type=int,
+              default=None,
+              help="Skip objects smaller than this many voxels.")
+@click.option("--dust-global/--dust-local", default=False, show_default=True,
+              help="Dust by global voxel counts (requires a voxels census).")
 @click.option("--fill-missing", is_flag=True)
+@click.option("--fill-holes", type=int, default=0, show_default=True,
+              help="0: off 1: fill cavities 2: also fix borders "
+                   "3: also morphological closing.")
+@click.option("--compress", default="gzip", show_default=True,
+              help="Fragment file compression: none, gzip, br.")
 @click.option("--sharded", is_flag=True)
 @click.option("--spatial-index/--no-spatial-index", default=True, show_default=True)
-@click.option("--obj-ids", default=None,
+@click.option("--closed-edge/--open-edge", "closed_edge", default=True,
+              show_default=True,
+              help="Close meshes against the dataset boundary.")
+@click.option("--labels", "--obj-ids", "obj_ids", default=None,
               help="comma-separated: mesh only these labels")
-@click.option("--exclude-obj-ids", default=None,
+@click.option("--exclude-labels", "--exclude-obj-ids", "exclude_obj_ids",
+              default=None,
               help="comma-separated: never mesh these labels")
 @click.option("--mesher", default="cubes", show_default=True,
               type=click.Choice(["cubes", "tetrahedra"]))
 @click.option("--simplify-parallel", default=1, show_default=True,
               help="threads for per-label simplification inside each task")
 @click.pass_context
-def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
-               mesh_dir, dust_threshold, fill_missing, sharded, spatial_index,
-               obj_ids, exclude_obj_ids, mesher, simplify_parallel):
+def mesh_forge(ctx, path, queue, mip, shape, simplify, simplify_factor,
+               max_error, mesh_dir, dust_threshold, dust_global,
+               fill_missing, fill_holes, compress, sharded, spatial_index,
+               closed_edge, obj_ids, exclude_obj_ids, mesher,
+               simplify_parallel):
   from . import task_creation as tc
 
+  compress = _resolve_compress(compress, None) or None
   enqueue(queue, tc.create_meshing_tasks(
     path, mip=mip, shape=shape,
+    simplification=simplify,
     simplification_factor=simplify_factor,
     max_simplification_error=max_error,
     mesh_dir=mesh_dir, dust_threshold=dust_threshold,
-    fill_missing=fill_missing, sharded=sharded,
+    dust_global=dust_global,
+    fill_missing=fill_missing, fill_holes=fill_holes, sharded=sharded,
     spatial_index=spatial_index,
+    closed_dataset_edges=closed_edge,
     object_ids=parse_id_list(obj_ids),
     exclude_object_ids=parse_id_list(exclude_obj_ids),
-    mesher=mesher, parallel=simplify_parallel,
+    mesher=mesher, parallel=simplify_parallel, compress=compress,
   ), ctx.obj["parallel"])
 
 
@@ -510,28 +821,73 @@ def mesh_forge(ctx, path, queue, mip, shape, simplify_factor, max_error,
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
 @click.option("--magnitude", default=2, show_default=True)
-@click.option("--mesh-dir", default=None)
+@click.option("--mesh-dir", "--dir", "mesh_dir", default=None)
+@click.option("--nlod", default=0, show_default=True,
+              help="(multires) Extra levels of detail; 0 = legacy "
+                   "manifests.")
+@click.option("--vqb", default=16, show_default=True,
+              help="(multires) Vertex quantization bits: 10 or 16.")
+@click.option("--min-chunk-size", type=TUPLE3, default=(256, 256, 256),
+              show_default=True,
+              help="(multires) Minimum finest-LOD fragment cell (voxels).")
 @click.pass_context
-def mesh_merge(ctx, path, queue, magnitude, mesh_dir):
-  """Write legacy manifests (stage 2)."""
+def mesh_merge(ctx, path, queue, magnitude, mesh_dir, nlod, vqb,
+               min_chunk_size):
+  """Write legacy manifests — or unsharded multires with --nlod > 0
+  (stage 2, reference cli.py:1073-1103)."""
   from . import task_creation as tc
 
-  enqueue(queue, tc.create_mesh_manifest_tasks(
-    path, magnitude=magnitude, mesh_dir=mesh_dir), ctx.obj["parallel"])
+  if nlod > 0:
+    tasks = tc.create_unsharded_multires_mesh_tasks(
+      path, magnitude=magnitude, mesh_dir=mesh_dir, num_lods=nlod + 1,
+      vertex_quantization_bits=vqb, min_chunk_size=min_chunk_size,
+    )
+  else:
+    tasks = tc.create_mesh_manifest_tasks(
+      path, magnitude=magnitude, mesh_dir=mesh_dir)
+  enqueue(queue, tasks, ctx.obj["parallel"])
 
 
 @mesh.command("merge-sharded")
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
-@click.option("--mesh-dir", default=None)
-@click.option("--num-lods", default=2, show_default=True)
+@click.option("--mesh-dir", "--dir", "mesh_dir", default=None)
+@click.option("--num-lods", "--nlod", "num_lods", default=2,
+              show_default=True)
+@click.option("--vqb", default=16, show_default=True,
+              help="Vertex quantization bits: 10 or 16.")
+@click.option("--min-chunk-size", type=TUPLE3, default=(256, 256, 256),
+              show_default=True,
+              help="Minimum finest-LOD fragment cell (voxels).")
+@click.option("--compress-level", default=7, show_default=True,
+              help="Draco compression level (recorded; this build's "
+                   "encoder is fixed sequential-method).")
+@click.option("--shard-index-bytes", default=2**13, show_default=True)
+@click.option("--minishard-index-bytes", default=2**15, show_default=True)
+@click.option("--minishard-index-encoding", default="gzip", show_default=True)
+@click.option("--min-shards", default=1, show_default=True)
+@click.option("--max-labels-per-shard", default=1000, show_default=True)
+@click.option("--spatial-index-db", default=None,
+              help="Query labels from this sqlite db (mesh spatial-index "
+                   "db) instead of listing .spatial files.")
 @click.pass_context
-def mesh_merge_sharded(ctx, path, queue, mesh_dir, num_lods):
-  """Sharded multires merge (requires a registered draco codec)."""
+def mesh_merge_sharded(ctx, path, queue, mesh_dir, num_lods, vqb,
+                       min_chunk_size, compress_level, shard_index_bytes,
+                       minishard_index_bytes, minishard_index_encoding,
+                       min_shards, max_labels_per_shard, spatial_index_db):
+  """Sharded multires merge (reference cli.py:1105-1155)."""
   from . import task_creation as tc
 
   enqueue(queue, tc.create_sharded_multires_mesh_tasks(
-    path, mesh_dir=mesh_dir, num_lods=num_lods), ctx.obj["parallel"])
+    path, mesh_dir=mesh_dir, num_lods=num_lods,
+    vertex_quantization_bits=vqb, min_chunk_size=min_chunk_size,
+    draco_compression_level=compress_level,
+    shard_index_bytes=shard_index_bytes,
+    minishard_index_bytes=minishard_index_bytes,
+    minishard_index_encoding=minishard_index_encoding,
+    min_shards=min_shards, max_labels_per_shard=max_labels_per_shard,
+    spatial_index_db=spatial_index_db,
+  ), ctx.obj["parallel"])
 
 
 @mesh.group("spatial-index")
@@ -545,23 +901,28 @@ def mesh_spatial_index():
 @click.option("--mip", default=0, show_default=True)
 @click.option("--shape", type=TUPLE3, default=(448, 448, 448), show_default=True)
 @click.option("--mesh-dir", default=None)
+@click.option("--fill-missing", is_flag=True)
 @click.pass_context
-def mesh_spatial_index_create(ctx, path, queue, mip, shape, mesh_dir):
+def mesh_spatial_index_create(ctx, path, queue, mip, shape, mesh_dir,
+                              fill_missing):
   from . import task_creation as tc
   from .tasks.mesh import mesh_dir_for
   from .volume import Volume
 
   mdir = mesh_dir_for(Volume(path), mesh_dir)
-  enqueue(queue, tc.create_spatial_index_tasks(path, mdir, mip=mip,
-                                               shape=shape),
-          ctx.obj["parallel"])
+  enqueue(queue, tc.create_spatial_index_tasks(
+    path, mdir, mip=mip, shape=shape, fill_missing=fill_missing,
+  ), ctx.obj["parallel"])
 
 
 @mesh_spatial_index.command("db")
 @click.argument("path")
 @click.argument("db_path", type=click.Path())
 @click.option("--mesh-dir", default=None)
-def mesh_spatial_index_db(path, db_path, mesh_dir):
+@click.option("--progress", is_flag=True)
+@click.option("--allow-missing", is_flag=True,
+              help="Tolerate missing index files.")
+def mesh_spatial_index_db(path, db_path, mesh_dir, progress, allow_missing):
   """Materialize the spatial index into a sqlite database."""
   from .spatial_index import SpatialIndex
   from .tasks.mesh import mesh_dir_for
@@ -569,7 +930,9 @@ def mesh_spatial_index_db(path, db_path, mesh_dir):
 
   vol = Volume(path)
   mdir = mesh_dir_for(vol, mesh_dir)
-  n = SpatialIndex(vol.cf, mdir).to_sqlite(db_path)
+  n = SpatialIndex(vol.cf, mdir).to_sqlite(
+    db_path, progress=progress, allow_missing=allow_missing,
+  )
   click.echo(f"wrote {n} rows to {db_path}")
 
 
@@ -597,20 +960,34 @@ def mesh_clean(path, mesh_dir):
 @click.argument("src")
 @click.argument("dest")
 @click.option("--queue", "-q", default=None)
-@click.option("--mesh-dir", default=None)
+@click.option("--mesh-dir", "--dir", "mesh_dir", default=None)
 @click.option("--magnitude", default=1, show_default=True)
+@click.option("--sharded", is_flag=True,
+              help="Convert unsharded meshes to sharded multires at the "
+                   "destination.")
+@click.option("--nlod", default=0, show_default=True,
+              help="(--sharded) Extra levels of detail.")
+@click.option("--mip", type=int, default=None,
+              help="Accepted for parity; the multires info records the "
+                   "source mip.")
 @click.pass_context
-def mesh_xfer(ctx, src, dest, queue, mesh_dir, magnitude):
+def mesh_xfer(ctx, src, dest, queue, mesh_dir, magnitude, sharded, nlod, mip):
   from . import task_creation as tc
 
-  enqueue(queue, tc.create_mesh_transfer_tasks(
-    src, dest, mesh_dir=mesh_dir, magnitude=magnitude), ctx.obj["parallel"])
+  if sharded:
+    tasks = tc.create_sharded_multires_mesh_from_unsharded_tasks(
+      src, dest_cloudpath=dest, mesh_dir=mesh_dir, num_lods=nlod + 1,
+    )
+  else:
+    tasks = tc.create_mesh_transfer_tasks(
+      src, dest, mesh_dir=mesh_dir, magnitude=magnitude)
+  enqueue(queue, tasks, ctx.obj["parallel"])
 
 
 @mesh.command("rm")
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
-@click.option("--mesh-dir", default=None)
+@click.option("--mesh-dir", "--dir", "mesh_dir", default=None)
 @click.option("--magnitude", default=1, show_default=True)
 @click.pass_context
 def mesh_rm(ctx, path, queue, mesh_dir, magnitude):
@@ -636,12 +1013,19 @@ def skeleton():
 @click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
 @click.option("--scale", default=4.0, show_default=True, help="TEASAR scale")
 @click.option("--const", default=500.0, show_default=True, help="TEASAR const (nm)")
+@click.option("--max-paths", type=float, default=None,
+              help="Abort an object after tracing this many paths.")
 @click.option("--dust-threshold", default=1000, show_default=True)
-@click.option("--dust-global/--no-dust-global", default=False, show_default=True,
+@click.option("--dust-global/--dust-local", default=False, show_default=True,
               help="dust by global voxel counts (requires a voxels census)")
 @click.option("--fill-missing", is_flag=True)
+@click.option("--fill-holes", type=int, default=0, show_default=True,
+              help="0: off 1: fill cavities 2: +close box sides "
+                   "3: +morphological closing")
 @click.option("--sharded", is_flag=True)
 @click.option("--skel-dir", default=None)
+@click.option("--spatial-index/--skip-spatial-index", default=True,
+              show_default=True)
 @click.option("--fix-borders/--no-fix-borders", default=True, show_default=True)
 @click.option("--fix-branching/--no-fix-branching", default=True,
               show_default=True,
@@ -650,17 +1034,42 @@ def skeleton():
 @click.option("--fix-avocados", is_flag=True,
               help="absorb nucleus labels engulfed by a soma and "
                    "re-EDT the solid cell body")
+@click.option("--fix-autapses", is_flag=True,
+              help="(graphene) constrain TEASAR to the chunk graph so "
+                   "self-contacts are severed")
 @click.option("--soma-detect", default=1100.0, show_default=True,
               help="soma candidate EDT threshold (physical units)")
 @click.option("--soma-accept", default=3500.0, show_default=True,
               help="soma acceptance EDT threshold (physical units)")
 @click.option("--soma-scale", default=2.0, show_default=True)
 @click.option("--soma-const", default=300.0, show_default=True)
+@click.option("--labels", default=None,
+              help="comma-separated: skeletonize only these labels")
+@click.option("--cross-section", type=int, default=0, show_default=True,
+              help="Compute per-vertex cross sectional area; the value is "
+                   "the normal-vector smoothing window (0 = off).")
+@click.option("--cross-section-label-repair-sec", type=int, default=-1,
+              show_default=True,
+              help="Per-label time budget for contact repair: 0 off, "
+                   "-1 unlimited.")
+@click.option("--output", "-o", default=None,
+              help="Write stage-1 fragments to this path instead.")
+@click.option("--timestamp", type=int, default=None,
+              help="(graphene) proofreading state at this UNIX time.")
+@click.option("--root-ids", default=None,
+              help="(graphene) materialized root-id layer to read instead "
+                   "of querying the server.")
+@click.option("--progress", is_flag=True,
+              help="Accepted for parity; local queues already show a "
+                   "progress bar.")
 @click.pass_context
-def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
-                   dust_global, fill_missing, sharded, skel_dir, fix_borders,
-                   fix_branching, fix_avocados, soma_detect, soma_accept,
-                   soma_scale, soma_const):
+def skeleton_forge(ctx, path, queue, mip, shape, scale, const, max_paths,
+                   dust_threshold, dust_global, fill_missing, fill_holes,
+                   sharded, skel_dir, spatial_index, fix_borders,
+                   fix_branching, fix_avocados, fix_autapses, soma_detect,
+                   soma_accept, soma_scale, soma_const, labels,
+                   cross_section, cross_section_label_repair_sec, output,
+                   timestamp, root_ids, progress):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_skeletonizing_tasks(
@@ -671,11 +1080,19 @@ def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
       "soma_acceptance_threshold": soma_accept,
       "soma_invalidation_scale": soma_scale,
       "soma_invalidation_const": soma_const,
+      "max_paths": max_paths,
     },
     dust_threshold=dust_threshold, dust_global=dust_global,
-    fill_missing=fill_missing,
-    sharded=sharded, skel_dir=skel_dir, fix_borders=fix_borders,
+    fill_missing=fill_missing, fill_holes=fill_holes,
+    sharded=sharded, skel_dir=skel_dir, spatial_index=spatial_index,
+    fix_borders=fix_borders,
     fix_branching=fix_branching, fix_avocados=fix_avocados,
+    fix_autapses=fix_autapses,
+    object_ids=parse_id_list(labels),
+    cross_sectional_area=(cross_section > 0),
+    csa_smoothing_window=max(int(cross_section), 1),
+    csa_repair_sec_per_label=cross_section_label_repair_sec,
+    frag_path=output, timestamp=timestamp, root_ids_cloudpath=root_ids,
   ), ctx.obj["parallel"])
 
 
@@ -684,7 +1101,9 @@ def skeleton_forge(ctx, path, queue, mip, shape, scale, const, dust_threshold,
 @click.option("--queue", "-q", default=None)
 @click.option("--magnitude", default=1, show_default=True)
 @click.option("--skel-dir", default=None)
-@click.option("--dust-threshold", default=4000.0, show_default=True)
+@click.option("--dust-threshold", "--min-cable-length", "dust_threshold",
+              default=4000.0, show_default=True,
+              help="Skip objects shorter than this physical path length.")
 @click.option("--tick-threshold", default=6000.0, show_default=True)
 @click.option("--delete-fragments", is_flag=True)
 @click.option("--max-cable-length", type=float, default=None,
@@ -707,19 +1126,41 @@ def skeleton_merge(ctx, path, queue, magnitude, skel_dir, dust_threshold,
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
 @click.option("--skel-dir", default=None)
-@click.option("--dust-threshold", default=4000.0, show_default=True)
+@click.option("--dust-threshold", "--min-cable-length", "dust_threshold",
+              default=4000.0, show_default=True,
+              help="Skip objects shorter than this physical path length.")
 @click.option("--tick-threshold", default=6000.0, show_default=True)
 @click.option("--max-cable-length", type=float, default=None,
               help="skip postprocessing for merged skeletons longer than "
                    "this (nm)")
+@click.option("--shard-index-bytes", default=2**13, show_default=True)
+@click.option("--minishard-index-bytes", default=2**15, show_default=True)
+@click.option("--minishard-index-encoding", default="gzip", show_default=True)
+@click.option("--data-encoding", default="gzip", show_default=True,
+              help="Shard data compression: gzip or raw.")
+@click.option("--min-shards", default=1, show_default=True)
+@click.option("--max-labels-per-shard", default=2000, show_default=True)
+@click.option("--spatial-index-db", default=None,
+              help="Query labels from this sqlite db instead of listing "
+                   ".spatial files.")
 @click.pass_context
 def skeleton_merge_sharded(ctx, path, queue, skel_dir, dust_threshold,
-                           tick_threshold, max_cable_length):
+                           tick_threshold, max_cable_length,
+                           shard_index_bytes, minishard_index_bytes,
+                           minishard_index_encoding, data_encoding,
+                           min_shards, max_labels_per_shard,
+                           spatial_index_db):
   from . import task_creation as tc
 
   enqueue(queue, tc.create_sharded_skeleton_merge_tasks(
     path, skel_dir=skel_dir, dust_threshold=dust_threshold,
     tick_threshold=tick_threshold, max_cable_length=max_cable_length,
+    shard_index_bytes=shard_index_bytes,
+    minishard_index_bytes=minishard_index_bytes,
+    minishard_index_encoding=minishard_index_encoding,
+    data_encoding=data_encoding, min_shards=min_shards,
+    max_labels_per_shard=max_labels_per_shard,
+    spatial_index_db=spatial_index_db,
   ), ctx.obj["parallel"])
 
 
@@ -769,24 +1210,30 @@ def skeleton_spatial_index():
 @click.option("--mip", default=0, show_default=True)
 @click.option("--shape", type=TUPLE3, default=(512, 512, 512), show_default=True)
 @click.option("--skel-dir", default=None)
+@click.option("--fill-missing", is_flag=True)
 @click.pass_context
-def skeleton_spatial_index_create(ctx, path, queue, mip, shape, skel_dir):
+def skeleton_spatial_index_create(ctx, path, queue, mip, shape, skel_dir,
+                                  fill_missing):
   """Rebuild the skeleton spatial index."""
   from . import task_creation as tc
   from .tasks.skeleton import skel_dir_for
   from .volume import Volume
 
   sdir = skel_dir_for(Volume(path), skel_dir)
-  enqueue(queue, tc.create_spatial_index_tasks(path, sdir, mip=mip,
-                                               shape=shape),
-          ctx.obj["parallel"])
+  enqueue(queue, tc.create_spatial_index_tasks(
+    path, sdir, mip=mip, shape=shape, fill_missing=fill_missing,
+  ), ctx.obj["parallel"])
 
 
 @skeleton_spatial_index.command("db")
 @click.argument("path")
 @click.argument("db_path", type=click.Path())
 @click.option("--skel-dir", default=None)
-def skeleton_spatial_index_db(path, db_path, skel_dir):
+@click.option("--progress", is_flag=True)
+@click.option("--allow-missing", is_flag=True,
+              help="Tolerate missing index files.")
+def skeleton_spatial_index_db(path, db_path, skel_dir, progress,
+                              allow_missing):
   """Materialize the skeleton spatial index into a sqlite database
   (reference `igneous skeleton spatial-index db`, cli.py:1565-1586)."""
   from .spatial_index import SpatialIndex
@@ -795,7 +1242,9 @@ def skeleton_spatial_index_db(path, db_path, skel_dir):
 
   vol = Volume(path)
   sdir = skel_dir_for(vol, skel_dir)
-  n = SpatialIndex(vol.cf, sdir).to_sqlite(db_path)
+  n = SpatialIndex(vol.cf, sdir).to_sqlite(
+    db_path, progress=progress, allow_missing=allow_missing,
+  )
   click.echo(f"wrote {n} rows to {db_path}")
 
 
@@ -822,12 +1271,20 @@ def skeleton_clean(path, skel_dir):
 @click.argument("src")
 @click.argument("dest")
 @click.option("--queue", "-q", default=None)
-@click.option("--skel-dir", default=None)
+@click.option("--skel-dir", "--dir", "skel_dir", default=None)
 @click.option("--magnitude", default=1, show_default=True)
+@click.option("--sharded", is_flag=True,
+              help="Convert unsharded skeletons to sharded format at the "
+                   "destination.")
 @click.pass_context
-def skeleton_xfer(ctx, src, dest, queue, skel_dir, magnitude):
+def skeleton_xfer(ctx, src, dest, queue, skel_dir, magnitude, sharded):
   from . import task_creation as tc
 
+  if sharded:
+    enqueue(queue, tc.create_sharded_from_unsharded_skeleton_merge_tasks(
+      src, dest_cloudpath=dest, skel_dir=skel_dir,
+    ), ctx.obj["parallel"])
+    return
   enqueue(queue, tc.create_skeleton_transfer_tasks(
     src, dest, skel_dir=skel_dir, magnitude=magnitude), ctx.obj["parallel"])
 
@@ -835,7 +1292,7 @@ def skeleton_xfer(ctx, src, dest, queue, skel_dir, magnitude):
 @skeleton.command("rm")
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
-@click.option("--skel-dir", default=None)
+@click.option("--skel-dir", "--dir", "skel_dir", default=None)
 @click.option("--magnitude", default=1, show_default=True)
 @click.pass_context
 def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
@@ -851,18 +1308,24 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
 
 @main.command("execute")
 @click.argument("queue_spec", required=False)
+@click.option("--aws-region", default=None,
+              help="AWS region of the SQS queue [default: $SQS_REGION_NAME].")
 @click.option("--lease-sec", default=None, type=int,
               help="Visibility timeout [default: $LEASE_SECONDS or 600].")
-@click.option("-n", "num_tasks", default=None, type=int,
+@click.option("--tally/--no-tally", default=True, show_default=True,
+              help="Tally completed fq:// tasks.")
+@click.option("-n", "--num-tasks", "num_tasks", default=None, type=int,
               help="Stop after N tasks.")
-@click.option("--exit-on-empty", is_flag=True)
+@click.option("-x", "--exit-on-empty", is_flag=True)
 @click.option("--min-sec", default=-1.0, show_default=True,
               help="Keep polling at least this long (<0: forever).")
+@click.option("-q", "--quiet", is_flag=True,
+              help="Suppress per-task status messages.")
 @click.option("--time", "timing", is_flag=True,
               help="Log per-task wall time + stage breakdown as JSON lines.")
 @click.pass_context
-def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-            timing):
+def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
+            exit_on_empty, min_sec, quiet, timing):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
@@ -874,6 +1337,8 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     raise click.UsageError("provide QUEUE_SPEC or set $QUEUE_URL")
   if lease_sec is None:
     lease_sec = secrets.lease_seconds()
+  if aws_region:
+    os.environ["SQS_REGION_NAME"] = aws_region
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp
@@ -888,7 +1353,7 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
       ctx_mp.Process(
         target=_execute_worker,
         args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-              timing),
+              timing, quiet, tally),
       )
       for _ in range(parallel)
     ]
@@ -898,11 +1363,11 @@ def execute(ctx, queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
       p.join()
     return
   _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                  timing)
+                  timing, quiet, tally)
 
 
 def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                    timing=False):
+                    timing=False, quiet=False, tally=True):
   import time
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
@@ -927,10 +1392,11 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     before_fn, after_fn = timed_poll_hooks()
 
   executed = tq.poll(
-    lease_seconds=lease_sec, verbose=True, stop_fn=stop_fn,
-    before_fn=before_fn, after_fn=after_fn,
+    lease_seconds=lease_sec, verbose=not quiet, stop_fn=stop_fn,
+    before_fn=before_fn, after_fn=after_fn, tally=tally,
   )
-  click.echo(f"executed {executed} tasks")
+  if not quiet:
+    click.echo(f"executed {executed} tasks")
 
 
 @main.group("queue")
@@ -964,11 +1430,13 @@ def queue_status(queue_spec, eta, sample_sec):
 
 @queue_group.command("wait")
 @click.argument("queue_spec")
-@click.option("--interval", default=5.0, show_default=True,
-              help="seconds between checks")
+@click.option("--interval", "--rate", "interval", default=5.0,
+              show_default=True, help="seconds between checks")
 @click.option("--timeout", default=None, type=float,
               help="give up after this many seconds")
-def queue_wait(queue_spec, interval, timeout):
+@click.option("--aws-region", default=None,
+              help="AWS region of the SQS queue.")
+def queue_wait(queue_spec, interval, timeout, aws_region):
   """Block until the queue is empty (reference `igneous queue wait`,
   cli.py:1974). Uses the backend's own emptiness semantics — for sqs://
   that includes the eventual-consistency double-confirmation."""
@@ -976,6 +1444,8 @@ def queue_wait(queue_spec, interval, timeout):
 
   from .queues import TaskQueue
 
+  if aws_region:
+    os.environ["SQS_REGION_NAME"] = aws_region
   q = TaskQueue(queue_spec)
   deadline = None if timeout is None else _time.monotonic() + timeout
   while True:
@@ -1059,31 +1529,55 @@ def design():
 @design.command("ds-memory")
 @click.argument("path")
 @click.option("--memory", default=int(3.5e9), show_default=True)
+@click.option("--mip", default=0, show_default=True)
 @click.option("--factor", type=TUPLE3, default=(2, 2, 1), show_default=True)
-def design_ds_memory(path, memory, factor):
-  """How many mips fit in a byte budget for PATH's chunk size."""
-  from .downsample_scales import num_mips_from_memory_target
+@click.option("--max-mips", default=None, type=int,
+              help="Cap the downsample count even if memory allows more.")
+@click.option("--verbose", is_flag=True)
+def design_ds_memory(path, memory, mip, factor, max_mips, verbose):
+  """Optimal task shape + mip count for a byte budget
+  (reference cli.py ds-memory)."""
+  from .downsample_scales import (
+    downsample_shape_from_memory_target,
+    num_mips_from_memory_target,
+  )
   from .volume import Volume
 
-  vol = Volume(path)
+  vol = Volume(path, mip=mip)
+  cs = vol.meta.chunk_size(mip)
   mips = num_mips_from_memory_target(
-    memory, vol.dtype.itemsize, vol.chunk_size, factor, vol.num_channels
+    memory, vol.dtype.itemsize, cs, factor, vol.num_channels
   )
-  click.echo(f"mips achievable in {memory:.2e} bytes: {mips}")
+  if max_mips is not None:
+    mips = min(mips, max_mips)
+  shape = downsample_shape_from_memory_target(
+    vol.dtype.itemsize, int(cs.x), int(cs.y), int(cs.z), factor, memory,
+    max_mips=max_mips, num_channels=vol.num_channels,
+  )
+  if verbose:
+    click.echo(f"Data width: {vol.dtype.itemsize} B")
+    click.echo(f"Chunk size: {int(cs.x)},{int(cs.y)},{int(cs.z)}")
+    click.echo(f"Memory limit: {memory:.2e} B")
+    click.echo(f"Optimized shape: {','.join(str(int(v)) for v in shape)}")
+    click.echo(f"Downsamples: {mips}")
+  else:
+    click.echo(f"mips achievable in {memory:.2e} bytes: {mips}")
+    click.echo(",".join(str(int(v)) for v in shape))
 
 
 @design.command("ds-shape")
 @click.argument("path")
 @click.option("--memory", default=int(3.5e9), show_default=True)
+@click.option("--mip", default=0, show_default=True)
 @click.option("--factor", type=TUPLE3, default=(2, 2, 1), show_default=True)
 @click.option("--num-mips", default=None, type=int)
-def design_ds_shape(path, memory, factor, num_mips):
+def design_ds_shape(path, memory, mip, factor, num_mips):
   """Optimal task shape for a byte budget."""
   from .downsample_scales import downsample_shape_from_memory_target
   from .volume import Volume
 
-  vol = Volume(path)
-  cs = vol.chunk_size
+  vol = Volume(path, mip=mip)
+  cs = vol.meta.chunk_size(mip)
   shape = downsample_shape_from_memory_target(
     vol.dtype.itemsize, int(cs.x), int(cs.y), int(cs.z), factor, memory,
     max_mips=num_mips, num_channels=vol.num_channels,
@@ -1119,12 +1613,34 @@ def design_bounds(path, mip):
 @main.command("view")
 @click.argument("path")
 @click.option("--port", default=1337, show_default=True)
-def view_cmd(path, port):
+@click.option("--browser/--no-browser", default=True, show_default=True,
+              help="Open the link in the system browser.")
+@click.option("--ng", default=None,
+              help="Alternative Neuroglancer deployment URL.")
+@click.option("--pos", type=TUPLE3, default=None,
+              help="Open the view centered at this voxel position.")
+@click.option("--name", default=None, help="Custom layer name.")
+@click.option("--indirect", is_flag=True,
+              help="Parity flag: the reference routes through CloudVolume "
+                   "for private buckets; this build always serves the "
+                   "local file server, which covers that case.")
+def view_cmd(path, port, browser, ng, pos, name, indirect):
   """Serve PATH locally and print a Neuroglancer link
   (reference cli.py:1735-1850)."""
+  import socket
+
   from .view import serve
 
-  serve(path, port=port, block=True)
+  # skip to a free port like the reference (cli.py:1748-1754)
+  for _ in range(10):
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+      if sock.connect_ex(("localhost", port)) != 0:
+        break
+    port += 1
+  serve(
+    path, port=port, block=True, browser=browser, ng_url=ng, position=pos,
+    layer_name=name,
+  )
 
 
 @main.command("license")

@@ -96,7 +96,10 @@ def make_handler(cf: CloudFiles):
   return Handler
 
 
-def neuroglancer_url(port: int, layer_name: str, layer_type: str) -> str:
+def neuroglancer_url(
+  port: int, layer_name: str, layer_type: str,
+  ng_url: "str | None" = None, position=None,
+) -> str:
   state = {
     "layers": [
       {
@@ -106,24 +109,40 @@ def neuroglancer_url(port: int, layer_name: str, layer_type: str) -> str:
       }
     ],
   }
+  if position is not None:
+    state["position"] = [float(v) for v in position]
   fragment = json.dumps(state, separators=(",", ":"))
-  return f"https://neuroglancer-demo.appspot.com/#!{fragment}"
+  base = (ng_url or "https://neuroglancer-demo.appspot.com/").rstrip("/")
+  return f"{base}/#!{fragment}"
 
 
 def serve(
   cloudpath: str,
   port: int = 1337,
   block: bool = True,
+  browser: bool = False,
+  ng_url: "str | None" = None,
+  position=None,
+  layer_name: "str | None" = None,
 ) -> Optional[ThreadingHTTPServer]:
-  """Serve a layer for Neuroglancer; returns the server when block=False."""
+  """Serve a layer for Neuroglancer; returns the server when block=False.
+  ``browser`` opens the link in the system browser; ``ng_url`` swaps the
+  Neuroglancer deployment; ``position`` centers the view (reference
+  `igneous view` --browser/--ng/--pos/--name, cli.py:1735-1850)."""
   cf = CloudFiles(cloudpath)
   httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(cf))
   port = httpd.server_address[1]  # resolves port=0 to the bound port
   info = cf.get_json("info") or {}
-  url = neuroglancer_url(port, cloudpath.rstrip("/").split("/")[-1],
-                         info.get("type", "image"))
+  url = neuroglancer_url(
+    port, layer_name or cloudpath.rstrip("/").split("/")[-1],
+    info.get("type", "image"), ng_url=ng_url, position=position,
+  )
   print(f"Serving {cloudpath} at http://localhost:{port}")
   print(f"View in Neuroglancer:\n  {url}")
+  if browser:
+    import webbrowser
+
+    webbrowser.open(url, new=2)
   if block:
     try:
       httpd.serve_forever()

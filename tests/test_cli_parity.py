@@ -1,0 +1,401 @@
+"""Flag-level CLI/factory parity features added in round 3.
+
+Covers the reference options wired through this round: CCL --dust,
+bounds ranges (--xrange/--yrange/--zrange), ROI long tail
+(suppress-faint / z-step / max-axial-len), voxels sum -o/--compress,
+reorder --mapping-file, CLAHE tile-grid pairs, and create --seg.
+Reference: /root/reference/igneous_cli/cli.py (cited per test).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.storage import clear_memory_storage
+from igneous_tpu.volume import Volume
+
+
+@pytest.fixture(autouse=True)
+def _clean_mem():
+  clear_memory_storage()
+  yield
+  clear_memory_storage()
+
+
+def tq():
+  return LocalTaskQueue(parallel=1, progress=False)
+
+
+# -- CCL dust ----------------------------------------------------------------
+
+
+def test_ccl_dust_removes_small_components():
+  from igneous_tpu.ops.ccl import dust
+
+  labels = np.zeros((12, 12, 4), dtype=np.uint32)
+  labels[0:6, 0:6, :] = 7          # 144 voxels: survives
+  labels[10:12, 10:12, 0:1] = 9    # 4 voxels: dusted
+  out = dust(labels, threshold=10, connectivity=6)
+  assert np.all(out[0:6, 0:6, :] == 7)
+  assert np.all(out[10:12, 10:12, 0:1] == 0)
+  # original untouched without in_place
+  assert labels[10, 10, 0] == 9
+
+
+def test_ccl_auto_with_dust():
+  """Reference ccl.py:167-171: dust inside every pass keeps the 4 passes'
+  recomputed labels identical, so the pipeline still converges."""
+  img = np.zeros((64, 64, 32), dtype=np.uint8)
+  img[4:30, 4:30, :] = 200      # big object
+  img[40:42, 40:42, 0:2] = 200  # 8-voxel speck: dusted away
+  Volume.from_numpy(img, "mem://ccl/src", chunk_size=(32, 32, 16),
+                    layer_type="image")
+  n = tc.ccl_auto(
+    "mem://ccl/src", "mem://ccl/dest", shape=(32, 32, 32), queue=tq(),
+    threshold_gte=100.0, dust_threshold=10,
+  )
+  assert n == 1  # only the big object
+  dest = Volume("mem://ccl/dest")
+  seg = dest.download(dest.bounds)[..., 0]
+  assert np.all(seg[40:42, 40:42, 0:2] == 0)
+  assert len(np.unique(seg[4:30, 4:30, :])) == 1
+
+
+# -- ROI long tail -----------------------------------------------------------
+
+
+def test_compute_rois_suppress_and_zstep():
+  img = np.zeros((64, 64, 8), dtype=np.uint8)
+  img[8:24, 8:24, 0:4] = 200    # bright tissue, z slab 0
+  img[40:56, 40:56, 4:8] = 200  # bright tissue, z slab 1
+  img[0:4, 60:64, :] = 3        # faint smear
+  Volume.from_numpy(img, "mem://roi/v", chunk_size=(32, 32, 8),
+                    layer_type="image")
+  rois = tc.compute_rois(
+    "mem://roi/v", mip=0, suppress_faint_voxels=10, dust_threshold=10,
+    z_step=4,
+  )
+  # faint smear suppressed; the two slabs give separate boxes
+  assert len(rois) == 2
+  zs = sorted(int(r.minpt[2]) for r in rois)
+  assert zs == [0, 4]
+
+
+def test_compute_rois_max_axial_downsample():
+  img = np.zeros((128, 128, 4), dtype=np.uint8)
+  img[16:112, 16:112, :] = 250
+  Volume.from_numpy(img, "mem://roi/big", chunk_size=(64, 64, 4),
+                    layer_type="image")
+  rois = tc.compute_rois(
+    "mem://roi/big", mip=0, max_axial_length=32, dust_threshold=1,
+  )
+  assert len(rois) == 1
+  # coords are scaled back to full resolution (within one 4x cell)
+  assert abs(int(rois[0].minpt[0]) - 16) <= 4
+  assert abs(int(rois[0].maxpt[0]) - 112) <= 4
+
+
+# -- voxels sum output -------------------------------------------------------
+
+
+def test_voxel_sum_compress_and_local_output(tmp_path):
+  seg = np.zeros((32, 32, 16), dtype=np.uint64)
+  seg[:16] = 5
+  Volume.from_numpy(seg, "mem://vx/v", chunk_size=(16, 16, 16),
+                    layer_type="segmentation")
+  tq().insert(tc.create_voxel_counting_tasks("mem://vx/v", shape=(32, 32, 16)))
+  out = tmp_path / "counts.im"
+  totals = tc.accumulate_voxel_counts(
+    "mem://vx/v", 0, compress="gzip", additional_output=str(out),
+  )
+  assert totals[5] == 16 * 32 * 16
+  from igneous_tpu.tasks.stats import load_voxel_counts
+  from igneous_tpu.mesh_io import FragMap
+
+  im = load_voxel_counts("mem://vx/v", 0)
+  assert im is not None
+  local = FragMap.frombytes(out.read_bytes())
+  assert set(local.keys()) == set(im.keys())
+
+
+# -- CLI flag wiring ---------------------------------------------------------
+
+
+def test_cli_downsample_ranges(tmp_path):
+  from igneous_tpu.cli import main
+
+  img = np.random.default_rng(0).integers(0, 255, (128, 64, 16)).astype(np.uint8)
+  path = f"file://{tmp_path}/v"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 16), layer_type="image")
+  r = CliRunner().invoke(main, [
+    "image", "downsample", path, "--num-mips", "1",
+    "--xrange", "0,64", "--yrange", "0,64", "--zrange", "0,16",
+  ])
+  assert r.exit_code == 0, r.output
+  v1 = Volume(path, mip=1)
+  got = v1.download(Bbox((0, 0, 0), (32, 32, 16)))[..., 0]
+  from igneous_tpu.ops import oracle
+
+  want = oracle.np_downsample_with_averaging(img[:64], (2, 2, 1), 1)[0]
+  np.testing.assert_array_equal(got, want[:32, :32])
+  # outside the restricted range nothing was written
+  missing = v1.cf.get(v1.meta.chunk_name(1, Bbox((32, 0, 0), (64, 32, 16))))
+  assert missing is None
+
+
+def test_cli_reorder_mapping_file(tmp_path):
+  from igneous_tpu.cli import main
+
+  img = np.stack(
+    [np.full((16, 16), z, dtype=np.uint8) for z in range(8)], axis=-1
+  )
+  src = f"file://{tmp_path}/src"
+  dest = f"file://{tmp_path}/dest"
+  Volume.from_numpy(img, src, chunk_size=(16, 16, 1), layer_type="image")
+  mf = tmp_path / "map.json"
+  mf.write_text(json.dumps({"0": 7, "7": 0}))
+  r = CliRunner().invoke(main, [
+    "image", "reorder", src, dest, "--mapping-file", str(mf),
+  ])
+  assert r.exit_code == 0, r.output
+  v = Volume(dest)
+  out = v.download(v.bounds)[..., 0]
+  assert out[0, 0, 0] == 7 and out[0, 0, 7] == 0 and out[0, 0, 3] == 3
+
+
+def test_cli_create_seg_flag(tmp_path):
+  from igneous_tpu.cli import main
+
+  arr = np.random.default_rng(0).integers(0, 9, (24, 24, 8)).astype(np.uint8)
+  npy = tmp_path / "in.npy"
+  np.save(npy, arr)
+  dest = f"file://{tmp_path}/seg"
+  r = CliRunner().invoke(main, [
+    "image", "create", str(npy), dest, "--seg", "--chunk-size", "16,16,8",
+  ])
+  assert r.exit_code == 0, r.output
+  assert Volume(dest).layer_type == "segmentation"
+
+
+def test_cli_clahe_tile_grid_pair(tmp_path):
+  from igneous_tpu.cli import main
+
+  img = np.random.default_rng(0).integers(0, 255, (64, 64, 2)).astype(np.uint8)
+  src = f"file://{tmp_path}/c_src"
+  dest = f"file://{tmp_path}/c_dest"
+  Volume.from_numpy(img, src, chunk_size=(64, 64, 2), layer_type="image")
+  Volume.from_numpy(np.zeros_like(img), dest, chunk_size=(64, 64, 2),
+                    layer_type="image")
+  r = CliRunner().invoke(main, [
+    "image", "contrast", "clahe", src, dest, "--tile-grid-size", "4,8",
+    "--shape", "64,64,2",
+  ])
+  assert r.exit_code == 0, r.output
+  v = Volume(dest)
+  out = v.download(v.bounds)[..., 0]
+  assert out.std() > 0  # CLAHE wrote something non-trivial
+
+
+def test_cli_rm_with_bounds(tmp_path):
+  from igneous_tpu.cli import main
+
+  img = np.random.default_rng(0).integers(0, 255, (64, 32, 16)).astype(np.uint8)
+  path = f"file://{tmp_path}/rmv"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 16), layer_type="image")
+  r = CliRunner().invoke(main, [
+    "image", "rm", path, "--xrange", "0,32", "--shape", "32,32,16",
+  ])
+  assert r.exit_code == 0, r.output
+  v = Volume(path)
+  assert v.cf.get(v.meta.chunk_name(0, Bbox((0, 0, 0), (32, 32, 16)))) is None
+  assert v.cf.get(v.meta.chunk_name(0, Bbox((32, 0, 0), (64, 32, 16)))) is not None
+
+
+# -- skeleton/mesh round-3 parity features -----------------------------------
+
+
+def _seg_volume(path, shape=(48, 24, 24), chunk=(24, 24, 24)):
+  seg = np.zeros(shape, dtype=np.uint64)
+  seg[4:44, 6:18, 6:18] = 7
+  Volume.from_numpy(seg, path, chunk_size=chunk, layer_type="segmentation")
+  return seg
+
+
+def test_skeleton_frag_path_output(tmp_path):
+  """--output/-o: stage-1 fragments land in a different bucket while the
+  segmentation volume stays untouched (reference frag_path)."""
+  _seg_volume("mem://sk/seg")
+  out = f"file://{tmp_path}/frags"
+  tq().insert(tc.create_skeletonizing_tasks(
+    "mem://sk/seg", shape=(48, 24, 24), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 40}, frag_path=out,
+    spatial_index=True,
+  ))
+  files = [
+    f[:-3] if f.endswith(".gz") else f
+    for f in os.listdir(f"{tmp_path}/frags/skeletons_mip_0")
+  ]
+  assert any(f.endswith(".sk") for f in files)
+  assert any(f.endswith(".spatial") for f in files)
+  # nothing was written into the source bucket's skeleton dir
+  vol = Volume("mem://sk/seg")
+  assert not [k for k in vol.cf.list("skeletons_mip_0/") if k.endswith(".sk")]
+
+
+def test_skeleton_csa_repair_budget_zero(monkeypatch):
+  """--cross-section-label-repair-sec 0 disables the repair pass."""
+  from igneous_tpu.tasks.skeleton import SkeletonTask
+
+  calls = []
+  monkeypatch.setattr(
+    SkeletonTask, "_repair_csa_contacts",
+    lambda self, *a, **k: calls.append(1),
+  )
+  _seg_volume("mem://sk2/seg")
+  tq().insert(tc.create_skeletonizing_tasks(
+    "mem://sk2/seg", shape=(48, 24, 24), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 40}, cross_sectional_area=True,
+    csa_repair_sec_per_label=0,
+  ))
+  assert calls == []
+  tq().insert(tc.create_skeletonizing_tasks(
+    "mem://sk2/seg", shape=(48, 24, 24), dust_threshold=10,
+    teasar_params={"scale": 4, "const": 40}, cross_sectional_area=True,
+  ))
+  assert calls  # default (-1) repairs
+
+
+def test_fix_autapses_requires_graphene():
+  _seg_volume("mem://sk3/seg")
+  with pytest.raises(ValueError, match="graphene"):
+    list(tc.create_skeletonizing_tasks(
+      "mem://sk3/seg", shape=(48, 24, 24), fix_autapses=True,
+    ))
+
+
+def test_mesh_dust_global(tmp_path):
+  """An object straddling two mesh tasks survives global dusting that
+  would kill either half (reference mesh.py dust_global)."""
+  seg = np.zeros((64, 16, 16), dtype=np.uint64)
+  seg[8:56, 4:12, 4:12] = 5  # 48x8x8 = 3072 voxels, ~1536 per task half
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(seg, path, chunk_size=(32, 16, 16),
+                    layer_type="segmentation")
+  tq().insert(tc.create_voxel_counting_tasks(path, shape=(64, 16, 16)))
+  tc.accumulate_voxel_counts(path, 0)
+  tq().insert(tc.create_meshing_tasks(
+    path, shape=(32, 16, 16), dust_threshold=2000, dust_global=True,
+    sharded=False, spatial_index=False,
+  ))
+  vol = Volume(path)
+  mdir = vol.info["mesh"]
+  frags = [k for k in vol.cf.list(f"{mdir}/") if ":0:" in k]
+  assert len(frags) == 2  # both halves meshed (2000 < 3072 global)
+  # per-cutout dusting at the same threshold would have dropped both
+  tq().insert(tc.create_meshing_tasks(
+    path, shape=(32, 16, 16), dust_threshold=2000, dust_global=False,
+    sharded=False, spatial_index=False, mesh_dir="mesh_local",
+  ))
+  assert not [k for k in vol.cf.list("mesh_local/") if ":0:" in k]
+
+
+def test_multires_min_chunk_size_caps_lods():
+  from igneous_tpu.mesh_io import Mesh
+  from igneous_tpu.mesh_multires import process_mesh
+
+  g = np.indices((24, 24, 24)).astype(np.float32) - 11.5
+  mask = (np.sqrt((g**2).sum(0)) < 9).astype(np.uint8)
+  from igneous_tpu.ops.mesh import marching_cubes
+
+  verts, faces = marching_cubes(mask, anisotropy=(1, 1, 1))
+  manifest_big, _ = process_mesh(Mesh(verts, faces), num_lods=3)
+  import struct as _struct
+
+  num_lods_big = _struct.unpack("<I", manifest_big[24:28])[0]
+  assert num_lods_big == 3
+  # a min chunk as large as the mesh forces a single LOD
+  manifest_capped, _ = process_mesh(
+    Mesh(verts, faces), num_lods=3, min_chunk_size=(64, 64, 64),
+  )
+  assert _struct.unpack("<I", manifest_capped[24:28])[0] == 1
+
+
+def test_sharded_multires_spatial_index_db(tmp_path):
+  """--spatial-index-db: the label census comes from the sqlite export."""
+  seg = np.zeros((32, 16, 16), dtype=np.uint64)
+  seg[2:30, 4:12, 4:12] = 9
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(seg, path, chunk_size=(32, 16, 16),
+                    layer_type="segmentation")
+  tq().insert(tc.create_meshing_tasks(
+    path, shape=(32, 16, 16), sharded=True, spatial_index=True,
+  ))
+  vol = Volume(path)
+  mdir = vol.info["mesh"]
+  from igneous_tpu.spatial_index import SpatialIndex
+
+  db = str(tmp_path / "si.db")
+  SpatialIndex(vol.cf, mdir).to_sqlite(db)
+  assert SpatialIndex.query_sqlite(db) == {9}
+  tq().insert(tc.create_sharded_multires_mesh_tasks(
+    path, num_lods=2, spatial_index_db=db,
+  ))
+  shards = [k for k in vol.cf.list(f"{mdir}/") if k.endswith(".shard")]
+  assert shards
+
+
+# -- review regressions ------------------------------------------------------
+
+
+def test_contrast_bounds_default_to_mip(tmp_path):
+  """--xrange on contrast commands is interpreted at --mip when
+  --bounds-mip is omitted (review regression: it was treated as mip 0)."""
+  from igneous_tpu.cli import main
+
+  img = np.random.default_rng(0).integers(10, 250, (64, 32, 8)).astype(np.uint8)
+  path = f"file://{tmp_path}/cv"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 8), layer_type="image")
+  r = CliRunner().invoke(main, ["image", "downsample", path, "--num-mips", "1"])
+  assert r.exit_code == 0, r.output
+  # histogram restricted to x 0..16 AT MIP 1 (= 0..32 at mip 0)
+  r = CliRunner().invoke(main, [
+    "image", "contrast", "histogram", path, "--mip", "1",
+    "--xrange", "0,16", "--yrange", "0,16", "--zrange", "0,8",
+  ])
+  assert r.exit_code == 0, r.output
+  v = Volume(path)
+  levels = [k for k in v.cf.list("levels/")]
+  assert levels  # histograms produced for the restricted region
+
+
+def test_create_encoding_level_applies_to_ingest(tmp_path):
+  """--encoding-level must be set before the upload so ingested chunks
+  honor it (review regression)."""
+  from igneous_tpu.cli import main
+
+  rng = np.random.default_rng(0)
+  x = np.linspace(0, 6, 64)
+  smooth = (127 + 120 * np.sin(x)[:, None, None] * np.cos(x)[None, :, None]
+            * np.ones((1, 1, 8))).astype(np.uint8)
+  npy = tmp_path / "in.npy"
+  np.save(npy, smooth)
+  lo = f"file://{tmp_path}/q30"
+  hi = f"file://{tmp_path}/q95"
+  for dest, q in ((lo, "30"), (hi, "95")):
+    r = CliRunner().invoke(main, [
+      "image", "create", str(npy), dest, "--encoding", "jpeg",
+      "--encoding-level", q, "--chunk-size", "64,64,8", "--compress", "none",
+    ])
+    assert r.exit_code == 0, r.output
+  import os as _os
+
+  size = lambda d: sum(
+    _os.path.getsize(f"{d}/1_1_1/{f}") for f in _os.listdir(f"{d}/1_1_1")
+  )
+  assert size(f"{tmp_path}/q30") < size(f"{tmp_path}/q95")

@@ -131,7 +131,7 @@ def test_graphene_skeleton_autapse_fix(tmp_path):
   )
   run(tc.create_skeletonizing_tasks(
     gpath, shape=(64, 16, 16), dust_threshold=10,
-    teasar_params={"scale": 4, "const": 50},
+    teasar_params={"scale": 4, "const": 50}, fix_autapses=True,
   ))
   vol = Volume(gpath)
   sdir = vol.info["skeletons"]
