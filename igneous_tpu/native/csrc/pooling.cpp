@@ -20,31 +20,114 @@
 // windows clamp at the high edge (edge padding, matching the oracle)
 static inline long clamp_idx(long v, long n) { return v < n ? v : n - 1; }
 
+static void avg_u8_generic(const uint8_t *in, uint8_t *out, long nx, long ny,
+                           long nz, long fx, long fy, long fz, long x,
+                           long y) {
+  // one (x, y) output column, any factor, clamped (edge-replicating)
+  const long oy = (ny + fy - 1) / fy, oz = (nz + fz - 1) / fz;
+  const long n = fx * fy * fz;
+  const long syx = ny * nz, syy = nz;
+  for (long z = 0; z < oz; ++z) {
+    long acc = 0;
+    for (long dx = 0; dx < fx; ++dx) {
+      const long sx = clamp_idx(x * fx + dx, nx);
+      for (long dy = 0; dy < fy; ++dy) {
+        const long sy = clamp_idx(y * fy + dy, ny);
+        const uint8_t *row = in + sx * syx + sy * syy;
+        for (long dz = 0; dz < fz; ++dz) {
+          acc += row[clamp_idx(z * fz + dz, nz)];
+        }
+      }
+    }
+    out[x * oy * oz + y * oz + z] = (uint8_t)((acc + n / 2) / n);
+  }
+}
+
 static void avg_u8_range(const uint8_t *in, uint8_t *out, long nx, long ny,
                          long nz, long fx, long fy, long fz, long ox0,
                          long ox1) {
   const long oy = (ny + fy - 1) / fy, oz = (nz + fz - 1) / fz;
-  const long n = fx * fy * fz;
   const long syx = ny * nz;  // x stride
   const long syy = nz;       // y stride
+  // interior extents where no window needs clamping
+  const long ix = nx / fx, iy = ny / fy, iz = nz / fz;
+  const bool f221 = (fx == 2 && fy == 2 && fz == 1);
+  const bool f222 = (fx == 2 && fy == 2 && fz == 2);
+  const bool f122 = (fx == 1 && fy == 2 && fz == 2);
   for (long x = ox0; x < ox1; ++x) {
+    const bool x_in = x < ix;
+    if (f122) {
+      // (1,2,2): x untouched — pool the (y, z) plane; the transposed-call
+      // form of a logical 2x2x1 pool on Fortran-ordered data
+      const uint8_t *cx = in + x * syx;
+      uint8_t *ox_ = out + x * oy * oz;
+      for (long y = 0; y < iy; ++y) {
+        const uint8_t *r0 = cx + (y * 2) * syy;
+        const uint8_t *r1 = r0 + syy;
+        uint8_t *o = ox_ + y * oz;
+        for (long z = 0; z < iz; ++z) {
+          const long s = 2 * z;
+          o[z] = (uint8_t)(((unsigned)r0[s] + r0[s + 1] + r1[s] +
+                            r1[s + 1] + 2u) >> 2);
+        }
+        if (iz < oz) {
+          const long s = nz - 1;
+          o[iz] = (uint8_t)((2u * ((unsigned)r0[s] + r1[s]) + 2u) >> 2);
+        }
+      }
+      for (long y = iy; y < oy; ++y) {
+        avg_u8_generic(in, out, nx, ny, nz, fx, fy, fz, x, y);
+      }
+      continue;
+    }
     for (long y = 0; y < oy; ++y) {
-      for (long z = 0; z < oz; ++z) {
-        long acc = 0;
-        for (long dx = 0; dx < fx; ++dx) {
-          const long sx = clamp_idx(x * fx + dx, nx);
-          for (long dy = 0; dy < fy; ++dy) {
-            const long sy = clamp_idx(y * fy + dy, ny);
-            const uint8_t *row = in + sx * syx + sy * syy;
-            for (long dz = 0; dz < fz; ++dz) {
-              acc += row[clamp_idx(z * fz + dz, nz)];
-            }
+      if ((f221 || f222) && x_in && y < iy) {
+        // clamp-free rows: the inner z loop is contiguous and
+        // auto-vectorizes (this is where ~all voxels of a 2x2x{1,2}
+        // pyramid live — boundary columns fall through to the
+        // clamped generic path below)
+        const uint8_t *r00 = in + (x * 2) * syx + (y * 2) * syy;
+        const uint8_t *r01 = r00 + syy;
+        const uint8_t *r10 = r00 + syx;
+        const uint8_t *r11 = r10 + syy;
+        uint8_t *o = out + x * oy * oz + y * oz;
+        if (f221) {
+          for (long z = 0; z < oz; ++z) {
+            o[z] = (uint8_t)(((unsigned)r00[z] + r01[z] + r10[z] + r11[z] +
+                              2u) >> 2);
+          }
+        } else {
+          for (long z = 0; z < iz; ++z) {
+            const long s = 2 * z;
+            o[z] = (uint8_t)(((unsigned)r00[s] + r00[s + 1] + r01[s] +
+                              r01[s + 1] + r10[s] + r10[s + 1] + r11[s] +
+                              r11[s + 1] + 4u) >> 3);
+          }
+          if (iz < oz) {  // odd nz: last output plane replicates the edge
+            const long s = 2 * iz < nz ? 2 * iz : nz - 1;
+            o[iz] = (uint8_t)((2u * ((unsigned)r00[s] + r01[s] + r10[s] +
+                                     r11[s]) + 4u) >> 3);
           }
         }
-        out[x * oy * oz + y * oz + z] = (uint8_t)((acc + n / 2) / n);
+        continue;
       }
+      avg_u8_generic(in, out, nx, ny, nz, fx, fy, fz, x, y);
     }
   }
+}
+
+static inline uint64_t mode_vote(const uint64_t *vals, long n, int sparse) {
+  long best = -1, best_count = -1;
+  for (long i = 0; i < n; ++i) {
+    if (sparse && vals[i] == 0) continue;
+    long count = 0;
+    for (long j = 0; j < n; ++j) count += (vals[j] == vals[i]);
+    if (count > best_count) {
+      best_count = count;
+      best = i;
+    }
+  }
+  return (best < 0) ? 0 : vals[best];
 }
 
 static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
@@ -53,8 +136,115 @@ static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
   const long oy = (ny + fy - 1) / fy, oz = (nz + fz - 1) / fz;
   const long n = fx * fy * fz;
   const long syx = ny * nz, syy = nz;
+  const long ix = nx / fx, iy = ny / fy, iz = nz / fz;
+  const bool f221 = (fx == 2 && fy == 2 && fz == 1);
+  const bool f122 = (fx == 1 && fy == 2 && fz == 2);
   std::vector<uint64_t> vals((size_t)n);
   for (long x = ox0; x < ox1; ++x) {
+    if (f122) {
+      // (1,2,2): the transposed-call form of a logical 2x2x1 mode pool on
+      // Fortran-ordered data. Tie-breaking note: for a 2x2 window the
+      // value at corner (0,0) has the minimum position index under BOTH
+      // traversal orders, and any maximal-count tie always includes that
+      // corner's value or a unique count-2 value — so this order is
+      // exactly equivalent to the logical (dx fastest) order (see
+      // tests: host path vs oracle across transposed layouts).
+      const uint64_t *cx = in + x * syx;
+      uint64_t *ox_ = out + x * oy * oz;
+      for (long y = 0; y < iy; ++y) {
+        const uint64_t *r0 = cx + (y * 2) * syy;
+        const uint64_t *r1 = r0 + syy;
+        uint64_t *o = ox_ + y * oz;
+        for (long z = 0; z < iz; ++z) {
+          const long s = 2 * z;
+          const uint64_t v0 = r0[s], v1 = r1[s], v2 = r0[s + 1],
+                         v3 = r1[s + 1];
+          uint64_t r;
+          if (v0 == v1 && v1 == v2 && v2 == v3) {
+            r = v0;
+          } else if (!sparse) {
+            if (v0 == v1 || v0 == v2 || v0 == v3) r = v0;
+            else if (v1 == v2 || v1 == v3) r = v1;
+            else if (v2 == v3) r = v2;
+            else r = v0;
+          } else {
+            // sparse vote excludes zeros, so the 2x2 order-equivalence
+            // argument no longer holds — gather in the REQUIRED position
+            // order (logical dx fastest: v0, v2, v1, v3)
+            const uint64_t w[4] = {v0, v2, v1, v3};
+            r = mode_vote(w, 4, 1);
+          }
+          o[z] = r;
+        }
+        if (iz < oz) {
+          const long s = nz - 1;
+          // required order with the logical-x window clamped: (dx0,dy0),
+          // (dx1,dy0), (dx0,dy1), (dx1,dy1) with both dx hitting s
+          const uint64_t w[4] = {r0[s], r0[s], r1[s], r1[s]};
+          o[iz] = mode_vote(w, 4, sparse);
+        }
+      }
+      for (long y = iy; y < oy; ++y) {
+        uint64_t *o = ox_ + y * oz;
+        const long sy0 = clamp_idx(y * 2, ny), sy1 = clamp_idx(y * 2 + 1, ny);
+        const uint64_t *r0 = cx + sy0 * syy;
+        const uint64_t *r1 = cx + sy1 * syy;
+        for (long z = 0; z < oz; ++z) {
+          const long s0 = clamp_idx(z * 2, nz), s1 = clamp_idx(z * 2 + 1, nz);
+          // required position order (logical dx fastest)
+          const uint64_t w[4] = {r0[s0], r0[s1], r1[s0], r1[s1]};
+          o[z] = mode_vote(w, 4, sparse);
+        }
+      }
+      continue;
+    }
+    if (f221 && x < ix) {
+      // clamp-free 2x2x1 columns: direct row pointers, the exact
+      // max-count/earliest-position vote as a branch waterfall.
+      // Window position order is z-major → (dy, dx):
+      //   v0=(0,0) v1=(0,1)=x+1 v2=(1,0)=y+1 v3=(1,1)
+      const uint64_t *c00 = in + (x * 2) * syx;
+      for (long y = 0; y < iy; ++y) {
+        const uint64_t *r00 = c00 + (y * 2) * syy;
+        const uint64_t *r01 = r00 + syy;       // y+1 → position v2
+        const uint64_t *r10 = r00 + syx;       // x+1 → position v1
+        const uint64_t *r11 = r10 + syy;
+        uint64_t *o = out + x * oy * oz + y * oz;
+        for (long z = 0; z < oz; ++z) {
+          const uint64_t v0 = r00[z], v1 = r10[z], v2 = r01[z], v3 = r11[z];
+          uint64_t r;
+          if (v0 == v1 && v1 == v2 && v2 == v3) {
+            r = v0;  // uniform window (the common case in real labels)
+          } else if (!sparse) {
+            // count>=2 for v0 means nothing both out-counts it and sits
+            // earlier (a count-3 rival would have to include v0 itself)
+            if (v0 == v1 || v0 == v2 || v0 == v3) r = v0;
+            else if (v1 == v2 || v1 == v3) r = v1;
+            else if (v2 == v3) r = v2;
+            else r = v0;  // all distinct: earliest position wins
+          } else {
+            const uint64_t w[4] = {v0, v1, v2, v3};
+            r = mode_vote(w, 4, 1);
+          }
+          o[z] = r;
+        }
+      }
+      // boundary y columns (clamped) fall through to the generic path
+      for (long y = iy; y < oy; ++y) {
+        for (long z = 0; z < oz; ++z) {
+          long k = 0;
+          for (long dy = 0; dy < fy; ++dy) {
+            const long sy = clamp_idx(y * fy + dy, ny);
+            for (long dx = 0; dx < fx; ++dx) {
+              const long sx = clamp_idx(x * fx + dx, nx);
+              vals[(size_t)k++] = in[sx * syx + sy * syy + z];
+            }
+          }
+          out[x * oy * oz + y * oz + z] = mode_vote(vals.data(), n, sparse);
+        }
+      }
+      continue;
+    }
     for (long y = 0; y < oy; ++y) {
       for (long z = 0; z < oz; ++z) {
         // gather in z-major window order (dz outer, then dy, then dx) to
@@ -70,17 +260,12 @@ static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
             }
           }
         }
-        long best = -1, best_count = -1;
-        for (long i = 0; i < n; ++i) {
-          if (sparse && vals[(size_t)i] == 0) continue;
-          long count = 0;
-          for (long j = 0; j < n; ++j) count += (vals[(size_t)j] == vals[(size_t)i]);
-          if (count > best_count) {
-            best_count = count;
-            best = i;
-          }
-        }
-        out[x * oy * oz + y * oz + z] = (best < 0) ? 0 : vals[(size_t)best];
+        // uniform-window early exit: real segmentation windows are
+        // overwhelmingly single-label, so skip the O(n^2) vote
+        bool uniform = true;
+        for (long i = 1; i < n; ++i) uniform &= (vals[(size_t)i] == vals[0]);
+        out[x * oy * oz + y * oz + z] =
+          uniform ? vals[0] : mode_vote(vals.data(), n, sparse);
       }
     }
   }

@@ -468,25 +468,48 @@ def host_downsample(
         *dims, *f, int(bool(sparse)), int(parallel),
       )
 
+  # Transposed-call layout trick: a Fortran-ordered (x, y, z) cutout IS a
+  # C-ordered (z, y, x) array, so the kernel can run on it directly with
+  # reversed dims/factors — no ascontiguousarray transpose-copy (which
+  # otherwise dominates the whole pyramid's wall clock). Exact for
+  # average at any factor (order-free sum); for mode only at 2x2 windows,
+  # where the earliest-position tie-break provably coincides across both
+  # traversal orders (see pooling.cpp f122 note + layout tests).
+  def mode_transpose_ok(f):
+    return method == "average" or (f[2] == 1 and f[0] == 2 and f[1] == 2)
+
   nchan = work.shape[3]
   chan_outs: List[List[np.ndarray]] = []
   for c in range(nchan):
-    cur = np.ascontiguousarray(work[..., c])
+    cur = work[..., c]
     outs = []
-    for fx, fy, fz in factors:
+    for f in factors:
+      fx, fy, fz = f
       nx, ny, nz = cur.shape
-      out = np.empty(
-        ((nx + fx - 1) // fx, (ny + fy - 1) // fy, (nz + fz - 1) // fz),
-        dtype=dtype,
-      )
-      run_mip(cur, out, (nx, ny, nz), (fx, fy, fz))
+      oshape = ((nx + fx - 1) // fx, (ny + fy - 1) // fy,
+                (nz + fz - 1) // fz)
+      if (
+        not cur.flags["C_CONTIGUOUS"]
+        and cur.T.flags["C_CONTIGUOUS"]
+        and mode_transpose_ok(f)
+      ):
+        out_t = np.empty(oshape[::-1], dtype=dtype)
+        run_mip(cur.T, out_t, (nz, ny, nx), (fz, fy, fx))
+        out = out_t.T  # logical (x, y, z), Fortran-ordered like the input
+      else:
+        cur = np.ascontiguousarray(cur)
+        out = np.empty(oshape, dtype=dtype)
+        run_mip(cur, out, (nx, ny, nz), (fx, fy, fz))
       outs.append(out)
       cur = out
     chan_outs.append(outs)
 
   results = []
   for i in range(len(factors)):
-    r = np.stack([chan_outs[c][i] for c in range(nchan)], axis=-1)
+    if nchan == 1:
+      r = chan_outs[0][i][..., np.newaxis]  # view, no copy
+    else:
+      r = np.stack([chan_outs[c][i] for c in range(nchan)], axis=-1)
     r = back(r)
     if r.dtype != orig_dtype:
       r = r.astype(orig_dtype)

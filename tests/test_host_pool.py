@@ -106,3 +106,52 @@ def test_downsample_auto_seg_parity(rng, monkeypatch):
   a = pooling.downsample_auto(img, (2, 2, 1), 3, method="mode", sparse=True)
   d = pooling.downsample(img, (2, 2, 1), 3, method="mode", sparse=True)
   _check(a, d)
+
+
+# -- layout (Fortran-order) dispatch ----------------------------------------
+
+
+@pytest.mark.parametrize("order", ["C", "F"])
+@pytest.mark.parametrize("factor", [(2, 2, 1), (2, 2, 2), (3, 2, 1)])
+def test_layout_sweep_oracle_exact(rng, order, factor):
+  """The F-order transposed-call trick must stay oracle-exact — downloads
+  arrive Fortran-ordered, so this is the production layout."""
+  from igneous_tpu.ops import oracle
+
+  a = np.asarray(rng.integers(0, 255, (37, 29, 13)), dtype=np.uint8,
+                 order=order)
+  s = np.asarray(rng.integers(0, 6, (33, 21, 11)), dtype=np.uint64,
+                 order=order)
+  s[s == 3] += np.uint64(2**40)
+  ho = pooling.host_downsample(a, factor, 2, method="average")
+  if ho is None:
+    pytest.skip("native pooling lib unavailable")
+  for hh, nn in zip(ho, oracle.np_downsample_with_averaging(a, factor, 2)):
+    np.testing.assert_array_equal(hh, nn)
+  for sparse in (False, True):
+    hs = pooling.host_downsample(s, factor, 3, method="mode", sparse=sparse)
+    ns = oracle.np_downsample_segmentation(s, factor, 3, sparse=sparse)
+    for hh, nn in zip(hs, ns):
+      np.testing.assert_array_equal(hh, nn)
+
+
+def test_mode_tie_break_fuzz(rng):
+  """Tiny label alphabets force max-count ties constantly: the fast-path
+  waterfalls and the sparse required-order gathers must match the oracle
+  voxel for voxel in both layouts."""
+  from igneous_tpu.ops import oracle
+
+  if pooling.host_downsample(
+    np.zeros((4, 4, 4), np.uint64), (2, 2, 1), 1, method="mode"
+  ) is None:
+    pytest.skip("native pooling lib unavailable")
+  for trial in range(120):
+    shp = tuple(rng.integers(2, 8, 3))
+    s = np.asarray(rng.integers(0, 3, shp), dtype=np.uint64,
+                   order="F" if trial % 2 else "C")
+    for sparse in (False, True):
+      hs = pooling.host_downsample(s, (2, 2, 1), 1, method="mode",
+                                   sparse=sparse)[0]
+      ns = oracle.np_downsample_segmentation(s, (2, 2, 1), 1,
+                                             sparse=sparse)[0]
+      np.testing.assert_array_equal(hs, ns, err_msg=f"{trial} {sparse}")
