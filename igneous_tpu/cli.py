@@ -192,6 +192,11 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, volumetric,
   if isotropic:
     if factor is not None or volumetric:
       raise click.UsageError("--isotropic excludes --factor/--volumetric")
+    if sharded or batched:
+      raise click.UsageError(
+        "--isotropic plans per-mip factors, which only the unsharded "
+        "task factory supports"
+      )
     factor = "isotropic"
   elif volumetric:
     if factor is not None:
@@ -229,6 +234,7 @@ def image_downsample(ctx, path, queue, mip, num_mips, factor, volumetric,
       encoding_level=encoding_level, encoding_effort=encoding_effort,
       factor=factor or (2, 2, 1), memory_target=memory_target,
       downsample_method=downsample_method, bounds=bounds, bounds_mip=mip,
+      num_mips=num_mips,
     )
   else:
     tasks = tc.create_downsampling_tasks(
@@ -317,7 +323,8 @@ def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
       encoding_level=encoding_level, encoding_effort=encoding_effort,
       translate=translate, fill_missing=fill_missing,
       dest_voxel_offset=dest_voxel_offset, bounds=bounds,
-      bounds_mip=bounds_mip,
+      bounds_mip=bounds_mip, uncompressed_shard_bytesize=memory_target,
+      cutout=cutout, clean_info=clean_info, truncate_scales=truncate_scales,
     )
   else:
     tasks = tc.create_transfer_tasks(
@@ -852,8 +859,11 @@ def mesh_merge(ctx, path, queue, magnitude, mesh_dir, nlod, vqb,
 @click.argument("path")
 @click.option("--queue", "-q", default=None)
 @click.option("--mesh-dir", "--dir", "mesh_dir", default=None)
-@click.option("--num-lods", "--nlod", "num_lods", default=2,
-              show_default=True)
+@click.option("--num-lods", "num_lods", default=None, type=int,
+              help="Total levels of detail [default: 2].")
+@click.option("--nlod", default=None, type=int,
+              help="Reference-style: EXTRA levels of detail "
+                   "(total = nlod + 1).")
 @click.option("--vqb", default=16, show_default=True,
               help="Vertex quantization bits: 10 or 16.")
 @click.option("--min-chunk-size", type=TUPLE3, default=(256, 256, 256),
@@ -871,13 +881,15 @@ def mesh_merge(ctx, path, queue, magnitude, mesh_dir, nlod, vqb,
               help="Query labels from this sqlite db (mesh spatial-index "
                    "db) instead of listing .spatial files.")
 @click.pass_context
-def mesh_merge_sharded(ctx, path, queue, mesh_dir, num_lods, vqb,
+def mesh_merge_sharded(ctx, path, queue, mesh_dir, num_lods, nlod, vqb,
                        min_chunk_size, compress_level, shard_index_bytes,
                        minishard_index_bytes, minishard_index_encoding,
                        min_shards, max_labels_per_shard, spatial_index_db):
   """Sharded multires merge (reference cli.py:1105-1155)."""
   from . import task_creation as tc
 
+  if num_lods is None:
+    num_lods = (nlod + 1) if nlod is not None else 2
   enqueue(queue, tc.create_sharded_multires_mesh_tasks(
     path, mesh_dir=mesh_dir, num_lods=num_lods,
     vertex_quantization_bits=vqb, min_chunk_size=min_chunk_size,

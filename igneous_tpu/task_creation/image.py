@@ -385,6 +385,9 @@ def create_image_shard_transfer_tasks(
   bounds: Optional[Bbox] = None,
   bounds_mip: int = 0,
   uncompressed_shard_bytesize: int = MEMORY_TARGET,
+  cutout: bool = False,
+  clean_info: bool = False,
+  truncate_scales: bool = True,
 ):
   """Transfer into a SHARDED destination scale
   (reference: task_creation/image.py:507-637)."""
@@ -433,8 +436,31 @@ def create_image_shard_transfer_tasks(
         chunk_size=dest_chunk,
         encoding=encoding or src.meta.encoding(m),
       )
+    if not truncate_scales:
+      for m in range(mip + 1, src.meta.num_mips):
+        dest.meta.add_scale(
+          np.asarray(src.meta.downsample_ratio(m)),
+          chunk_size=dest_chunk,
+          encoding=encoding or src.meta.encoding(m),
+        )
     if mip > 0 and dest_voxel_offset is not None:
       dest.meta.scale(mip)["voxel_offset"] = list(dest_voxel_offset)
+    if cutout and bounds is not None:
+      # restrict the new volume to the requested bounds (same semantics
+      # as the unsharded transfer above; reference :879-886)
+      bounds_res = np.asarray(src.meta.resolution(bounds_mip), dtype=float)
+      for i in range(len(dest.info["scales"])):
+        ratio = bounds_res / np.asarray(dest.meta.resolution(i), dtype=float)
+        sc = dest.info["scales"][i]
+        sc["voxel_offset"] = [
+          int(v) for v in np.asarray(bounds.minpt, dtype=float) * ratio
+        ]
+        sc["size"] = [
+          int(np.ceil(v)) for v in np.asarray(bounds.size3(), float) * ratio
+        ]
+    if clean_info:
+      for key in ("mesh", "meshing", "skeletons"):
+        dest.info.pop(key, None)
   # the computed sharding spec always lands on the scale tasks write to —
   # including when the destination layer already existed
   dest.meta.scale(mip)["sharding"] = spec
@@ -489,53 +515,80 @@ def create_image_shard_downsample_tasks(
   bounds_mip: int = 0,
   memory_target: int = MEMORY_TARGET,
   downsample_method: str = "auto",
+  num_mips: int = 1,
 ):
-  """One downsampled SHARDED mip per pass
-  (reference: task_creation/image.py:639-807; the reference likewise emits
-  one mip per sharded pass because a shard must be written complete)."""
+  """Downsampled SHARDED mips, several per pass (reference:
+  task_creation/image.py:639-807). Each of the ``num_mips`` new scales
+  gets its own sharding spec; the task stride is the largest per-mip
+  shard extent (shard extents are powers of two per axis, so the max
+  evenly contains them all — reference :732-740), and ``num_mips`` is
+  clamped so every produced mip stays chunk-aligned within the stride
+  (reference :742-757)."""
   from ..sharding import create_sharded_image_info, image_shard_shape_from_spec
   from ..tasks.image_sharded import ImageShardDownsampleTask
 
   vol = Volume(layer_path, mip=mip)
   factor = tuple(int(v) for v in factor)
+  num_mips = max(int(num_mips), 1)
   cs = list(chunk_size) if chunk_size else [int(v) for v in vol.meta.chunk_size(mip)]
 
-  dest_size = [
-    int(v) for v in -(-np.asarray(vol.meta.volume_size(mip)) //
-                      np.asarray(factor))
-  ]
-  spec = create_sharded_image_info(
-    dataset_size=dest_size,
-    chunk_size=cs,
-    encoding=encoding or vol.meta.encoding(mip),
-    dtype=vol.meta.data_type,
-    # shard task must hold source region = shard * prod(factor) voxels
-    uncompressed_shard_bytesize=int(
-      memory_target // (int(np.prod(factor)) + 1)
-    ),
-  )
   base_ratio = np.asarray(vol.meta.downsample_ratio(mip), dtype=np.int64)
-  vol.meta.add_scale(
-    base_ratio * np.asarray(factor), chunk_size=cs,
-    encoding=encoding, sharding=spec,
-  )
-  dest_mip_key = "_".join(
-    str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
-    * base_ratio * np.asarray(factor)
-  )
-  if encoding_level is not None or encoding_effort is not None:
-    vol.meta.set_encoding(
-      vol.meta.mip_from_key(dest_mip_key), None, encoding_level,
-      encoding_effort,
+  specs = []
+  dest_mips = []
+  stride = np.zeros(3, dtype=np.int64)
+  cum = np.ones(3, dtype=np.int64)
+  for i in range(1, num_mips + 1):
+    cum = cum * np.asarray(factor, dtype=np.int64)
+    dest_size = [
+      int(v) for v in -(-np.asarray(vol.meta.volume_size(mip)) // cum)
+    ]
+    spec = create_sharded_image_info(
+      dataset_size=dest_size,
+      chunk_size=cs,
+      encoding=encoding or vol.meta.encoding(mip),
+      dtype=vol.meta.data_type,
+      # the task must hold the SOURCE region for this shard: one dest
+      # voxel at mip+i costs prod(cum) source voxels plus the pyramid
+      uncompressed_shard_bytesize=max(
+        int(memory_target // (int(np.prod(cum)) + 1)), int(1e6)
+      ),
     )
-  vol.commit_info()
-  dest_mip = vol.meta.mip_from_key("_".join(
-    str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
-    * base_ratio * np.asarray(factor)
-  ))
+    vol.meta.add_scale(
+      base_ratio * cum, chunk_size=cs, encoding=encoding, sharding=spec,
+    )
+    dmip = vol.meta.mip_from_key("_".join(
+      str(int(r)) for r in np.asarray(vol.meta.scale(0)["resolution"])
+      * base_ratio * cum
+    ))
+    if encoding_level is not None or encoding_effort is not None:
+      vol.meta.set_encoding(dmip, None, encoding_level, encoding_effort)
+    specs.append(spec)
+    dest_mips.append(dmip)
+    shard_shape = np.asarray(
+      image_shard_shape_from_spec(spec, dest_size, cs), dtype=np.int64
+    )
+    stride = np.maximum(stride, shard_shape * cum)
 
-  shard_shape = image_shard_shape_from_spec(spec, dest_size, cs)
-  shape = Vec(*(np.asarray(shard_shape) * np.asarray(factor)))
+  # clamp num_mips so every produced mip's dest region inside the stride
+  # is chunk-aligned (reference :742-757)
+  max_mips = num_mips
+  for axis in range(3):
+    if factor[axis] == 1:
+      continue
+    chunks_per_dim = stride[axis] // cs[axis]
+    max_mip_a = int(np.floor(np.log2(max(chunks_per_dim, 1))
+                             / np.log2(factor[axis])))
+    max_mips = min(max_mips, max_mip_a)
+  max_mips = max(max_mips, 1)
+  if max_mips < num_mips:
+    # drop the unreachable scales again
+    for dmip in sorted(dest_mips[max_mips:], reverse=True):
+      del vol.info["scales"][dmip]
+    dest_mips = dest_mips[:max_mips]
+    specs = specs[:max_mips]
+  vol.commit_info()
+
+  shape = Vec(*stride)
   # shard-align the task grid: shard files are write-once
   task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
   task_bounds = task_bounds.expand_to_chunk_size(
@@ -552,13 +605,15 @@ def create_image_shard_downsample_tasks(
       sparse=sparse,
       factor=list(factor),
       downsample_method=downsample_method,
+      num_mips=max_mips,
     )
 
   def finish():
     _provenance(vol, {
       "task": "ImageShardDownsampleTask",
-      "mip": mip, "dest_mip": dest_mip,
-      "factor": list(factor), "sharding": spec,
+      "mip": mip, "dest_mips": [int(m) for m in dest_mips],
+      "num_mips": max_mips,
+      "factor": list(factor), "sharding": specs[0],
       "bounds": task_bounds.to_list(),
     })
 

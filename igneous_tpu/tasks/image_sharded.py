@@ -57,10 +57,12 @@ class ImageShardTransferTask(RegisteredTask):
 
 
 class ImageShardDownsampleTask(RegisteredTask):
-  """Downsample a shard-aligned region of mip into sharded mip+1.
+  """Downsample a shard-aligned region of mip into sharded mip+1…mip+N.
 
-  The task bbox (shape/offset, in source-mip coords) covers exactly one
-  destination shard (or its dataset-edge remainder)."""
+  The task bbox (shape/offset, in source-mip coords) covers whole
+  destination shards at every produced mip (or their dataset-edge
+  remainders); the factory's stride math guarantees that
+  (reference image.py:681-847 multi-mip shard synthesis)."""
 
   def __init__(
     self,
@@ -72,6 +74,7 @@ class ImageShardDownsampleTask(RegisteredTask):
     sparse: bool = False,
     factor: Sequence[int] = (2, 2, 1),
     downsample_method: str = "auto",
+    num_mips: int = 1,
   ):
     self.src_path = src_path
     self.shape = Vec(*shape)
@@ -81,6 +84,7 @@ class ImageShardDownsampleTask(RegisteredTask):
     self.sparse = sparse
     self.factor = Vec(*factor)
     self.downsample_method = downsample_method
+    self.num_mips = int(num_mips)
 
   def execute(self):
     vol = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
@@ -91,18 +95,19 @@ class ImageShardDownsampleTask(RegisteredTask):
       return
     img = vol.download(bounds)
     method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
-    mipped = pooling.downsample_auto(
-      img, tuple(int(v) for v in self.factor), 1, method=method,
-      sparse=self.sparse,
-    )[0]
-    # resolve the destination scale by resolution, not positional index:
-    # add_scale keeps scales sorted, so mip+1 is not guaranteed to be ours
-    dest_res = np.asarray(vol.meta.resolution(self.mip)) * np.asarray(
-      [int(v) for v in self.factor]
+    factor = tuple(int(v) for v in self.factor)
+    mips_out = pooling.downsample_auto(
+      img, factor, self.num_mips, method=method, sparse=self.sparse,
     )
-    dest_mip = vol.meta.mip_from_resolution(dest_res)
-    dest_min = bounds.minpt // self.factor
-    dest_bounds = Bbox(dest_min, dest_min + Vec(*mipped.shape[:3]))
-    dest_bounds = Bbox.intersection(dest_bounds, vol.meta.bounds(dest_mip))
-    sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
-    upload_shard(vol, dest_bounds, mipped[sl], dest_mip)
+    cum = np.ones(3, dtype=np.int64)
+    for mipped in mips_out:
+      cum *= np.asarray(factor, dtype=np.int64)
+      # resolve each destination scale by resolution, not positional
+      # index: add_scale keeps scales sorted, so mip+i is not guaranteed
+      dest_res = np.asarray(vol.meta.resolution(self.mip)) * cum
+      dest_mip = vol.meta.mip_from_resolution(dest_res)
+      dest_min = bounds.minpt // Vec(*cum)
+      dest_bounds = Bbox(dest_min, dest_min + Vec(*mipped.shape[:3]))
+      dest_bounds = Bbox.intersection(dest_bounds, vol.meta.bounds(dest_mip))
+      sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
+      upload_shard(vol, dest_bounds, mipped[sl], dest_mip)

@@ -399,3 +399,36 @@ def test_create_encoding_level_applies_to_ingest(tmp_path):
     _os.path.getsize(f"{d}/1_1_1/{f}") for f in _os.listdir(f"{d}/1_1_1")
   )
   assert size(f"{tmp_path}/q30") < size(f"{tmp_path}/q95")
+
+
+def test_sharded_downsample_multi_mip():
+  """--sharded honors --num-mips: one pass emits several sharded scales,
+  each oracle-exact (review regression: only one mip was produced)."""
+  from igneous_tpu.ops import oracle
+
+  rng = np.random.default_rng(3)
+  img = rng.integers(0, 255, (128, 128, 32)).astype(np.uint8)
+  Volume.from_numpy(img, "mem://ms/v", chunk_size=(32, 32, 32),
+                    layer_type="image")
+  tq().insert(tc.create_image_shard_downsample_tasks(
+    "mem://ms/v", mip=0, num_mips=2, memory_target=int(1e8)))
+  vol = Volume("mem://ms/v")
+  assert len(vol.info["scales"]) >= 3
+  want = oracle.np_downsample_with_averaging(img, (2, 2, 1), 2)
+  for m in (1, 2):
+    v = Volume("mem://ms/v", mip=m)
+    assert v.meta.is_sharded(m)
+    np.testing.assert_array_equal(v.download(v.bounds)[..., 0], want[m - 1])
+
+
+def test_cli_isotropic_excludes_sharded(tmp_path):
+  from igneous_tpu.cli import main
+
+  img = np.zeros((32, 32, 8), dtype=np.uint8)
+  path = f"file://{tmp_path}/iso"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 8), layer_type="image")
+  r = CliRunner().invoke(main, [
+    "image", "downsample", path, "--isotropic", "--sharded",
+  ])
+  assert r.exit_code != 0
+  assert "unsharded" in r.output
