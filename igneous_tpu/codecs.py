@@ -29,8 +29,15 @@ def encode_raw(img: np.ndarray) -> bytes:
   return img.tobytes("F")
 
 
-def decode_raw(data: bytes, shape, dtype) -> np.ndarray:
-  arr = np.frombuffer(bytearray(data), dtype=dtype)
+def decode_raw(data: bytes, shape, dtype, writable: bool = True) -> np.ndarray:
+  """``writable=False`` skips the defensive buffer copy and returns a
+  read-only view of ``data`` — the download assembly path copies the
+  decoded voxels into the output cutout anyway, so the extra copy here
+  would be pure overhead at 8 bytes/voxel."""
+  if writable:
+    arr = np.frombuffer(bytearray(data), dtype=dtype)
+  else:
+    arr = np.frombuffer(data, dtype=dtype)
   return arr.reshape(shape, order="F")
 
 
@@ -120,12 +127,13 @@ def encode(
   raise NotImplementedError(f"Encoding not supported: {encoding}")
 
 
-def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8)) -> np.ndarray:
+def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8),
+           writable: bool = True) -> np.ndarray:
   shape = tuple(int(v) for v in shape)
   if len(shape) == 3:
     shape = shape + (1,)
   if encoding == "raw":
-    return decode_raw(data, shape, dtype)
+    return decode_raw(data, shape, dtype, writable=writable)
   if encoding == "compressed_segmentation":
     return cseg_decompress(data, shape, dtype, block_size=block_size)
   if encoding == "jpeg":

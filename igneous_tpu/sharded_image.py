@@ -50,7 +50,10 @@ def download_sharded(vol, bbox: Bbox, mip: int) -> List[Tuple[Bbox, np.ndarray]]
       continue
     cid = chunk_morton_id(vol, gchunk, mip)
     data = reader.get_chunk(cid)
-    renders.append((chunk_bbx, vol._decode_chunk(data, chunk_bbx, mip)))
+    # read-only decode: Volume.download copies into its assembly buffer
+    renders.append((
+      chunk_bbx, vol._decode_chunk(data, chunk_bbx, mip, writable=False)
+    ))
   return renders
 
 

@@ -227,7 +227,10 @@ class Volume:
 
   # -- download -------------------------------------------------------------
 
-  def _decode_chunk(self, data: Optional[bytes], chunk_bbx: Bbox, mip: int) -> np.ndarray:
+  def _decode_chunk(
+    self, data: Optional[bytes], chunk_bbx: Bbox, mip: int,
+    writable: bool = True,
+  ) -> np.ndarray:
     shape = tuple(int(v) for v in chunk_bbx.size3()) + (self.num_channels,)
     if data is None:
       if not self.fill_missing:
@@ -241,6 +244,7 @@ class Volume:
       shape,
       self.dtype,
       block_size=self.meta.cseg_block_size(mip),
+      writable=writable,
     )
 
   def download(
@@ -303,20 +307,26 @@ class Volume:
       ]
       keys = [self.meta.chunk_name(mip, c) for c in chunks]
       datas = self._parallel_get(keys, parallel)
+      # read-only decode: the voxels are copied into the assembly buffer
+      # below, so a writable defensive copy here would be pure overhead
       renders = [
-        (c, self._decode_chunk(data, c, mip)) for c, data in zip(chunks, datas)
+        (c, self._decode_chunk(data, c, mip, writable=False))
+        for c, data in zip(chunks, datas)
       ]
 
     # Fortran order end to end: decoded chunks are F-order views, the
     # device layout (c,z,y,x) is a zero-copy transpose of an F-order
     # cutout, and raw encode is tobytes("F") — C-order assembly here would
     # force a full-volume transpose copy on BOTH sides of the compute.
-    out = np.full(
-      tuple(int(v) for v in bbox.size3()) + (self.num_channels,),
-      self.background_color,
-      dtype=self.dtype,
-      order="F",
-    )
+    out_shape = tuple(int(v) for v in bbox.size3()) + (self.num_channels,)
+    if inner == bbox:
+      # the chunk grid covers every output voxel (missing chunks arrive
+      # background-filled): skip the background memset
+      out = np.empty(out_shape, dtype=self.dtype, order="F")
+    else:
+      out = np.full(
+        out_shape, self.background_color, dtype=self.dtype, order="F"
+      )
     for chunk_bbx, chunk_img in renders:
       isect = Bbox.intersection(chunk_bbx, bbox)
       if isect.empty():
