@@ -118,12 +118,15 @@ def enqueue(queue_spec: str, tasks, parallel: int = 1):
 
 @click.group()
 @click.option("-p", "--parallel", default=1, show_default=True,
-              help="Worker processes for local execution.")
+              help="Worker processes for local execution (0 = all cores).")
+@click.version_option(version="0.3.0", prog_name="igneous-tpu")
 @click.pass_context
 def main(ctx, parallel):
   """igneous-tpu: TPU-native Neuroglancer Precomputed pipelines."""
   ctx.ensure_object(dict)
-  ctx.obj["parallel"] = parallel
+  # reference semantics: -p 0 means "use the number of cores"
+  # (/root/reference/igneous_cli/cli.py:186)
+  ctx.obj["parallel"] = parallel if parallel > 0 else (os.cpu_count() or 1)
 
 
 # ---------------------------------------------------------------------------
@@ -1389,7 +1392,10 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   start = time.time()
 
   def stop_fn(executed: int, empty: bool) -> bool:
-    if num_tasks is not None and executed >= num_tasks:
+    if num_tasks is not None and 0 <= num_tasks <= executed:
+      return True
+    if min_sec == 0 and (executed >= 1 or empty):
+      # reference special value: run at most a single task (cli.py:892)
       return True
     if empty and exit_on_empty:
       return True

@@ -432,3 +432,27 @@ def test_cli_isotropic_excludes_sharded(tmp_path):
   ])
   assert r.exit_code != 0
   assert "unsharded" in r.output
+
+
+def test_execute_min_sec_zero_single_task(tmp_path):
+  """--min-sec 0 runs at most ONE task (reference special value,
+  cli.py:892)."""
+  from igneous_tpu.cli import main
+
+  img = np.random.default_rng(0).integers(0, 255, (128, 32, 16)).astype(np.uint8)
+  path = f"file://{tmp_path}/v"
+  Volume.from_numpy(img, path, chunk_size=(16, 16, 16), layer_type="image")
+  q = f"fq://{tmp_path}/q"
+  r = CliRunner().invoke(main, [
+    "image", "downsample", path, "--num-mips", "1", "--queue", q,
+    "--memory", str(int(2e4)),
+  ])
+  assert r.exit_code == 0, r.output
+  from igneous_tpu.queues import TaskQueue
+
+  tq_ = TaskQueue(q)
+  before = tq_.enqueued
+  assert before >= 2
+  r = CliRunner().invoke(main, ["execute", q, "--min-sec", "0"])
+  assert r.exit_code == 0, r.output
+  assert TaskQueue(q).enqueued == before - 1
