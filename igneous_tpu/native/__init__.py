@@ -122,6 +122,8 @@ def pooling_lib() -> Optional[ctypes.CDLL]:
       ctypes.c_long, ctypes.c_long, ctypes.c_long,
       ctypes.c_int, ctypes.c_int,
     ]
+    lib.pool_mode_u64_f.restype = None
+    lib.pool_mode_u64_f.argtypes = list(lib.pool_mode_u64.argtypes)
     lib._configured = True
   return lib
 

@@ -168,19 +168,20 @@ static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
             else if (v2 == v3) r = v2;
             else r = v0;
           } else {
-            // sparse vote excludes zeros, so the 2x2 order-equivalence
-            // argument no longer holds — gather in the REQUIRED position
-            // order (logical dx fastest: v0, v2, v1, v3)
-            const uint64_t w[4] = {v0, v2, v1, v3};
+            // kernel-logical position order (dy fastest for fx=1) — the
+            // host layer only routes direct logical-(1,2,2) calls here;
+            // transposed 2x2x1 calls come only in the non-sparse case,
+            // where the waterfall above is order-independent (see
+            // host_downsample's dispatch rules)
+            const uint64_t w[4] = {v0, v1, v2, v3};
             r = mode_vote(w, 4, 1);
           }
           o[z] = r;
         }
         if (iz < oz) {
           const long s = nz - 1;
-          // required order with the logical-x window clamped: (dx0,dy0),
-          // (dx1,dy0), (dx0,dy1), (dx1,dy1) with both dx hitting s
-          const uint64_t w[4] = {r0[s], r0[s], r1[s], r1[s]};
+          // kernel-logical order with the z window clamped (both dz -> s)
+          const uint64_t w[4] = {r0[s], r1[s], r0[s], r1[s]};
           o[iz] = mode_vote(w, 4, sparse);
         }
       }
@@ -191,8 +192,8 @@ static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
         const uint64_t *r1 = cx + sy1 * syy;
         for (long z = 0; z < oz; ++z) {
           const long s0 = clamp_idx(z * 2, nz), s1 = clamp_idx(z * 2 + 1, nz);
-          // required position order (logical dx fastest)
-          const uint64_t w[4] = {r0[s0], r0[s1], r1[s0], r1[s1]};
+          // kernel-logical position order (dy fastest)
+          const uint64_t w[4] = {r0[s0], r1[s0], r0[s1], r1[s1]};
           o[z] = mode_vote(w, 4, sparse);
         }
       }
@@ -271,6 +272,42 @@ static void mode_u64_range(const uint64_t *in, uint64_t *out, long nx,
   }
 }
 
+static void mode_u64_f_range(const uint64_t *in, uint64_t *out, long nx,
+                             long ny, long nz, long fx, long fy, long fz,
+                             int sparse, long oz0, long oz1) {
+  // Fortran-ordered logical (x, y, z) input (x contiguous) and output.
+  // Output loops z, y outer and x inner (memory order); the per-window
+  // gather runs dz, dy outer and dx INNER — the required earliest-
+  // position tie order — so this is exact for ANY factor without the
+  // transpose-equivalence argument. Threading splits the output z range.
+  const long ox = (nx + fx - 1) / fx, oy = (ny + fy - 1) / fy;
+  const long n = fx * fy * fz;
+  const long sy = nx, sz = nx * ny;        // input Fortran strides
+  const long osy = ox, osz = ox * oy;      // output Fortran strides
+  std::vector<uint64_t> vals((size_t)n);
+  for (long z = oz0; z < oz1; ++z) {
+    for (long y = 0; y < oy; ++y) {
+      uint64_t *orow = out + z * osz + y * osy;
+      for (long x = 0; x < ox; ++x) {
+        long k = 0;
+        for (long dz = 0; dz < fz; ++dz) {
+          const long izz = clamp_idx(z * fz + dz, nz);
+          for (long dy = 0; dy < fy; ++dy) {
+            const long iyy = clamp_idx(y * fy + dy, ny);
+            const uint64_t *row = in + izz * sz + iyy * sy;
+            for (long dx = 0; dx < fx; ++dx) {
+              vals[(size_t)k++] = row[clamp_idx(x * fx + dx, nx)];
+            }
+          }
+        }
+        bool uniform = true;
+        for (long i = 1; i < n; ++i) uniform &= (vals[(size_t)i] == vals[0]);
+        orow[x] = uniform ? vals[0] : mode_vote(vals.data(), n, sparse);
+      }
+    }
+  }
+}
+
 template <typename F>
 static void run_threaded(long ox, int parallel, F body) {
   int T = parallel > 0 ? parallel : (int)std::thread::hardware_concurrency();
@@ -305,5 +342,14 @@ extern "C" void pool_mode_u64(const uint64_t *in, uint64_t *out, long nx,
   const long ox = (nx + fx - 1) / fx;
   run_threaded(ox, parallel, [&](long lo, long hi) {
     mode_u64_range(in, out, nx, ny, nz, fx, fy, fz, sparse, lo, hi);
+  });
+}
+
+extern "C" void pool_mode_u64_f(const uint64_t *in, uint64_t *out, long nx,
+                                long ny, long nz, long fx, long fy, long fz,
+                                int sparse, int parallel) {
+  const long oz = (nz + fz - 1) / fz;
+  run_threaded(oz, parallel, [&](long lo, long hi) {
+    mode_u64_f_range(in, out, nx, ny, nz, fx, fy, fz, sparse, lo, hi);
   });
 }

@@ -468,6 +468,15 @@ def host_downsample(
         *dims, *f, int(bool(sparse)), int(parallel),
       )
 
+    def run_mip_f(cur, out, dims, f):
+      # Fortran-layout variant: exact for any factor (gathers windows in
+      # the required dx-fastest tie order with explicit strides)
+      lib.pool_mode_u64_f(
+        cur.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        *dims, *f, int(bool(sparse)), int(parallel),
+      )
+
   # Transposed-call layout trick: a Fortran-ordered (x, y, z) cutout IS a
   # C-ordered (z, y, x) array, so the kernel can run on it directly with
   # reversed dims/factors — no ascontiguousarray transpose-copy (which
@@ -476,7 +485,13 @@ def host_downsample(
   # where the earliest-position tie-break provably coincides across both
   # traversal orders (see pooling.cpp f122 note + layout tests).
   def mode_transpose_ok(f):
-    return method == "average" or (f[2] == 1 and f[0] == 2 and f[1] == 2)
+    # average: order-free sum, any factor. mode: only the NON-sparse
+    # 2x2x1 case, where the f122 waterfall's winner is provably order-
+    # independent; sparse votes and other factors go through the exact
+    # Fortran-strided kernel instead.
+    if method == "average":
+      return True
+    return (not sparse) and f == (2, 2, 1)
 
   nchan = work.shape[3]
   chan_outs: List[List[np.ndarray]] = []
@@ -488,14 +503,18 @@ def host_downsample(
       nx, ny, nz = cur.shape
       oshape = ((nx + fx - 1) // fx, (ny + fy - 1) // fy,
                 (nz + fz - 1) // fz)
-      if (
-        not cur.flags["C_CONTIGUOUS"]
-        and cur.T.flags["C_CONTIGUOUS"]
-        and mode_transpose_ok(f)
-      ):
+      f_contig = (
+        not cur.flags["C_CONTIGUOUS"] and cur.T.flags["C_CONTIGUOUS"]
+      )
+      if f_contig and mode_transpose_ok(f):
         out_t = np.empty(oshape[::-1], dtype=dtype)
         run_mip(cur.T, out_t, (nz, ny, nx), (fz, fy, fx))
         out = out_t.T  # logical (x, y, z), Fortran-ordered like the input
+      elif f_contig and method == "mode":
+        # factors the transpose-equivalence proof does not cover (e.g.
+        # volumetric 2x2x2): the dedicated Fortran-strided mode kernel
+        out = np.empty(oshape[::-1], dtype=dtype).T
+        run_mip_f(cur, out, (nx, ny, nz), (fx, fy, fz))
       else:
         cur = np.ascontiguousarray(cur)
         out = np.empty(oshape, dtype=dtype)

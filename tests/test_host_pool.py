@@ -155,3 +155,24 @@ def test_mode_tie_break_fuzz(rng):
       ns = oracle.np_downsample_segmentation(s, (2, 2, 1), 1,
                                              sparse=sparse)[0]
       np.testing.assert_array_equal(hs, ns, err_msg=f"{trial} {sparse}")
+
+
+@pytest.mark.parametrize("order", ["C", "F"])
+@pytest.mark.parametrize("factor", [(2, 2, 2), (1, 2, 2), (2, 1, 2)])
+def test_mode_all_factor_layouts(rng, order, factor):
+  """Mode at non-2x2x1 factors routes F-order inputs through the
+  Fortran-strided kernel (exact for any factor); C-order inputs through
+  the direct kernel. Both must match the oracle including sparse."""
+  from igneous_tpu.ops import oracle
+
+  s = np.asarray(rng.integers(0, 5, (19, 14, 11)), dtype=np.uint64,
+                 order=order)
+  s[s == 2] += np.uint64(2**41)
+  out = pooling.host_downsample(s, factor, 2, method="mode")
+  if out is None:
+    pytest.skip("native pooling lib unavailable")
+  for sparse in (False, True):
+    hs = pooling.host_downsample(s, factor, 2, method="mode", sparse=sparse)
+    ns = oracle.np_downsample_segmentation(s, factor, 2, sparse=sparse)
+    for hh, nn in zip(hs, ns):
+      np.testing.assert_array_equal(hh, nn)
