@@ -577,12 +577,14 @@ def image_roi(path, threshold, dust, suppress_faint, max_axial_len, z_step,
   """Detect tissue regions of interest at the coarsest mip."""
   from . import task_creation as tc
 
-  for roi in tc.compute_rois(
+  rois = tc.compute_rois(
     path, threshold=threshold, dust_threshold=dust,
     suppress_faint_voxels=suppress_faint, max_axial_length=max_axial_len,
     z_step=z_step, progress=progress,
-  ):
+  )
+  for roi in rois:
     click.echo(str(roi))
+  click.echo(f"{len(rois)} ROI detected. info file updated.")
 
 
 @image.command("reorder")

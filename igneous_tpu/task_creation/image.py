@@ -1019,10 +1019,13 @@ def compute_rois(
   max_axial_length: int = 512,
   z_step: Optional[int] = None,
   progress: bool = False,
+  save: bool = True,
 ) -> list:
   """Detect tissue regions-of-interest: CCL over the coarsest mip's
   foreground, returning physical-space bounding boxes
-  (reference :2032-2095).
+  (reference :2032-2095). ``save`` also records them in the layer's
+  info file as mip-0 voxel bboxes (the reference CLI prints
+  "info file updated", cli.py:441).
 
   ``suppress_faint_voxels`` zeroes values ≤ that level first;
   ``max_axial_length`` downsamples in memory until XY fits that square
@@ -1078,6 +1081,16 @@ def compute_rois(
       mn = (mn * vx_scale + offset) * res
       mx = (mx * vx_scale + offset) * res
       rois.append(Bbox(mn, mx))
+  if save:
+    # reference format (image.py:2085-2092): flat [x0,y0,z0,x1,y1,z1]
+    # lists with INCLUSIVE max corners, stored on the mip-0 scale
+    res0 = np.asarray(vol.meta.resolution(0), dtype=np.int64)
+    vol.info["scales"][0]["rois"] = [
+      [int(v) for v in np.asarray(r.minpt) // res0]
+      + [int(v) - 1 for v in np.asarray(r.maxpt) // res0]
+      for r in rois
+    ]
+    vol.commit_info()
   return rois
 
 

@@ -456,3 +456,20 @@ def test_execute_min_sec_zero_single_task(tmp_path):
   r = CliRunner().invoke(main, ["execute", q, "--min-sec", "0"])
   assert r.exit_code == 0, r.output
   assert TaskQueue(q).enqueued == before - 1
+
+
+def test_roi_updates_info(tmp_path):
+  """Reference `image roi` records ROIs in the info file (cli.py:441)."""
+  from igneous_tpu.cli import main
+
+  img = np.zeros((64, 64, 8), dtype=np.uint8)
+  img[8:24, 8:24, :] = 200
+  path = f"file://{tmp_path}/roi_v"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 8), layer_type="image")
+  r = CliRunner().invoke(main, ["image", "roi", path, "--dust", "10"])
+  assert r.exit_code == 0, r.output
+  assert "info file updated" in r.output
+  info = json.loads((tmp_path / "roi_v" / "info").read_text())
+  rois = info["scales"][0]["rois"]  # reference location + format
+  assert len(rois) == 1
+  assert rois[0] == [8, 8, 0, 23, 23, 7]  # inclusive max corners
