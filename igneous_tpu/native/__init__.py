@@ -167,6 +167,20 @@ def cseg_lib() -> Optional[ctypes.CDLL]:
   return lib
 
 
+def xsection_lib() -> Optional[ctypes.CDLL]:
+  lib = load("xsection")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.xs_plane_cubes_area.restype = ctypes.c_double
+    lib.xs_plane_cubes_area.argtypes = [
+      ctypes.c_void_p, ctypes.c_longlong,
+      ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib._configured = True
+  return lib
+
+
 def simplify_lib() -> Optional[ctypes.CDLL]:
   lib = load("simplify")
   if lib is None:

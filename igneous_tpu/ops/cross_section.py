@@ -102,7 +102,35 @@ def _plane_cube_areas(
   """Exact Σ area(plane ∩ cube) over voxel cubes at integer indices
   vox_idx (K, 3); plane through v_phys with unit normal t. Convention:
   index i is the CUBE CENTER, i.e. cube k spans
-  [(vox_idx-1/2)*anis, (vox_idx+1/2)*anis). Fully vectorized over cubes."""
+  [(vox_idx-1/2)*anis, (vox_idx+1/2)*anis). Dispatches to the native
+  xs3d-equivalent kernel (native/csrc/xsection.cpp — the same algorithm
+  with the same tolerances, scalar C++); this numpy twin doubles as the
+  fallback and the equivalence oracle."""
+  if len(vox_idx) == 0:
+    return 0.0
+  from ..native import xsection_lib
+
+  lib = xsection_lib()
+  if lib is not None:
+    import ctypes
+
+    vi = np.ascontiguousarray(vox_idx, dtype=np.int64)
+    v = np.ascontiguousarray(v_phys, dtype=np.float64)
+    tn = np.ascontiguousarray(t, dtype=np.float64)
+    an = np.ascontiguousarray(anis, dtype=np.float64)
+    return float(lib.xs_plane_cubes_area(
+      vi.ctypes.data_as(ctypes.c_void_p), len(vi),
+      v.ctypes.data_as(ctypes.c_void_p),
+      tn.ctypes.data_as(ctypes.c_void_p),
+      an.ctypes.data_as(ctypes.c_void_p),
+    ))
+  return _plane_cube_areas_py(vox_idx, v_phys, t, anis)
+
+
+def _plane_cube_areas_py(
+  vox_idx: np.ndarray, v_phys: np.ndarray, t: np.ndarray, anis: np.ndarray
+) -> float:
+  """Numpy twin of the native kernel (kept as oracle + fallback)."""
   from ..mesh_multires import clip_polygons
 
   if len(vox_idx) == 0:

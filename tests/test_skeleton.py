@@ -752,3 +752,24 @@ def test_merge_max_cable_length_skips_postprocess_only(tmp_path):
     max_cable_length=1e9))
   assert vol.cf.get(f"{sdir}/55") is None
   assert len(s_over) > 0
+
+
+def test_native_xsection_matches_numpy_twin():
+  """The native plane∩cube kernel (xs3d-equivalent hot loop) must agree
+  with the numpy twin to float64 roundoff across random planes, cube
+  sets, and anisotropies."""
+  from igneous_tpu.ops import cross_section as cs
+
+  if __import__("igneous_tpu.native", fromlist=["x"]).xsection_lib() is None:
+    pytest.skip("native toolchain unavailable")
+  rng = np.random.default_rng(7)
+  for _ in range(80):
+    K = int(rng.integers(1, 30))
+    vox = rng.integers(-4, 24, (K, 3)).astype(np.int64)
+    t = rng.normal(size=3)
+    t /= np.linalg.norm(t)
+    anis = rng.uniform(1.0, 40.0, 3)
+    v = rng.uniform(-10, 300, 3)
+    a_native = cs._plane_cube_areas(vox, v, t, anis)
+    a_py = cs._plane_cube_areas_py(vox, v, t, anis)
+    assert abs(a_native - a_py) <= 1e-9 * max(1.0, a_py)
