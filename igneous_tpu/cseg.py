@@ -115,7 +115,9 @@ def _encode_channel(chan: np.ndarray, block_size: Tuple[int, int, int]) -> np.nd
 
 
 def _native_encode_channel(chan: np.ndarray, block_size) -> "np.ndarray | None":
-  """C++ fast path (igneous_tpu/native/csrc/cseg.cpp); None → numpy path."""
+  """C++ fast path (igneous_tpu/native/csrc/cseg.cpp); None → numpy path.
+  Stride-aware: Fortran-ordered download cutouts (and sliced views) encode
+  in place with no ascontiguousarray copy."""
   import ctypes
 
   from .native import cseg_lib
@@ -123,12 +125,17 @@ def _native_encode_channel(chan: np.ndarray, block_size) -> "np.ndarray | None":
   lib = cseg_lib()
   if lib is None:
     return None
-  chan = np.ascontiguousarray(chan)
+  item = chan.dtype.itemsize
+  strides = [s // item for s in chan.strides]
+  if any(s % item for s in chan.strides) or any(s <= 0 for s in strides):
+    chan = np.ascontiguousarray(chan)  # exotic views: normalize first
+    strides = [s // item for s in chan.strides]
   out = ctypes.POINTER(ctypes.c_uint32)()
-  n = lib.cseg_encode_channel(
+  n = lib.cseg_encode_channel_strided(
     chan.ctypes.data_as(ctypes.c_void_p),
-    1 if chan.dtype.itemsize == 8 else 0,
+    1 if item == 8 else 0,
     *[int(v) for v in chan.shape],
+    *[int(s) for s in strides],
     *[int(b) for b in block_size],
     ctypes.byref(out),
   )
@@ -145,9 +152,9 @@ def compress(img: np.ndarray, block_size: Sequence[int] = (8, 8, 8)) -> bytes:
   if img.ndim == 3:
     img = img[..., np.newaxis]
   if img.dtype.itemsize <= 4:
-    img = img.astype(np.uint32)
+    img = img.astype(np.uint32, copy=False)
   else:
-    img = img.astype(np.uint64)
+    img = img.astype(np.uint64, copy=False)
 
   num_channels = img.shape[3]
   channels = []

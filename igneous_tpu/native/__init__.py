@@ -149,10 +149,12 @@ def cseg_lib() -> Optional[ctypes.CDLL]:
   if lib is None:
     return None
   if not getattr(lib, "_configured", False):
-    lib.cseg_encode_channel.restype = ctypes.c_int64
-    lib.cseg_encode_channel.argtypes = [
-      ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-      ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    lib.cseg_encode_channel_strided.restype = ctypes.c_int64
+    lib.cseg_encode_channel_strided.argtypes = [
+      ctypes.c_void_p, ctypes.c_int,
+      ctypes.c_int, ctypes.c_int, ctypes.c_int,
+      ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+      ctypes.c_int, ctypes.c_int, ctypes.c_int,
       ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
     ]
     lib.cseg_free.restype = None

@@ -26,10 +26,13 @@ int pick_bits(int n_distinct) {
   return -1;
 }
 
-// encode one channel: img is an (sx, sy, sz) C-ordered array (stride order:
-// z fastest in memory). Voxels inside a block are enumerated x-fastest.
+// encode one channel: img is an (sx, sy, sz) array with ELEMENT strides
+// (stx, sty, stz) — any layout (C, Fortran, sliced views). Voxels inside
+// a block are enumerated x-fastest regardless of memory layout (the
+// format fixes the traversal; strides only change where we read).
 template <typename T>
 std::vector<uint32_t> encode_channel(const T* img, int sx, int sy, int sz,
+                                     int64_t stx, int64_t sty, int64_t stz,
                                      int bx, int by, int bz) {
   const int gx = (sx + bx - 1) / bx;
   const int gy = (sy + by - 1) / by;
@@ -60,11 +63,15 @@ std::vector<uint32_t> encode_channel(const T* img, int sx, int sy, int sz,
         // gather block voxels, x fastest
         vals.clear();
         vals.reserve(n);
-        for (int dz = 0; dz < cz; dz++)
-          for (int dy = 0; dy < cy; dy++)
+        for (int dz = 0; dz < cz; dz++) {
+          for (int dy = 0; dy < cy; dy++) {
+            const T* row =
+                img + (int64_t)(z0 + dz) * stz + (int64_t)(y0 + dy) * sty +
+                (int64_t)x0 * stx;
             for (int dx = 0; dx < cx; dx++)
-              vals.push_back(img[(int64_t)(x0 + dx) * sy * sz +
-                                 (int64_t)(y0 + dy) * sz + (z0 + dz)]);
+              vals.push_back(row[(int64_t)dx * stx]);
+          }
+        }
 
         // sorted distinct table + per-voxel index (matches np.unique order)
         table = vals;
@@ -183,11 +190,15 @@ extern "C" {
 
 // Returns number of uint32 words written to *out (malloc'd; caller frees
 // with cseg_free), or 0 on failure.
-int64_t cseg_encode_channel(const void* img, int is64, int sx, int sy, int sz,
-                            int bx, int by, int bz, uint32_t** out) {
+int64_t cseg_encode_channel_strided(const void* img, int is64, int sx,
+                                    int sy, int sz, int64_t stx, int64_t sty,
+                                    int64_t stz, int bx, int by, int bz,
+                                    uint32_t** out) {
   std::vector<uint32_t> enc =
-      is64 ? encode_channel<uint64_t>((const uint64_t*)img, sx, sy, sz, bx, by, bz)
-           : encode_channel<uint32_t>((const uint32_t*)img, sx, sy, sz, bx, by, bz);
+      is64 ? encode_channel<uint64_t>((const uint64_t*)img, sx, sy, sz, stx,
+                                      sty, stz, bx, by, bz)
+           : encode_channel<uint32_t>((const uint32_t*)img, sx, sy, sz, stx,
+                                      sty, stz, bx, by, bz);
   if (enc.empty() && (int64_t)sx * sy * sz > 0) {
     const int gx = (sx + bx - 1) / bx, gy = (sy + by - 1) / by,
               gz = (sz + bz - 1) / bz;

@@ -52,7 +52,7 @@ def border_targets(
   consolidation welds them. ``low_sides[axis]`` is True when a neighbor
   task exists below (pin plane index 0); the high plane at index
   core_shape[axis] is pinned whenever the cutout includes it."""
-  from ..ops.ccl import connected_components
+  from ..ops.ccl import _ccl_native
 
   out: Dict[int, List[np.ndarray]] = defaultdict(list)
   for axis in range(3):
@@ -67,8 +67,31 @@ def border_targets(
       plane = labels[tuple(sl)]
       # ONE multilabel CC per plane instead of one label() per label:
       # a 1-thick 6-connected slab is exactly in-plane 4-connectivity,
-      # and multilabel components equal the per-label binary components
-      comps = connected_components(plane[:, :, None])[:, :, 0]
+      # and multilabel components equal the per-label binary components.
+      # This is host-side pin bookkeeping on tiny planes — NEVER dispatch
+      # it to the device CCL kernel (a per-plane XLA compile would
+      # dominate the task); use the native host kernel or scipy.
+      got = _ccl_native(np.ascontiguousarray(plane[:, :, None]), 6)
+      others = [a for a in range(3) if a != axis]
+      if got is None:
+        # no toolchain: per-label scipy labeling (the original path)
+        from scipy import ndimage
+
+        for label in np.unique(plane):
+          if label == 0:
+            continue
+          patch, n = ndimage.label(plane == label)
+          for comp in range(1, n + 1):
+            pts = np.argwhere(patch == comp)
+            centroid = pts.mean(axis=0)
+            nearest = pts[np.argmin(((pts - centroid) ** 2).sum(axis=1))]
+            coord = np.zeros(3, dtype=np.int64)
+            coord[axis] = plane_idx
+            coord[others[0]] = nearest[0]
+            coord[others[1]] = nearest[1]
+            out[int(label)].append(coord)
+        continue
+      comps = got[0][:, :, 0]
       flat = comps.ravel()
       fg = np.flatnonzero(flat)
       if len(fg) == 0:
@@ -81,7 +104,6 @@ def border_targets(
       ends = np.concatenate([starts[1:], [len(order)]])
       w = plane.shape[1]
       plane_flat = plane.ravel()
-      others = [a for a in range(3) if a != axis]
       for s, e in zip(starts, ends):
         members = order[s:e]
         pts = np.stack([members // w, members % w], axis=1)
