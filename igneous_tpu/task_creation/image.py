@@ -722,11 +722,15 @@ def create_luminance_levels_tasks(
 
   vol = Volume(src_path, mip=mip)
   task_bounds = get_bounds(
-    vol, bounds, mip, mip if bounds_mip is None else bounds_mip
+    vol, bounds, mip, mip if bounds_mip is None else bounds_mip,
+    chunk_size=vol.meta.chunk_size(mip),
   )
   if shape is None:
+    # one task per CHUNK-Z-ALIGNED z slab (not per z slice): the task
+    # downloads sampled patches as whole z columns and histograms every
+    # slice from memory, so each stored chunk decodes exactly once
     sz3 = task_bounds.size3()
-    shape = (int(sz3.x), int(sz3.y), 1)
+    shape = (int(sz3.x), int(sz3.y), int(vol.meta.chunk_size(mip).z))
   shape = Vec(*shape)
 
   def make_task(shape_: Vec, offset: Vec):

@@ -84,27 +84,34 @@ class LuminanceLevelsTask(RegisteredTask):
     xs = rng.integers(0, max(sx - patch, 0) + 1, size=n_patches)
     ys = rng.integers(0, max(sy - patch, 0) + 1, size=n_patches)
 
+    # download each sampled patch ONCE as a full z column (a 1-z-thick
+    # read would decode the whole chunk-z-thick chunk per slice), but
+    # STREAM the columns: accumulate per-z histograms and drop each
+    # column before the next download so peak memory stays one column,
+    # not coverage_factor x the slab
+    hists = np.zeros((sz, LEVELS_BINS), dtype=np.int64)
+    n_samples = 0
+    for px, py in zip(xs, ys):
+      col_box = Bbox(
+        bounds.minpt + (int(px), int(py), 0),
+        bounds.minpt + (int(px) + patch, int(py) + patch, sz),
+      )
+      col = vol.download(col_box)[..., 0]
+      n_samples += col.shape[0] * col.shape[1]
+      binned = (col // col.dtype.type(width)).astype(np.int64)
+      for dz in range(sz):
+        hists[dz] += np.bincount(
+          binned[:, :, dz].reshape(-1), minlength=LEVELS_BINS,
+        )[:LEVELS_BINS]
     for dz in range(sz):
       z = int(bounds.minpt.z) + dz
-      samples = []
-      for px, py in zip(xs, ys):
-        patch_box = Bbox(
-          bounds.minpt + (int(px), int(py), dz),
-          bounds.minpt + (int(px) + patch, int(py) + patch, dz + 1),
-        )
-        samples.append(vol.download(patch_box)[..., 0].reshape(-1))
-      sample = np.concatenate(samples)
-      hist = np.bincount(
-        (sample // sample.dtype.type(width)).astype(np.int64),
-        minlength=LEVELS_BINS,
-      )[:LEVELS_BINS]
       cf.put_json(
         f"{levels_key(self.mip)}/{z}",
         {
-          "levels": hist.tolist(),
+          "levels": hists[dz].tolist(),
           "bin_width": int(width),
           "patch_size": [patch, patch, 1],
-          "num_samples": int(len(sample)),
+          "num_samples": int(n_samples),
           "coverage_ratio": self.coverage_factor,
         },
       )
