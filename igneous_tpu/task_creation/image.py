@@ -782,7 +782,8 @@ def create_contrast_normalization_tasks(
     dest = Volume.create(dest_path, info)
 
   task_bounds = get_bounds(
-    src, bounds, mip, mip if bounds_mip is None else bounds_mip
+    src, bounds, mip, mip if bounds_mip is None else bounds_mip,
+    chunk_size=src.meta.chunk_size(mip),
   )
   if shape is None:
     cs = dest.meta.chunk_size(0)
@@ -845,7 +846,8 @@ def create_clahe_tasks(
     dest = Volume.create(dest_path, info)
 
   task_bounds = get_bounds(
-    src, bounds, mip, mip if bounds_mip is None else bounds_mip
+    src, bounds, mip, mip if bounds_mip is None else bounds_mip,
+    chunk_size=src.meta.chunk_size(mip),
   )
   shape = Vec(*shape)
 
