@@ -408,6 +408,47 @@ def bench_host_kernels(img, seg):
   return (img.size + seg.size) / dt
 
 
+def bench_forge_pipelines():
+  """e2e forge rates on a small blobby segmentation (BASELINE configs
+  3/5 pipeline-level): mesh forge (sharded, device count pass + host
+  emit/weld/QEM) and skeleton forge with exact cross-sections."""
+  from igneous_tpu.volume import Volume
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.storage import clear_memory_storage
+
+  rng = np.random.default_rng(0)
+  n = 64 if QUICK else 128
+  g = np.indices((n, n, n)).astype(np.float32)
+  seg = np.zeros((n, n, n), dtype=np.uint64)
+  for i in range(8):
+    c = rng.integers(n // 8, n - n // 8, 3)
+    r = rng.integers(n // 12, n // 5)
+    seg[((g[0] - c[0]) ** 2 + (g[1] - c[1]) ** 2 + (g[2] - c[2]) ** 2) < r * r] = i + 1
+  clear_memory_storage()
+  Volume.from_numpy(
+    seg, "mem://bench/forge", resolution=(16, 16, 40),
+    chunk_size=(n, n, n), layer_type="segmentation",
+  )
+  tq = LocalTaskQueue(parallel=1, progress=False)
+
+  t0 = time.perf_counter()
+  tq.insert(tc.create_meshing_tasks(
+    "mem://bench/forge", shape=(n, n, n), sharded=True, spatial_index=True,
+  ))
+  mesh_dt = time.perf_counter() - t0
+
+  t0 = time.perf_counter()
+  tq.insert(tc.create_skeletonizing_tasks(
+    "mem://bench/forge", shape=(n, n, n), dust_threshold=50,
+    teasar_params={"scale": 4, "const": 200},
+    cross_sectional_area=True, csa_smoothing_window=2,
+  ))
+  skel_dt = time.perf_counter() - t0
+  clear_memory_storage()
+  return round(seg.size / mesh_dt, 1), round(seg.size / skel_dt, 1)
+
+
 def run_bench(platform: str):
   if platform == "tpu":
     # Never report CPU numbers as TPU: a fast axon-init failure silently
@@ -432,6 +473,7 @@ def run_bench(platform: str):
   ccl_relax_rate = bench_ccl_kernel("relax") if platform == "tpu" else None
   pool_ab = bench_pool_ab() if platform == "tpu" else None
   edt_rate = bench_edt_kernel()
+  mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
 
   # Headline = the framework's production kernel path on this platform:
   # device pyramid on TPU; on the CPU fallback, the native threaded host
@@ -459,6 +501,8 @@ def run_bench(platform: str):
       "e2e_batched_voxps": round(e2e_batched, 1),
       "transfer_MBps_up_down": [up, down],
       "mesh_count_kernel_voxps": round(mesh_rate, 1),
+      "mesh_forge_e2e_voxps": mesh_forge_rate,
+      "skeleton_forge_csa_e2e_voxps": skel_forge_rate,
       "ccl_kernel_voxps": round(ccl_rate, 1),
       "ccl_relax_kernel_voxps": (
         round(ccl_relax_rate, 1) if ccl_relax_rate is not None else None
