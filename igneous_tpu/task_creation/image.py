@@ -586,6 +586,10 @@ def create_image_shard_downsample_tasks(
       del vol.info["scales"][dmip]
     dest_mips = dest_mips[:max_mips]
     specs = specs[:max_mips]
+  if max_mips > 1 and (encoding or vol.meta.encoding(mip)) == "jpeg":
+    # lossy pyramids keep their TOP mip lossless so further downsample
+    # passes can build on it reliably (reference :714-718)
+    vol.meta.set_encoding(dest_mips[-1], "png", 9)
   vol.commit_info()
 
   shape = Vec(*stride)

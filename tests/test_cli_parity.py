@@ -473,3 +473,21 @@ def test_roi_updates_info(tmp_path):
   rois = info["scales"][0]["rois"]  # reference location + format
   assert len(rois) == 1
   assert rois[0] == [8, 8, 0, 23, 23, 7]  # inclusive max corners
+
+
+def test_sharded_jpeg_pyramid_top_mip_lossless():
+  """Multi-mip jpeg sharded pyramids store the TOP mip as png so later
+  passes can build on it losslessly (reference image.py:714-718)."""
+  x = np.linspace(0, 6, 128)
+  img = (127 + 120 * np.sin(x)[:, None, None] * np.cos(x)[None, :, None]
+         * np.ones((1, 1, 32))).astype(np.uint8)
+  Volume.from_numpy(img, "mem://jp/v", chunk_size=(32, 32, 32),
+                    layer_type="image", encoding="jpeg", compress=None)
+  tq().insert(tc.create_image_shard_downsample_tasks(
+    "mem://jp/v", mip=0, num_mips=2, encoding="jpeg",
+    memory_target=int(1e8)))
+  vol = Volume("mem://jp/v")
+  encs = [s["encoding"] for s in vol.info["scales"]]
+  assert encs[1] == "jpeg" and encs[-1] == "png", encs
+  v2 = Volume("mem://jp/v", mip=len(encs) - 1)
+  assert v2.download(v2.bounds).shape[0] > 0
