@@ -33,6 +33,18 @@ from .common import GridTaskIterator, get_bounds, operator_contact
 MEMORY_TARGET = int(3.5e9)  # bytes per task, reference default (image.py:74)
 
 
+def _resolve_auto_compress(compress, encoding, vol, mip):
+  """compress="auto": gzip for encodings that benefit (raw, cseg,
+  compresso, crackle); no second-stage compression for self-compressed
+  codecs (reference _select_compression_by_encoding, image.py:913-919)."""
+  if compress != "auto":
+    return compress
+  enc = (encoding or vol.meta.encoding(mip)).lower()
+  if enc in ("raw", "compressed_segmentation", "compresso", "crackle"):
+    return "gzip"
+  return False
+
+
 def _provenance(vol: Volume, method: dict):
   vol.meta.refresh_provenance()
   vol.meta.add_provenance_entry(jsonify(method), operator_contact())
@@ -110,6 +122,7 @@ def create_downsampling_tasks(
   string "isotropic" (per-mip factors from the reference's near-isotropic
   planners, driving resolution toward isotropy)."""
   vol = Volume(layer_path, mip=mip)
+  compress = _resolve_auto_compress(compress, encoding, vol, mip)
   if isinstance(factor, str):
     if factor != "isotropic":
       raise ValueError(f"unknown factor spec {factor!r}")
@@ -213,6 +226,7 @@ def create_transfer_tasks(
   this build has no https storage backend, so it only implies
   ``no_src_update`` like the reference (:1033)."""
   src = Volume(src_layer_path, mip=mip)
+  compress = _resolve_auto_compress(compress, encoding, src, mip)
   if factor is None:
     factor = DEFAULT_FACTOR
 
