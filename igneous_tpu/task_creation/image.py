@@ -114,6 +114,7 @@ def create_downsampling_tasks(
   bounds_mip: int = 0,
   memory_target: int = MEMORY_TARGET,
   downsample_method: str = "auto",
+  preserve_chunk_size: bool = True,
 ):
   """Grid of DownsampleTasks; creates the destination scales first
   (reference: task_creation/image.py:195-345).
@@ -123,6 +124,11 @@ def create_downsampling_tasks(
   planners, driving resolution toward isotropy)."""
   vol = Volume(layer_path, mip=mip)
   compress = _resolve_auto_compress(compress, encoding, vol, mip)
+  if (not preserve_chunk_size and chunk_size is None
+      and vol.meta.num_mips > mip + 1):
+    # reference add_scales(preserve_chunk_size=False): reuse the NEXT
+    # mip's existing chunking for the new scales (downsample_scales.py:233)
+    chunk_size = [int(v) for v in vol.meta.chunk_size(mip + 1)]
   if isinstance(factor, str):
     if factor != "isotropic":
       raise ValueError(f"unknown factor spec {factor!r}")
@@ -212,6 +218,8 @@ def create_transfer_tasks(
   truncate_scales: bool = True,
   cutout: bool = False,
   use_https_for_source: bool = False,
+  max_mips: Optional[int] = None,
+  preserve_chunk_size: bool = True,
 ):
   """Grid of TransferTasks; creates/extends the destination info
   (reference: task_creation/image.py:921-1170). ``agglomerate``/
@@ -227,6 +235,11 @@ def create_transfer_tasks(
   ``no_src_update`` like the reference (:1033)."""
   src = Volume(src_layer_path, mip=mip)
   compress = _resolve_auto_compress(compress, encoding, src, mip)
+  if max_mips is not None:
+    num_mips = max_mips  # reference kwarg name for the same cap
+  if (not preserve_chunk_size and chunk_size is None
+      and src.meta.num_mips > mip + 1):
+    chunk_size = [int(v) for v in src.meta.chunk_size(mip + 1)]
   if factor is None:
     factor = DEFAULT_FACTOR
 
@@ -727,6 +740,7 @@ def create_touch_tasks(
 
 def create_luminance_levels_tasks(
   src_path: str,
+  levels_path: Optional[str] = None,
   mip: int = 0,
   coverage_factor: float = 0.01,
   shape: Optional[Sequence[int]] = None,
@@ -754,6 +768,7 @@ def create_luminance_levels_tasks(
   def make_task(shape_: Vec, offset: Vec):
     return LuminanceLevelsTask(
       src_path=src_path,
+      levels_path_=levels_path,
       shape=shape_.tolist(),
       offset=offset.tolist(),
       mip=mip,
@@ -767,6 +782,7 @@ def create_luminance_levels_tasks(
 def create_contrast_normalization_tasks(
   src_path: str,
   dest_path: str,
+  levels_path: Optional[str] = None,
   mip: int = 0,
   clip_fraction: float = 0.01,
   shape: Optional[Sequence[int]] = None,
@@ -810,6 +826,7 @@ def create_contrast_normalization_tasks(
 
   def make_task(shape_: Vec, offset: Vec):
     return ContrastNormalizationTask(
+      levels_path_=levels_path,
       src_path=src_path,
       dest_path=dest_path,
       shape=shape_.tolist(),
@@ -896,12 +913,17 @@ def create_voxel_counting_tasks(
   shape: Sequence[int] = (512, 512, 512),
   bounds: Optional[Bbox] = None,
   fill_missing: bool = False,
+  agglomerate: bool = False,
+  timestamp: Optional[float] = None,
 ):
   """Census phase of voxel statistics (reference :1928-2030); reduce with
   tasks.stats.accumulate_voxel_counts."""
   from ..tasks.stats import CountVoxelsTask
 
   vol = Volume(cloudpath, mip=mip)
+  if agglomerate and vol.graphene is None:
+    # fail at creation, not in thousands of queued tasks
+    raise ValueError("agglomerate voxel counting requires a graphene:// path")
   task_bounds = get_bounds(vol, bounds, mip, mip)
   shape = Vec(*shape)
 
@@ -912,6 +934,8 @@ def create_voxel_counting_tasks(
       offset=offset.tolist(),
       mip=mip,
       fill_missing=fill_missing,
+      agglomerate=agglomerate,
+      timestamp=timestamp,
     )
 
   return GridTaskIterator(task_bounds, shape, make_task)
@@ -1000,16 +1024,29 @@ def create_reordering_tasks(
 
 def create_fixup_downsample_tasks(
   layer_path: str,
-  bad_bboxes: Sequence[Bbox],
+  bad_bboxes: Optional[Sequence[Bbox]] = None,
   mip: int = 0,
   shape: Sequence[int] = (2048, 2048, 64),
   fill_missing: bool = True,
   num_mips: int = 1,
   sparse: bool = False,
+  points: Optional[Sequence[Sequence[int]]] = None,
 ):
   """Re-run downsamples covering damaged regions (black spots)
-  (reference :1558-1581 repair tool)."""
+  (reference :1558-1581 repair tool). Give either bounding boxes or the
+  reference's form — one ``points`` coordinate inside each black spot."""
   vol = Volume(layer_path, mip=mip)
+  if bad_bboxes is None:
+    bad_bboxes = []
+  if points:
+    # reference semantics: points are MIP-0 (high-res) coordinates
+    # (compute_fixup_offsets, reference image.py:1547-1556)
+    ratio = np.asarray(vol.meta.downsample_ratio(mip), dtype=np.int64)
+    bad_bboxes = list(bad_bboxes) + [
+      Bbox(Vec(*(np.asarray(p, np.int64) // ratio)),
+           Vec(*(np.asarray(p, np.int64) // ratio)) + 1)
+      for p in points
+    ]
   shape = Vec(*shape)
   seen = set()
   for bbx in bad_bboxes:
@@ -1147,14 +1184,17 @@ def create_quantize_tasks(
   mip: int = 0,
   fill_missing: bool = False,
   chunk_size: Sequence[int] = (128, 128, 64),
+  encoding: str = "raw",
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
 ):
   shape = Vec(*shape)
   info = create_quantized_affinity_info(
-    src_layer, dest_layer, shape, mip, chunk_size
+    src_layer, dest_layer, shape, mip, chunk_size, encoding=encoding,
   )
   dest = Volume.create(dest_layer, info)
   src = Volume(src_layer, mip=mip)
-  task_bounds = src.meta.bounds(mip)
+  task_bounds = get_bounds(src, bounds, mip, bounds_mip)
 
   def make_task(shape_: Vec, offset: Vec):
     return QuantizeTask(

@@ -55,9 +55,16 @@ def create_skeletonizing_tasks(
   timestamp: Optional[float] = None,
   frag_path: Optional[str] = None,
   root_ids_cloudpath: Optional[str] = None,
+  cross_sectional_area_smoothing_window: Optional[int] = None,
+  cross_sectional_area_repair_sec_per_label: Optional[int] = None,
 ):
   """Stage-1 skeleton forge grid; creates the skeleton info with its
-  vertex_attributes (reference :68-388)."""
+  vertex_attributes (reference :68-388). The two long reference kwarg
+  spellings alias csa_smoothing_window / csa_repair_sec_per_label."""
+  if cross_sectional_area_smoothing_window is not None:
+    csa_smoothing_window = cross_sectional_area_smoothing_window
+  if cross_sectional_area_repair_sec_per_label is not None:
+    csa_repair_sec_per_label = cross_sectional_area_repair_sec_per_label
   vol = Volume(cloudpath, mip=mip)
   if vol.layer_type != "segmentation":
     raise ValueError("Skeletonization requires a segmentation layer")
@@ -200,6 +207,7 @@ def create_unsharded_skeleton_merge_tasks(
   tick_threshold: float = 6000.0,
   delete_fragments: bool = False,
   max_cable_length: Optional[float] = None,
+  crop: int = 0,
 ) -> Iterator:
   """Stage-2 merge split by decimal label prefix (reference :535-591;
   common.label_prefixes gives exactly-once coverage)."""
@@ -214,6 +222,7 @@ def create_unsharded_skeleton_merge_tasks(
       tick_threshold=tick_threshold,
       delete_fragments=delete_fragments,
       max_cable_length=max_cable_length,
+      crop=crop,
     )
 
 

@@ -547,6 +547,7 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
     tick_threshold: float = 6000.0,
     delete_fragments: bool = False,
     max_cable_length: Optional[float] = None,
+    crop: int = 0,
   ):
     self.cloudpath = cloudpath
     self.prefix = str(prefix)
@@ -557,12 +558,20 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
     self.max_cable_length = (
       float(max_cable_length) if max_cable_length is not None else None
     )
+    # trim this many voxels from each fragment's bbox faces before the
+    # merge (reference crop kwarg, tasks/skeleton.py:823,891-907; default
+    # 0 — this build's border-pinned fragments need no trimming)
+    self.crop = int(crop)
 
   def execute(self):
     vol = Volume(self.cloudpath)
     sdir = skel_dir_for(vol, self.skel_dir)
     cf = CloudFiles(vol.cloudpath)
-    attrs = (cf.get_json(f"{sdir}/info") or {}).get("vertex_attributes")
+    skel_info = cf.get_json(f"{sdir}/info") or {}
+    attrs = skel_info.get("vertex_attributes")
+    # fragment bboxes are voxel coords at the SKELETONIZATION mip (the
+    # info records it); vertices are physical nm
+    skel_mip = int(skel_info.get("mip", 0))
 
     frags = defaultdict(list)
     frag_keys = []
@@ -574,11 +583,28 @@ class UnshardedSkeletonMergeTask(RegisteredTask):
       frag_keys.append(key)
       frags[label].append(key)
 
+    res = np.asarray(vol.meta.resolution(skel_mip), dtype=np.float32)
     for label, keys in frags.items():
-      skels = [
-        Skeleton.from_precomputed(cf.get(k), vertex_attributes=attrs)
-        for k in keys
-      ]
+      skels = []
+      for k in keys:
+        skel = Skeleton.from_precomputed(cf.get(k), vertex_attributes=attrs)
+        if self.crop > 0:
+          # fragment filenames carry the task bbox: label:bbox.sk
+          bbx = Bbox.from_filename(k.split(":", 1)[1][: -len(".sk")])
+          lo = (np.asarray(bbx.minpt) + self.crop) * res
+          hi = (np.asarray(bbx.maxpt) - self.crop) * res
+          if np.any(hi <= lo):
+            # crop would swallow the whole fragment (thin remainder at a
+            # volume edge): keep it uncropped, like the reference's
+            # bbx.volume() <= 0 guard (tasks/skeleton.py:911-912)
+            skels.append(skel)
+            continue
+          keep = np.all(
+            (skel.vertices >= lo - 1e-3) & (skel.vertices <= hi + 1e-3),
+            axis=1,
+          )
+          skel = skel._select_vertices(keep)
+        skels.append(skel)
       merged = _merge_label(
         skels, self.dust_threshold, self.tick_threshold,
         self.max_cable_length,

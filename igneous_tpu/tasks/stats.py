@@ -36,12 +36,18 @@ class CountVoxelsTask(RegisteredTask):
     offset: Sequence[int],
     mip: int = 0,
     fill_missing: bool = False,
+    agglomerate: bool = False,
+    timestamp=None,
   ):
     self.cloudpath = cloudpath
     self.shape = Vec(*shape)
     self.offset = Vec(*offset)
     self.mip = int(mip)
     self.fill_missing = fill_missing
+    # graphene volumes: census the proofread ROOT ids as of timestamp
+    # (reference CountVoxelsTask agglomerate passthrough)
+    self.agglomerate = bool(agglomerate)
+    self.timestamp = timestamp
 
   def execute(self):
     vol = Volume(self.cloudpath, mip=self.mip, fill_missing=self.fill_missing,
@@ -51,7 +57,9 @@ class CountVoxelsTask(RegisteredTask):
     )
     if bounds.empty():
       return
-    img = vol.download(bounds)[..., 0]
+    img = vol.download(
+      bounds, agglomerate=self.agglomerate, timestamp=self.timestamp
+    )[..., 0]
     labels, counts = fastremap.unique(img, return_counts=True)
     cf = CloudFiles(vol.cloudpath)
     cf.put_json(

@@ -773,3 +773,34 @@ def test_native_xsection_matches_numpy_twin():
     a_native = cs._plane_cube_areas(vox, v, t, anis)
     a_py = cs._plane_cube_areas_py(vox, v, t, anis)
     assert abs(a_native - a_py) <= 1e-9 * max(1.0, a_py)
+
+
+def test_unsharded_merge_crop(tmp_path):
+  """crop=N trims fragment vertices within N voxels of their task bbox
+  faces before merging (reference crop kwarg, tasks/skeleton.py:891-907)."""
+  from igneous_tpu.volume import Volume
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.skeleton_io import Skeleton
+
+  seg = np.zeros((64, 16, 16), dtype=np.uint64)
+  seg[2:62, 5:11, 5:11] = 7
+  path = f"file://{tmp_path}/seg"
+  Volume.from_numpy(seg, path, chunk_size=(32, 16, 16),
+                    layer_type="segmentation")
+  tq = LocalTaskQueue(parallel=1, progress=False)
+  tq.insert(tc.create_skeletonizing_tasks(
+    path, shape=(32, 16, 16), dust_threshold=10, fix_borders=False,
+    teasar_params={"scale": 4, "const": 40}))
+  tq.insert(tc.create_unsharded_skeleton_merge_tasks(
+    path, dust_threshold=10, tick_threshold=0, crop=1))
+  vol = Volume(path)
+  sdir = vol.info["skeletons"]
+  blob = vol.cf.get(f"{sdir}/7")
+  assert blob is not None
+  sk = Skeleton.from_precomputed(blob)
+  assert len(sk.vertices) > 0
+  # the overlap voxel at the seam (x=32) is trimmed from both fragments
+  x = sk.vertices[:, 0]
+  assert not ((x > 31.01) & (x < 32.99)).any()
+  assert (x == 31.0).any() and (x == 33.0).any()  # crop keeps the edges
