@@ -295,6 +295,12 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
   parallel: int = 1,
   vertex_quantization_bits: int = 16,
   min_chunk_size: Optional[Sequence[int]] = None,
+  draco_compression_level: int = 7,
+  shard_index_bytes: int = 2**13,
+  minishard_index_bytes: int = 2**15,
+  minishard_index_encoding: str = "gzip",
+  min_shards: int = 1,
+  max_labels_per_shard: Optional[int] = None,
 ) -> Iterator:
   """Legacy unsharded meshes → sharded multires (reference :590-704).
   ``dest_cloudpath`` writes the converted meshes into a different volume
@@ -309,7 +315,14 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
   src = mesh_dir_for(vol, src_mesh_dir)  # raises if nothing is configured
   out = mesh_dir or f"{src}_multires"
   labels = legacy_manifest_labels(vol.cf, src)
-  spec = _multires_shard_spec(len(labels))
+  spec = _multires_shard_spec(
+    len(labels),
+    shard_index_bytes=shard_index_bytes,
+    minishard_index_bytes=minishard_index_bytes,
+    min_shards=min_shards,
+    max_labels_per_shard=max_labels_per_shard,
+    minishard_index_encoding=minishard_index_encoding,
+  )
   configure_multires_info(
     dest_cloudpath or cloudpath, out, sharding=spec.to_dict(),
     vertex_quantization_bits=vertex_quantization_bits,
@@ -325,6 +338,7 @@ def create_sharded_multires_mesh_from_unsharded_tasks(
       encoding=encoding,
       parallel=parallel,
       min_chunk_size=min_chunk_size,
+      draco_compression_level=draco_compression_level,
       dest_cloudpath=dest_cloudpath,
     )
 
@@ -362,11 +376,19 @@ def create_graphene_meshing_tasks(
   shape: Optional[Sequence[int]] = None,
   timestamp: Optional[float] = None,
   mesh_dir: Optional[str] = None,
+  simplification: bool = True,
   simplification_factor: int = 100,
   max_simplification_error: int = 40,
   fill_missing: bool = False,
   bounds: Optional[Bbox] = None,
+  object_ids: Optional[Sequence[int]] = None,
+  draco_compression_level: int = 1,
 ):
+  """``draco_compression_level`` is recorded for interface parity (this
+  build's draco encoder is fixed sequential-method); ``simplification``
+  False disables the simplifier like create_meshing_tasks."""
+  if not simplification:
+    simplification_factor = 1
   """Stage-1 graphene mesh forge (reference task_creation/mesh.py:269-361):
   L2-granularity draco meshes in sharded .frags containers. The task grid
   defaults to the chunk-graph's chunk size so every task covers whole L2

@@ -350,6 +350,7 @@ class GrapheneMeshTask(MeshTask):
     fill_missing: bool = False,
     encoding: str = "draco",
     timestamp: Optional[float] = None,
+    object_ids: Optional[Sequence[int]] = None,
   ):
     super().__init__(
       shape=shape,
@@ -363,4 +364,5 @@ class GrapheneMeshTask(MeshTask):
       encoding=encoding,
       sharded=True,
       timestamp=timestamp,
+      object_ids=object_ids,
     )
