@@ -429,6 +429,8 @@ def create_sharded_image_info(
   max_shard_index_bytes: int = 8192,
   minishard_index_bytes: int = 40000,
   min_shards: int = 1,
+  minishard_index_encoding: str = "gzip",
+  data_encoding: "str | None" = None,
 ) -> dict:
   """Sharding spec dict for an image scale. Fresh derivation of
   /root/reference/igneous/task_creation/image.py:347-505.
@@ -471,10 +473,11 @@ def create_sharded_image_info(
     "hash": "identity",
     "minishard_bits": minishard_bits,
     "shard_bits": shard_bits,
-    "minishard_index_encoding": "gzip",
+    "minishard_index_encoding": minishard_index_encoding,
     # gzip everything except codecs that are already entropy-coded
-    # (reference rule: task_creation/image.py:494-495)
-    "data_encoding": (
+    # (reference rule: task_creation/image.py:494-495); callers may
+    # force a data_encoding (e.g. compress=False -> raw)
+    "data_encoding": data_encoding or (
       "raw" if encoding in ("jpeg", "png", "jpegxl", "fpzip", "zfpc", "jxl")
       else "gzip"
     ),

@@ -412,16 +412,51 @@ def create_image_shard_transfer_tasks(
   bounds: Optional[Bbox] = None,
   bounds_mip: int = 0,
   uncompressed_shard_bytesize: int = MEMORY_TARGET,
+  memory_target: Optional[int] = None,
   cutout: bool = False,
   clean_info: bool = False,
   truncate_scales: bool = True,
+  agglomerate: bool = False,
+  timestamp: Optional[float] = None,
+  stop_layer: Optional[int] = None,
+  compress="auto",
+  minishard_index_encoding: str = "gzip",
+  use_https_for_source: bool = False,
 ):
   """Transfer into a SHARDED destination scale
-  (reference: task_creation/image.py:507-637)."""
+  (reference: task_creation/image.py:507-637). ``memory_target`` is the
+  reference's name for ``uncompressed_shard_bytesize``; ``compress``
+  False forces raw shard data encoding. ``use_https_for_source`` is a
+  parity no-op here (no https backend; sharded transfers never write
+  source provenance)."""
+  del use_https_for_source
   from ..sharding import create_sharded_image_info, image_shard_shape_from_spec
   from ..tasks.image_sharded import ImageShardTransferTask
 
   src = Volume(src_layer_path, mip=mip)
+  if memory_target is not None:
+    uncompressed_shard_bytesize = memory_target
+  materialize_ids = agglomerate or stop_layer is not None
+  if materialize_ids and src.graphene is None:
+    raise ValueError(
+      "agglomerate/stop_layer transfers require a graphene:// source"
+    )
+  if stop_layer not in (None, 1, 2):
+    raise ValueError(f"stop_layer must be 1 or 2: {stop_layer!r}")
+  if timestamp is not None and not materialize_ids:
+    raise ValueError(
+      "timestamp only applies with agglomerate=True or stop_layer"
+    )
+  # shard data encoding from the compress knob (reference image.py:552-572
+  # maps gzip-if-compress-else-raw; "auto" defers to the by-encoding rule)
+  if compress == "auto":
+    data_encoding = None
+  elif compress in (None, False, 0) or str(compress).lower() in ("none", "false"):
+    data_encoding = "raw"
+  elif compress is True or str(compress).lower() == "gzip":
+    data_encoding = "gzip"
+  else:
+    raise ValueError(f"unsupported shard compress: {compress!r}")
   src_scale = src.meta.scale(mip)
   dest_chunk = list(chunk_size) if chunk_size else src_scale["chunk_sizes"][0]
   dest_offset = (
@@ -434,8 +469,10 @@ def create_image_shard_transfer_tasks(
     dataset_size=src_scale["size"],
     chunk_size=dest_chunk,
     encoding=encoding or src_scale["encoding"],
-    dtype=src.meta.data_type,
+    dtype="uint64" if materialize_ids else src.meta.data_type,
     uncompressed_shard_bytesize=uncompressed_shard_bytesize,
+    minishard_index_encoding=minishard_index_encoding,
+    data_encoding=data_encoding,
   )
   # dest scale structure mirrors the source through `mip` so mip indices
   # line up; dest_voxel_offset applies at mip 0 geometry
@@ -514,6 +551,9 @@ def create_image_shard_transfer_tasks(
       mip=mip,
       fill_missing=fill_missing,
       translate=tuple(translate),
+      agglomerate=agglomerate,
+      timestamp=timestamp,
+      stop_layer=stop_layer,
     )
 
   def finish():
@@ -543,6 +583,9 @@ def create_image_shard_downsample_tasks(
   memory_target: int = MEMORY_TARGET,
   downsample_method: str = "auto",
   num_mips: int = 1,
+  agglomerate: bool = False,
+  timestamp: Optional[float] = None,
+  truncate_scales: bool = False,
 ):
   """Downsampled SHARDED mips, several per pass (reference:
   task_creation/image.py:639-807). Each of the ``num_mips`` new scales
@@ -555,6 +598,22 @@ def create_image_shard_downsample_tasks(
   from ..tasks.image_sharded import ImageShardDownsampleTask
 
   vol = Volume(layer_path, mip=mip)
+  if agglomerate and vol.graphene is None:
+    raise ValueError("agglomerate downsamples require a graphene:// source")
+  if agglomerate and vol.meta.data_type != "uint64":
+    # Precomputed data_type is volume-global: agglomerated root ids are
+    # uint64 and cannot be stored into a narrower watershed layer's own
+    # scales — materialize roots into a uint64 destination first
+    # (create_image_shard_transfer_tasks(agglomerate=True)), then
+    # downsample that
+    raise ValueError(
+      f"agglomerate downsamples write uint64 root ids, but this layer's "
+      f"data_type is {vol.meta.data_type}; transfer the roots to a "
+      f"uint64 destination first"
+    )
+  if truncate_scales:
+    # drop scales above mip before regenerating them (reference :685-687)
+    vol.info["scales"] = vol.info["scales"][: mip + 1]
   factor = tuple(int(v) for v in factor)
   num_mips = max(int(num_mips), 1)
   cs = list(chunk_size) if chunk_size else [int(v) for v in vol.meta.chunk_size(mip)]
@@ -573,7 +632,7 @@ def create_image_shard_downsample_tasks(
       dataset_size=dest_size,
       chunk_size=cs,
       encoding=encoding or vol.meta.encoding(mip),
-      dtype=vol.meta.data_type,
+      dtype=vol.meta.data_type,  # uint64 when agglomerate (validated above)
       # the task must hold the SOURCE region for this shard: one dest
       # voxel at mip+i costs prod(cum) source voxels plus the pyramid
       uncompressed_shard_bytesize=max(
@@ -637,6 +696,8 @@ def create_image_shard_downsample_tasks(
       factor=list(factor),
       downsample_method=downsample_method,
       num_mips=max_mips,
+      agglomerate=agglomerate,
+      timestamp=timestamp,
     )
 
   def finish():
@@ -1031,6 +1092,7 @@ def create_fixup_downsample_tasks(
   num_mips: int = 1,
   sparse: bool = False,
   points: Optional[Sequence[Sequence[int]]] = None,
+  axis: str = "z",
 ):
   """Re-run downsamples covering damaged regions (black spots)
   (reference :1558-1581 repair tool). Give either bounding boxes or the
@@ -1068,6 +1130,7 @@ def create_fixup_downsample_tasks(
         fill_missing=fill_missing,
         sparse=sparse,
         num_mips=num_mips,
+        factor=tuple(int(v) for v in axis_to_factor(axis)),
       )
 
 

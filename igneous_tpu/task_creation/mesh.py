@@ -384,15 +384,17 @@ def create_graphene_meshing_tasks(
   object_ids: Optional[Sequence[int]] = None,
   draco_compression_level: int = 1,
 ):
-  """``draco_compression_level`` is recorded for interface parity (this
-  build's draco encoder is fixed sequential-method); ``simplification``
-  False disables the simplifier like create_meshing_tasks."""
-  if not simplification:
-    simplification_factor = 1
   """Stage-1 graphene mesh forge (reference task_creation/mesh.py:269-361):
   L2-granularity draco meshes in sharded .frags containers. The task grid
   defaults to the chunk-graph's chunk size so every task covers whole L2
-  chunks (their ids are per-(root, chunk))."""
+  chunks (their ids are per-(root, chunk)).
+
+  ``draco_compression_level`` is recorded for interface parity (this
+  build's draco encoder is fixed sequential-method); ``simplification``
+  False disables the simplifier like create_meshing_tasks."""
+  del draco_compression_level
+  if not simplification:
+    simplification_factor = 1
   from ..tasks.mesh import GrapheneMeshTask
 
   vol = Volume(cloudpath, mip=mip)
@@ -434,6 +436,7 @@ def create_graphene_meshing_tasks(
 
   def make_task(shape_: Vec, offset: Vec):
     return GrapheneMeshTask(
+      object_ids=list(object_ids) if object_ids else None,
       shape=shape_.tolist(),
       offset=offset.tolist(),
       layer_path=cloudpath,

@@ -35,6 +35,9 @@ class ImageShardTransferTask(RegisteredTask):
     mip: int = 0,
     fill_missing: bool = False,
     translate: Sequence[int] = (0, 0, 0),
+    agglomerate: bool = False,
+    timestamp=None,
+    stop_layer=None,
   ):
     self.src_path = src_path
     self.dest_path = dest_path
@@ -43,6 +46,11 @@ class ImageShardTransferTask(RegisteredTask):
     self.mip = int(mip)
     self.fill_missing = fill_missing
     self.translate = Vec(*translate)
+    # graphene sources: materialize proofread root (or L2) ids while
+    # copying, mirroring TransferTask's surface
+    self.agglomerate = bool(agglomerate)
+    self.timestamp = timestamp
+    self.stop_layer = stop_layer
 
   def execute(self):
     src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
@@ -52,7 +60,10 @@ class ImageShardTransferTask(RegisteredTask):
     )
     if bounds.empty():
       return
-    img = src.download(bounds)
+    img = src.download(
+      bounds, agglomerate=self.agglomerate, timestamp=self.timestamp,
+      stop_layer=self.stop_layer,
+    )
     upload_shard(dest, bounds.translate(self.translate), img, self.mip)
 
 
@@ -75,6 +86,8 @@ class ImageShardDownsampleTask(RegisteredTask):
     factor: Sequence[int] = (2, 2, 1),
     downsample_method: str = "auto",
     num_mips: int = 1,
+    agglomerate: bool = False,
+    timestamp=None,
   ):
     self.src_path = src_path
     self.shape = Vec(*shape)
@@ -85,6 +98,8 @@ class ImageShardDownsampleTask(RegisteredTask):
     self.factor = Vec(*factor)
     self.downsample_method = downsample_method
     self.num_mips = int(num_mips)
+    self.agglomerate = bool(agglomerate)
+    self.timestamp = timestamp
 
   def execute(self):
     vol = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
@@ -93,7 +108,9 @@ class ImageShardDownsampleTask(RegisteredTask):
     )
     if bounds.empty():
       return
-    img = vol.download(bounds)
+    img = vol.download(
+      bounds, agglomerate=self.agglomerate, timestamp=self.timestamp
+    )
     method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
     factor = tuple(int(v) for v in self.factor)
     mips_out = pooling.downsample_auto(
