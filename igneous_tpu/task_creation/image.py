@@ -805,6 +805,7 @@ def create_luminance_levels_tasks(
   mip: int = 0,
   coverage_factor: float = 0.01,
   shape: Optional[Sequence[int]] = None,
+  offset: Optional[Sequence[int]] = None,
   bounds: Optional[Bbox] = None,
   bounds_mip: Optional[int] = None,
   fill_missing: bool = False,
@@ -814,6 +815,9 @@ def create_luminance_levels_tasks(
   from ..tasks.contrast import LuminanceLevelsTask
 
   vol = Volume(src_path, mip=mip)
+  if offset is not None and bounds is None and shape is not None:
+    # reference shape/offset pair: one explicit task window
+    bounds = Bbox(Vec(*offset), Vec(*offset) + Vec(*shape))
   task_bounds = get_bounds(
     vol, bounds, mip, mip if bounds_mip is None else bounds_mip,
     chunk_size=vol.meta.chunk_size(mip),
@@ -1039,6 +1043,7 @@ def create_reordering_tasks(
   fill_missing: bool = False,
   encoding: Optional[str] = None,
   encoding_level: Optional[int] = None,
+  encoding_effort: Optional[int] = None,
   compress="gzip",
   delete_black_uploads: bool = False,
   background_color: int = 0,
@@ -1062,8 +1067,8 @@ def create_reordering_tasks(
     Volume(dest_path)
   except FileNotFoundError:
     dest = Volume.create(dest_path, info)
-    if encoding_level is not None:
-      dest.meta.set_encoding(0, None, encoding_level)
+    if encoding_level is not None or encoding_effort is not None:
+      dest.meta.set_encoding(0, None, encoding_level, encoding_effort)
       dest.commit_info()
 
   z0 = int(src.bounds.minpt.z)
