@@ -491,3 +491,37 @@ def test_sharded_jpeg_pyramid_top_mip_lossless():
   assert encs[1] == "jpeg" and encs[-1] == "png", encs
   v2 = Volume("mem://jp/v", mip=len(encs) - 1)
   assert v2.download(v2.bounds).shape[0] > 0
+
+
+def test_sharded_transfer_compress_mapping(tmp_path):
+  """compress=False forces raw shard data encoding; invalid values raise
+  (reference image.py:552-572 mapping)."""
+  img = np.random.default_rng(0).integers(0, 255, (64, 32, 16)).astype(np.uint8)
+  path = f"file://{tmp_path}/v"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 16), layer_type="image")
+  tq().insert(tc.create_image_shard_transfer_tasks(
+    path, f"file://{tmp_path}/raw_enc", compress=False,
+    memory_target=int(1e8)))
+  out = Volume(f"file://{tmp_path}/raw_enc")
+  assert out.meta.sharding(0)["data_encoding"] == "raw"
+  np.testing.assert_array_equal(out.download(out.bounds)[..., 0], img)
+  with pytest.raises(ValueError, match="compress"):
+    list(tc.create_image_shard_transfer_tasks(
+      path, f"file://{tmp_path}/bad", compress="br"))
+
+
+def test_sharded_graphene_guards(tmp_path):
+  """Eager validation: agglomerate sharded ops demand graphene sources
+  and a uint64 layer for in-place downsamples."""
+  img = np.random.default_rng(0).integers(0, 9, (32, 32, 16)).astype(np.uint32)
+  path = f"file://{tmp_path}/seg32"
+  Volume.from_numpy(img, path, chunk_size=(32, 32, 16),
+                    layer_type="segmentation")
+  with pytest.raises(ValueError, match="graphene"):
+    list(tc.create_image_shard_transfer_tasks(
+      path, f"file://{tmp_path}/d", agglomerate=True))
+  with pytest.raises(ValueError, match="graphene"):
+    list(tc.create_image_shard_downsample_tasks(path, agglomerate=True))
+  with pytest.raises(ValueError, match="timestamp"):
+    list(tc.create_image_shard_transfer_tasks(
+      path, f"file://{tmp_path}/d2", timestamp=123))
