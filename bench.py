@@ -176,30 +176,47 @@ def bench_device_kernels(img, seg):
   return (img.size + seg.size) / dt
 
 
+BEST_OF_N = 2 if QUICK else 3
+
+
+def _best_of(once, n=BEST_OF_N):
+  """Best-of-N throughput sampling. A single sample taken in a contended
+  scheduler window can underreport by orders of magnitude (the round-3
+  artifact recorded 46x below the real rate); the max over N samples is
+  the least-contended estimate of what the kernels actually sustain."""
+  return max(once() for _ in range(n))
+
+
 def bench_cpu_kernels(img, seg):
-  """Single-core CPU comparator rate. Prefers the native C++ pooling
-  kernels (oracle-verified semantics twins — the closest in-image
+  """Single-core CPU comparator rate (best-of-N). Prefers the native C++
+  pooling kernels (oracle-verified semantics twins — the closest in-image
   stand-in for tinybrain, which a zero-egress build cannot vendor);
   falls back to the numpy oracles when no toolchain exists."""
   from igneous_tpu.native import pooling_lib
   from igneous_tpu.ops import oracle
 
   pooling_lib()  # build/load outside the timed region (g++ on first use)
-  t0 = time.perf_counter()
-  a = oracle.native_downsample_with_averaging(
-    img, (2, 2, 1), NUM_MIPS, parallel=1
-  )
-  b = oracle.native_downsample_segmentation(
-    seg, (2, 2, 1), NUM_MIPS, parallel=1
-  )
-  if a is not None and b is not None:
-    dt = time.perf_counter() - t0
-    return (img.size + seg.size) / dt, "native-C++ pooling x8-core credit"
-  t0 = time.perf_counter()
-  oracle.np_downsample_with_averaging(img, (2, 2, 1), NUM_MIPS)
-  oracle.np_downsample_segmentation(seg, (2, 2, 1), NUM_MIPS)
-  dt = time.perf_counter() - t0
-  return (img.size + seg.size) / dt, "numpy-oracle kernels x8-core credit"
+  if (
+    oracle.native_downsample_with_averaging(
+      img[:64, :64, :16], (2, 2, 1), 1, parallel=1
+    ) is not None
+    and oracle.native_downsample_segmentation(
+      seg[:64, :64, :16], (2, 2, 1), 1, parallel=1
+    ) is not None
+  ):
+    def once():
+      t0 = time.perf_counter()
+      oracle.native_downsample_with_averaging(img, (2, 2, 1), NUM_MIPS, parallel=1)
+      oracle.native_downsample_segmentation(seg, (2, 2, 1), NUM_MIPS, parallel=1)
+      return (img.size + seg.size) / (time.perf_counter() - t0)
+    return _best_of(once, BEST_OF_N), "native-C++ pooling x8-core credit"
+
+  def once():
+    t0 = time.perf_counter()
+    oracle.np_downsample_with_averaging(img, (2, 2, 1), NUM_MIPS)
+    oracle.np_downsample_segmentation(seg, (2, 2, 1), NUM_MIPS)
+    return (img.size + seg.size) / (time.perf_counter() - t0)
+  return _best_of(once, BEST_OF_N), "numpy-oracle kernels x8-core credit"
 
 
 # ---------------------------------------------------------------------------
@@ -401,11 +418,14 @@ def bench_host_kernels(img, seg):
   )
   if warm is None:
     return None
-  t0 = time.perf_counter()
-  pooling.host_downsample(img, (2, 2, 1), NUM_MIPS, method="average", parallel=0)
-  pooling.host_downsample(seg, (2, 2, 1), NUM_MIPS, method="mode", parallel=0)
-  dt = time.perf_counter() - t0
-  return (img.size + seg.size) / dt
+
+  def once():
+    t0 = time.perf_counter()
+    pooling.host_downsample(img, (2, 2, 1), NUM_MIPS, method="average", parallel=0)
+    pooling.host_downsample(seg, (2, 2, 1), NUM_MIPS, method="mode", parallel=0)
+    return (img.size + seg.size) / (time.perf_counter() - t0)
+
+  return _best_of(once, BEST_OF_N)
 
 
 def bench_forge_pipelines():
@@ -461,6 +481,24 @@ def run_bench(platform: str):
   dev_kernel = bench_device_kernels(img, seg)
   host_kernel = None if platform == "tpu" else bench_host_kernels(img, seg)
   cpu1, baseline_kind = bench_cpu_kernels(img, seg)
+
+  # Consistency guard (round-3 postmortem): on the CPU-fallback path the
+  # headline (threaded native pooling) and cpu_1core (the same kernels,
+  # one core) are measured seconds apart in the same process. The headline
+  # dropping below cpu_1core/4 is physically impossible without external
+  # interference — the r03 artifact recorded exactly that (21.5M headline
+  # vs 1.09G cpu_1core) and poisoned the round's official signal. Discard
+  # and re-measure instead of publishing a contended sample.
+  guard_retries = 0
+  while (
+    host_kernel is not None
+    and host_kernel < cpu1 / 4
+    and guard_retries < 3
+  ):
+    guard_retries += 1
+    time.sleep(3)  # let whatever is contending drain
+    host_kernel = bench_host_kernels(img, seg)
+
   cpu8 = cpu1 * 8.0
   e2e = bench_e2e(img, seg)
   e2e_batched = bench_e2e_batched(img, seg)
@@ -495,6 +533,9 @@ def run_bench(platform: str):
       # the baseline credits the reference with 8 cores; on a smaller
       # fallback host the per-core ratio is the informative comparison
       "host_cores": len(os.sched_getaffinity(0)),
+      "load_avg": [round(x, 2) for x in os.getloadavg()],
+      "best_of_n": BEST_OF_N,
+      "guard_retries": guard_retries,
       "cpu_1core_kernel_voxps": round(cpu1, 1),
       "cpu8_baseline_voxps": round(cpu8, 1),
       "e2e_pipeline_voxps": round(e2e, 1),
