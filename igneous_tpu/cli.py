@@ -1340,9 +1340,13 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
               help="Suppress per-task status messages.")
 @click.option("--time", "timing", is_flag=True,
               help="Log per-task wall time + stage breakdown as JSON lines.")
+@click.option("--batch", "batch_size", default=1, show_default=True, type=int,
+              help="Lease up to K compatible tasks per round and run their "
+                   "device stage as ONE mesh dispatch (SURVEY §5.8). Each "
+                   "lease still completes/recycles independently.")
 @click.pass_context
 def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
-            exit_on_empty, min_sec, quiet, timing):
+            exit_on_empty, min_sec, quiet, timing, batch_size):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
@@ -1370,7 +1374,7 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
       ctx_mp.Process(
         target=_execute_worker,
         args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-              timing, quiet, tally),
+              timing, quiet, tally, batch_size),
       )
       for _ in range(parallel)
     ]
@@ -1380,11 +1384,11 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
       p.join()
     return
   _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                  timing, quiet, tally)
+                  timing, quiet, tally, batch_size)
 
 
 def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                    timing=False, quiet=False, tally=True):
+                    timing=False, quiet=False, tally=True, batch_size=1):
   import time
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
@@ -1404,6 +1408,34 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     if empty and 0 <= min_sec <= (time.time() - start):
       return True
     return False
+
+  if batch_size > 1:
+    from .parallel.lease_batcher import poll_batched
+
+    if timing:
+      click.echo(
+        "--time is per-task; batched rounds share device dispatches, so "
+        "it is ignored with --batch > 1", err=True,
+      )
+    # honor --num-tasks / the min_sec==0 single-task special exactly: the
+    # lease loop must not lease past the remaining budget
+    task_budget = None
+    if num_tasks is not None and num_tasks >= 0:
+      task_budget = num_tasks
+    if min_sec == 0:
+      task_budget = 1 if task_budget is None else min(task_budget, 1)
+    executed, stats = poll_batched(
+      tq, batch_size=batch_size, lease_seconds=lease_sec,
+      verbose=not quiet, stop_fn=stop_fn, task_budget=task_budget,
+    )
+    if not quiet:
+      click.echo(
+        f"executed {executed} tasks "
+        f"({stats['batched']} batched in "
+        f"{sum(stats['dispatches'].values())} dispatches, "
+        f"{stats['solo']} solo, {stats['failed']} failed)"
+      )
+    return
 
   before_fn = after_fn = None
   if timing:

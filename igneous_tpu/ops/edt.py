@@ -426,6 +426,27 @@ def _host_backend() -> str:
 _BATCH_EXECUTORS = {}
 
 
+def batch_edt_executor(anisotropy, mesh=None):
+  """Cached BatchKernelExecutor for the squared-EDT kernel, keyed by
+  anisotropy + mesh so callers (the lease batcher) can pin dispatches to
+  an injected device mesh instead of the full device set."""
+  wx, wy, wz = (float(a) for a in anisotropy)
+  mesh_key = (
+    None if mesh is None
+    else (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+  )
+  key = (wx, wy, wz, mesh_key)
+  if key not in _BATCH_EXECUTORS:
+    from functools import partial as _partial
+
+    from ..parallel.executor import BatchKernelExecutor
+
+    _BATCH_EXECUTORS[key] = BatchKernelExecutor(
+      _partial(_edt_sq_kernel, anisotropy=(wx, wy, wz)), mesh=mesh
+    )
+  return _BATCH_EXECUTORS[key]
+
+
 def edt_batch(
   labels_batch: np.ndarray,
   anisotropy: Sequence[float] = (1.0, 1.0, 1.0),
@@ -458,16 +479,7 @@ def edt_batch(
   dev = np.ascontiguousarray(lab32.transpose(0, 3, 2, 1))  # (K, z, y, x)
   wx, wy, wz = (float(a) for a in anisotropy)
   if executor is None:
-    key = (wx, wy, wz)
-    if key not in _BATCH_EXECUTORS:
-      from functools import partial as _partial
-
-      from ..parallel.executor import BatchKernelExecutor
-
-      _BATCH_EXECUTORS[key] = BatchKernelExecutor(
-        _partial(_edt_sq_kernel, anisotropy=key)
-      )
-    executor = _BATCH_EXECUTORS[key]
+    executor = batch_edt_executor((wx, wy, wz))
   sq = executor(dev)
   outs = []
   for k in range(len(labels_batch)):

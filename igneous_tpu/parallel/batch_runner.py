@@ -36,6 +36,26 @@ _to_batch_layout = _to_device_layout
 _from_batch_layout = _from_device_layout
 
 
+def device_pyramid_batch(executor, imgs, is_u64_mode: bool):
+  """K same-shape (x,y,z[,c]) cutouts → per-mip batch arrays via ONE
+  ChunkExecutor dispatch. uint64 mode rides as (lo, hi) uint32 planes and
+  comes back packed. Shared by batched_downsample and the lease batcher."""
+  if is_u64_mode:
+    # zero-copy strided views; the one copy per plane happens in
+    # _to_batch_layout's contiguity fixup (shared helpers with
+    # ops.pooling.downsample — keep the two paths in sync)
+    planes = [_split_u64_planes(i) for i in imgs]
+    lo = np.stack([_to_batch_layout(l) for l, _ in planes])
+    hi = np.stack([_to_batch_layout(h) for _, h in planes])
+    outs, _ = executor((lo, hi))
+    return [
+      _pack_u64_planes(np.asarray(ol), np.asarray(oh)) for ol, oh in outs
+    ]
+  batch = np.stack([_to_batch_layout(i) for i in imgs])
+  outs, _ = executor(batch)
+  return outs
+
+
 def batched_downsample(
   layer_path: str,
   mip: int = 0,
@@ -118,20 +138,7 @@ def batched_downsample(
     return futures
 
   def run_batch(io_pool, boxes, imgs):
-    if is_u64_mode:
-      # zero-copy strided views; the one copy per plane happens in
-      # _to_batch_layout's contiguity fixup (shared helpers with
-      # ops.pooling.downsample — keep the two paths in sync)
-      planes = [_split_u64_planes(i) for i in imgs]
-      lo = np.stack([_to_batch_layout(l) for l, _ in planes])
-      hi = np.stack([_to_batch_layout(h) for _, h in planes])
-      outs, _ = executor((lo, hi))
-      mips_out = [
-        _pack_u64_planes(np.asarray(ol), np.asarray(oh)) for ol, oh in outs
-      ]
-    else:
-      batch = np.stack([_to_batch_layout(i) for i in imgs])
-      mips_out, _ = executor(batch)
+    mips_out = device_pyramid_batch(executor, imgs, is_u64_mode)
     stats["batched_cutouts"] += len(boxes)
     stats["dispatches"] += 1
     return upload_batch(io_pool, boxes, mips_out)

@@ -1,0 +1,428 @@
+"""Queue-leased batched execution — SURVEY.md §5.8's north star made real.
+
+The reference's worker loop (`igneous execute`, reference
+igneous_cli/cli.py:888-964) runs one task per lease. On a TPU host that
+wastes the chip: each task's device stage (a pooling pyramid, an EDT, a
+block CCL) occupies a sliver of the mesh while download/upload dominate
+wall clock. This module teaches the worker loop to lease up to K tasks,
+group the compatible ones (same type + same device-stage signature), and
+run each group's device stage as ONE shard_map'd dispatch across the
+mesh — while every lease still completes independently:
+
+  * a member whose host stage fails keeps its lease and recycles alone
+    after the visibility timeout (at-least-once, exactly like the solo
+    poll loop in queues/filequeue.py:36-80);
+  * a failed group dispatch fails all members the same way;
+  * outputs are byte-identical to solo execution — the group handlers
+    feed the batched device results back through the SAME completion
+    code paths the solo tasks use (downsample_and_upload(_mips_out=...),
+    SkeletonTask.execute(_prepared=..., _edt_field=...), the CCL
+    store_ccl_faces helpers).
+
+Batchable today: DownsampleTask (pooling pyramid), SkeletonTask (EDT),
+CCLFacesTask (block CCL), MeshTask (marching-cubes count pass). Anything
+else — or any member whose cutout clamps to a different shape — executes
+solo within the same lease round.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import random
+import time
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+from ..lib import Bbox
+
+
+def _group_key(task, volmeta_cache):
+  """Hashable device-stage signature, or None when the task must run solo.
+
+  Tasks whose device stage depends only on (cutout shape, dtype, kernel
+  params) batch together; the offset is the batch dimension. Keys embed
+  the PREDICTED cutout shape so boundary tasks clamped along the same
+  dataset faces still group, while ragged members fall out to solo."""
+  from ..tasks.ccl import CCLFacesTask
+  from ..tasks.image import DownsampleTask
+  from ..tasks.mesh import MeshTask
+  from ..tasks.skeleton import SkeletonTask
+
+  def bounds_of(path, mip, fill_missing=False):
+    key = (path, mip)
+    if key not in volmeta_cache:
+      from ..volume import Volume
+
+      volmeta_cache[key] = Volume(
+        path, mip=mip, fill_missing=fill_missing, bounded=False
+      ).meta.bounds(mip)
+    return volmeta_cache[key]
+
+  if type(task) is DownsampleTask:
+    bounds = bounds_of(task.src_path, task.mip, task.fill_missing)
+    box = Bbox.intersection(
+      Bbox(task.offset, task.offset + task.shape), bounds
+    )
+    if box.empty() or box != Bbox(task.offset, task.offset + task.shape):
+      return None  # clamped edge cutout: shapes differ, run solo
+    return (
+      "downsample", task.src_path, int(task.mip),
+      tuple(int(v) for v in task.shape),
+      None if task.factor is None else tuple(int(v) for v in task.factor),
+      task.num_mips, bool(task.sparse), bool(task.fill_missing),
+      task.downsample_method, task.compress,
+      bool(task.delete_black_uploads), int(task.background_color),
+    )
+
+  if type(task) is SkeletonTask:
+    bounds = bounds_of(task.cloudpath, task.mip, task.fill_missing)
+    core = Bbox.intersection(
+      Bbox(task.offset, task.offset + task.shape), bounds
+    )
+    if core.empty():
+      return None  # solo path no-ops it cheaply
+    cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
+    return (
+      "skeleton", task.cloudpath, int(task.mip),
+      tuple(int(v) for v in cutout.size3()), bool(task.fill_missing),
+    )
+
+  if type(task) is CCLFacesTask:
+    from ..ops.ccl import _ccl_backend
+
+    if _ccl_backend() == "native":
+      # CPU-only host: per-cutout native union-find IS the fast path
+      # (same policy as ops.ccl.connected_components_batch)
+      return None
+    bounds = bounds_of(task.src_path, task.mip, task.fill_missing)
+    cutout = Bbox.intersection(
+      Bbox(task.offset, task.offset + task.shape + 1), bounds
+    )
+    if cutout.empty():
+      return None
+    return (
+      "ccl_faces", task.src_path, int(task.mip),
+      tuple(int(v) for v in cutout.size3()),
+      task.threshold_gte, task.threshold_lte,
+      int(task.dust_threshold), bool(task.fill_missing),
+    )
+
+  if type(task) is MeshTask:
+    # mesh cutouts need not share shapes: the count pass batches per
+    # per-label mask bucket, which already spans tasks (see
+    # _run_mesh_group); the kernel and resolution must agree though
+    return ("mesh", task.layer_path, int(task.mip), task.mesher)
+
+  return None
+
+
+class LeaseBatcher:
+  """Worker loop that leases up to ``batch_size`` tasks per round and
+  runs compatible device stages as single mesh dispatches."""
+
+  def __init__(
+    self,
+    queue,
+    batch_size: int = 8,
+    lease_seconds: float = 600,
+    mesh=None,
+    verbose: bool = False,
+  ):
+    self.queue = queue
+    self.batch_size = int(batch_size)
+    self.lease_seconds = lease_seconds
+    self.mesh = mesh
+    self.verbose = verbose
+    self.stats = {
+      "executed": 0, "batched": 0, "solo": 0, "failed": 0,
+      "dispatches": defaultdict(int),
+    }
+
+  # -- poll loop ------------------------------------------------------------
+
+  def poll(
+    self,
+    stop_fn=None,
+    max_backoff_window: float = 30.0,
+    task_budget: Optional[int] = None,
+  ) -> int:
+    """Lease K → group → dispatch → complete each lease independently.
+    Same stop_fn/backoff contract as queues.filequeue.poll_loop.
+    ``task_budget`` caps TOTAL executed tasks: the lease loop never takes
+    more leases than the remaining budget, so ``--num-tasks N`` means N
+    even when N < batch_size (stop_fn alone is only consulted between
+    rounds and would overshoot by up to batch_size-1)."""
+    backoff = 1.0
+    while True:
+      if stop_fn is not None and stop_fn(
+        executed=self.stats["executed"], empty=False
+      ):
+        return self.stats["executed"]
+      cap = self.batch_size
+      if task_budget is not None:
+        cap = min(cap, task_budget - self.stats["executed"])
+        if cap <= 0:
+          return self.stats["executed"]
+      members = []
+      while len(members) < cap:
+        leased = self.queue.lease(self.lease_seconds)
+        if leased is None:
+          break
+        members.append(leased)
+      if not members:
+        if stop_fn is not None and stop_fn(
+          executed=self.stats["executed"], empty=True
+        ):
+          return self.stats["executed"]
+        time.sleep(backoff + random.random())
+        backoff = min(backoff * 2, max_backoff_window)
+        continue
+      backoff = 1.0
+      self.run_round(members)
+
+  def run_round(self, members):
+    """Execute one lease round: group, dispatch groups, solo the rest."""
+    volmeta_cache = {}
+    groups = defaultdict(list)
+    solo = []
+    for task, lease_id in members:
+      try:
+        key = _group_key(task, volmeta_cache)
+      except Exception:
+        key = None  # unreadable metadata: the solo path surfaces it
+      if key is None:
+        solo.append((task, lease_id))
+      else:
+        groups[key].append((task, lease_id))
+
+    for key, group in groups.items():
+      if len(group) == 1:
+        solo.extend(group)
+        continue
+      handler = {
+        "downsample": self._run_downsample_group,
+        "skeleton": self._run_skeleton_group,
+        "ccl_faces": self._run_ccl_group,
+        "mesh": self._run_mesh_group,
+      }[key[0]]
+      try:
+        handler(key, group)
+      except Exception:
+        # group-stage failure: every member keeps its lease and recycles
+        if self.verbose:
+          import traceback
+
+          traceback.print_exc()
+        self.stats["failed"] += len(group)
+
+    for task, lease_id in solo:
+      if self.verbose:
+        print(f"Executing (solo) {task!r}")
+      try:
+        task.execute()
+      except Exception:
+        if self.verbose:
+          import traceback
+
+          traceback.print_exc()
+        self.stats["failed"] += 1
+        continue
+      self.queue.delete(lease_id)
+      self.stats["executed"] += 1
+      self.stats["solo"] += 1
+
+  # -- completion plumbing --------------------------------------------------
+
+  def _complete(self, lease_id):
+    self.queue.delete(lease_id)
+    self.stats["executed"] += 1
+    self.stats["batched"] += 1
+
+  def _finish_members(self, group, finish_one):
+    """Run each member's host completion; a failure keeps that member's
+    lease only."""
+    for idx, (task, lease_id) in enumerate(group):
+      try:
+        finish_one(idx, task)
+      except Exception:
+        if self.verbose:
+          import traceback
+
+          traceback.print_exc()
+        self.stats["failed"] += 1
+        continue
+      self._complete(lease_id)
+
+  # -- group handlers -------------------------------------------------------
+
+  def _run_downsample_group(self, key, group):
+    """K downsample cutouts → one ChunkExecutor pyramid dispatch; uploads
+    go back through downsample_and_upload so chunk bytes match solo."""
+    from ..ops import pooling
+    from ..tasks.image import _resolve_factors, downsample_and_upload
+    from ..volume import Volume
+    from .batch_runner import _from_batch_layout, device_pyramid_batch
+    from .executor import cached_chunk_executor, make_mesh
+
+    t0 = group[0][0]
+    src = Volume(t0.src_path, mip=t0.mip, fill_missing=t0.fill_missing)
+    dest = Volume(
+      t0.dest_path, mip=t0.mip, fill_missing=t0.fill_missing,
+      delete_black_uploads=t0.delete_black_uploads,
+      background_color=t0.background_color,
+    )
+    factors = _resolve_factors(dest, t0.mip, t0.shape, t0.num_mips, t0.factor)
+    if not factors:
+      # nothing to produce; solo semantics are a clean no-op per task
+      for _task, lease_id in group:
+        self._complete(lease_id)
+      return
+    method = pooling.method_for_layer(dest.layer_type, t0.downsample_method)
+    boxes = [Bbox(t.offset, t.offset + t.shape) for t, _ in group]
+    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+      imgs = list(io_pool.map(src.download, boxes))
+    is_u64 = method == "mode" and dest.dtype.itemsize == 8
+    mesh = self.mesh if self.mesh is not None else make_mesh()
+    executor = cached_chunk_executor(
+      mesh, factors=tuple(factors), method=method, sparse=t0.sparse,
+      planes=2 if is_u64 else 1,
+    )
+    mips_out = device_pyramid_batch(executor, imgs, is_u64)
+    self.stats["dispatches"]["downsample"] += 1
+
+    def finish(k, task):
+      downsample_and_upload(
+        None, boxes[k], dest,
+        task_shape=task.shape, mip=task.mip, num_mips=task.num_mips,
+        factor=task.factor, sparse=task.sparse,
+        method=task.downsample_method, compress=task.compress,
+        _mips_out=[_from_batch_layout(np.asarray(m[k])) for m in mips_out],
+      )
+
+    self._finish_members(group, finish)
+
+  def _run_skeleton_group(self, key, group):
+    """K skeleton cutouts → one batched EDT dispatch; TEASAR and uploads
+    run through SkeletonTask.execute(_prepared, _edt_field)."""
+    from ..ops.edt import _host_backend, batch_edt_executor, edt_batch
+    from ..volume import Volume
+
+    t0 = group[0][0]
+    vol = Volume(
+      t0.cloudpath, mip=t0.mip, fill_missing=t0.fill_missing, bounded=False
+    )
+    anis = tuple(float(v) for v in vol.resolution)
+
+    def prep(task):
+      return task.prepare_labels(Volume(
+        t0.cloudpath, mip=t0.mip, fill_missing=task.fill_missing,
+        bounded=False,
+      ))
+
+    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+      preps = list(io_pool.map(prep, [t for t, _ in group]))
+
+    live = [i for i, p in enumerate(preps) if p is not None]
+    fields = {}
+    if live:
+      labels_batch = np.stack([preps[i][0] for i in live])
+      # only pin the executor to the injected mesh when edt_batch would
+      # take the device path anyway: an explicit executor bypasses its
+      # host-backend fallback, which is what keeps batched EDTs
+      # bit-identical to solo skeletonize on accelerator-less hosts
+      pin = self.mesh is not None and _host_backend() == "device"
+      edts = edt_batch(
+        labels_batch, anis, black_border=True,
+        executor=batch_edt_executor(anis, mesh=self.mesh) if pin else None,
+      )
+      self.stats["dispatches"]["skeleton"] += 1
+      fields = {i: f for i, f in zip(live, edts)}
+
+    def finish(k, task):
+      if preps[k] is None:
+        return  # empty core: solo execute() is the same clean no-op
+      task.execute(_prepared=preps[k], _edt_field=fields[k])
+
+    self._finish_members(group, finish)
+
+  def _run_ccl_group(self, key, group):
+    """K CCL cutouts → one batched block-CCL dispatch; face planes are
+    stored by the same helpers CCLFacesTask.execute uses."""
+    from ..ops.ccl import _batch_executor, connected_components_batch
+    from ..storage import CloudFiles
+    from ..tasks.ccl import (
+      _offset_components,
+      _prep_ccl_image,
+      ccl_scratch_path,
+      store_ccl_faces,
+    )
+
+    t0 = group[0][0]
+    files = CloudFiles(t0.src_path)
+    scratch = ccl_scratch_path(t0.src_path, t0.mip)
+
+    def prep(task):
+      return _prep_ccl_image(
+        task.src_path, task.mip, task.shape, task.offset,
+        task.fill_missing, task.threshold_gte, task.threshold_lte,
+        task.dust_threshold,
+      )
+
+    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+      preps = list(io_pool.map(prep, [t for t, _ in group]))
+
+    imgs = np.stack([p[0] for p in preps])
+    comps = connected_components_batch(
+      imgs, executor=_batch_executor(6, mesh=self.mesh)
+    )
+    self.stats["dispatches"]["ccl_faces"] += 1
+
+    def finish(k, task):
+      _img, cutout, core = preps[k]
+      cc = _offset_components(comps[k], task.task_num, task.shape)
+      store_ccl_faces(cc, cutout, core, task.task_num, files, scratch)
+
+    self._finish_members(group, finish)
+
+  def _run_mesh_group(self, key, group):
+    """K mesh cutouts → the marching-cubes count pass batches across ALL
+    tasks' labels per mask-shape bucket (one dispatch per bucket instead
+    of per task); emit/weld/simplify/upload stay per task."""
+    from ..tasks.mesh import execute_mesh_tasks_batched
+
+    dispatches = execute_mesh_tasks_batched(
+      [t for t, _ in group], mesh=self.mesh,
+    )
+    self.stats["dispatches"]["mesh"] += dispatches
+
+    def finish(k, task):
+      if getattr(task, "_batch_error", None) is not None:
+        err = task._batch_error
+        task._batch_error = None
+        raise err
+
+    self._finish_members(group, finish)
+
+
+def poll_batched(
+  queue,
+  batch_size: int = 8,
+  lease_seconds: float = 600,
+  verbose: bool = False,
+  stop_fn=None,
+  max_backoff_window: float = 30.0,
+  mesh=None,
+  task_budget: Optional[int] = None,
+):
+  """Functional entry point mirroring queues.filequeue.poll_loop."""
+  batcher = LeaseBatcher(
+    queue, batch_size=batch_size, lease_seconds=lease_seconds,
+    mesh=mesh, verbose=verbose,
+  )
+  executed = batcher.poll(
+    stop_fn=stop_fn, max_backoff_window=max_backoff_window,
+    task_budget=task_budget,
+  )
+  return executed, batcher.stats

@@ -207,7 +207,7 @@ class ShardingSpecification:
 
   def _encode(self, data: bytes, encoding: str) -> bytes:
     if encoding == "gzip":
-      return gzip_mod.compress(data, compresslevel=6)
+      return gzip_mod.compress(data, compresslevel=6, mtime=0)
     return data
 
   def _decode(self, data: bytes, encoding: str) -> bytes:

@@ -48,7 +48,11 @@ def compress_bytes(data: bytes, method) -> bytes:
   if method in (None, False, ""):
     return data
   if method == "gzip":
-    return gzip_mod.compress(data, compresslevel=6)
+    # mtime=0 keeps output deterministic: re-running a task writes
+    # byte-identical objects (idempotent at-least-once execution), and
+    # the lease batcher's byte-identity contract with solo execution
+    # stays literally true for compressed chunks
+    return gzip_mod.compress(data, compresslevel=6, mtime=0)
   if method == "zstd":
     return zstandard.ZstdCompressor().compress(data)
   raise ValueError(f"Unsupported compression: {method}")

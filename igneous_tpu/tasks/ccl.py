@@ -40,7 +40,7 @@ from ..ops import remap as fastremap
 def _npy_bytes(arr: np.ndarray) -> bytes:
   buf = io.BytesIO()
   np.save(buf, arr)
-  return gzip.compress(buf.getvalue(), compresslevel=4)
+  return gzip.compress(buf.getvalue(), compresslevel=4, mtime=0)
 
 
 def _npy_load(data: bytes) -> np.ndarray:

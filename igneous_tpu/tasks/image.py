@@ -27,6 +27,25 @@ from ..ops import pooling
 from .. import telemetry
 
 
+def _resolve_factors(
+  vol: Volume,
+  mip: int,
+  task_shape: Sequence[int],
+  num_mips: Optional[int],
+  factor: Optional[Sequence[int]],
+):
+  """The pyramid schedule downsample_and_upload will follow — shared with
+  the lease batcher so its one-dispatch device stage produces exactly the
+  mips the solo path would."""
+  if factor is None:
+    factor = DEFAULT_FACTOR
+  available = vol.meta.num_mips - mip - 1
+  if num_mips is None:
+    num_mips = available
+  num_mips = min(num_mips, available)
+  return compute_factors(task_shape, factor, num_mips)
+
+
 def downsample_and_upload(
   image: np.ndarray,
   bounds: Bbox,
@@ -38,28 +57,29 @@ def downsample_and_upload(
   sparse: bool = False,
   method: str = "auto",
   compress="gzip",
+  _mips_out=None,
 ):
   """Build the mip pyramid for one cutout and upload every level.
 
   ``image`` covers ``bounds`` at ``mip``; mips mip+1… are written while
-  scales exist in the destination info (or up to num_mips)."""
-  if factor is None:
-    factor = DEFAULT_FACTOR
-  available = vol.meta.num_mips - mip - 1
-  if num_mips is None:
-    num_mips = available
-  num_mips = min(num_mips, available)
-  factors = compute_factors(task_shape, factor, num_mips)
+  scales exist in the destination info (or up to num_mips). ``_mips_out``
+  injects a pre-computed pyramid (the lease batcher's one-dispatch device
+  stage) so only the upload loop runs here — keeping batched chunk bytes
+  identical to solo execution."""
+  factors = _resolve_factors(vol, mip, task_shape, num_mips, factor)
   if not factors:
     return
 
-  method = pooling.method_for_layer(vol.layer_type, method)
-  # uint64 labels are handled natively (hi/lo uint32 planes on device);
-  # hosts with no accelerator dispatch to the native C++ kernels instead
-  with telemetry.stage("device_pool"):
-    mips_out = pooling.downsample_auto(
-      image, factors, len(factors), method=method, sparse=sparse
-    )
+  if _mips_out is not None:
+    mips_out = _mips_out
+  else:
+    method = pooling.method_for_layer(vol.layer_type, method)
+    # uint64 labels are handled natively (hi/lo uint32 planes on device);
+    # hosts with no accelerator dispatch to the native C++ kernels instead
+    with telemetry.stage("device_pool"):
+      mips_out = pooling.downsample_auto(
+        image, factors, len(factors), method=method, sparse=sparse
+      )
 
   cur_bounds = bounds.clone()
   for i, mipped in enumerate(mips_out):

@@ -106,6 +106,29 @@ class MeshTask(RegisteredTask):
     self.parallel = int(parallel)
 
   def execute(self):
+    ctx = self.prepare_jobs()
+    if ctx is None:
+      return
+    mesher_batch = (
+      marching_cubes_batch if self.mesher == "cubes"
+      else marching_tetrahedra_batch
+    )
+    for g0 in range(0, len(ctx["jobs"]), self.MESH_BATCH):
+      group = ctx["jobs"][g0 : g0 + self.MESH_BATCH]
+      results = mesher_batch(
+        self.group_masks(ctx, group),
+        anisotropy=ctx["resolution"],
+        offsets=self.group_offsets(ctx, group),
+      )
+      self.finish_group(ctx, group, results)
+    self.finalize(ctx)
+
+  def prepare_jobs(self):
+    """Download + label prep + job planning — everything before the
+    device count pass. Returns a context dict (or None when there is
+    nothing to mesh) so the lease batcher can merge many tasks' label
+    masks into shared count-pass dispatches (parallel/lease_batcher.py);
+    execute() drives the same stages solo."""
     vol = Volume(
       self.layer_path, mip=self.mip, fill_missing=self.fill_missing,
       bounded=False,
@@ -113,7 +136,7 @@ class MeshTask(RegisteredTask):
     bounds = vol.meta.bounds(self.mip)
     core = Bbox.intersection(Bbox(self.offset, self.offset + self.shape), bounds)
     if core.empty():
-      return
+      return None
     # 1-voxel high-side overlap: adjacent tasks share a boundary plane so
     # their surfaces meet exactly (reference mesh.py:64-69,155-160)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
@@ -179,7 +202,7 @@ class MeshTask(RegisteredTask):
     labels = labels[sel]
     if len(labels) == 0:
       self._upload({}, core, cutout, vol)
-      return
+      return None
 
     # crop each label to its bounding box (find_objects) before meshing
     dense, mapping = fastremap.renumber(img)
@@ -202,48 +225,58 @@ class MeshTask(RegisteredTask):
       )
       jobs.append((int(orig), grow, int(new_id)))
 
-    meshes = {}
-    label_bounds = {}
-    res_int = np.asarray(vol.resolution, dtype=np.int64)
-    for g0 in range(0, len(jobs), self.MESH_BATCH):
-      group = jobs[g0 : g0 + self.MESH_BATCH]
-      mesher_batch = (
-        marching_cubes_batch if self.mesher == "cubes"
-        else marching_tetrahedra_batch
-      )
-      results = mesher_batch(
-        [dense[grow] == new_id for _, grow, new_id in group],
-        anisotropy=resolution,
-        offsets=[
-          np.asarray(origin, dtype=np.float32)
-          + np.asarray([g.start for g in grow], dtype=np.float32)
-          for _, grow, _ in group
-        ],
-      )
-      def _finish(args):
-        (orig, grow, _), (verts, faces) = args
-        mesh = Mesh(verts, faces)
-        if self.simplification_factor > 1:
-          mesh = simplify(
-            mesh, self.simplification_factor, self.max_simplification_error
-          )
-        mn = (np.asarray([g.start for g in grow]) + np.asarray(origin)) * res_int
-        mx = (np.asarray([g.stop for g in grow]) + np.asarray(origin)) * res_int
-        return orig, mesh, Bbox(mn, mx)
+    return {
+      "vol": vol, "core": core, "cutout": cutout, "origin": origin,
+      "dense": dense, "jobs": jobs, "resolution": resolution,
+      "res_int": np.asarray(vol.resolution, dtype=np.int64),
+      "meshes": {}, "label_bounds": {},
+    }
 
-      pairs = list(zip(group, results))
-      if self.parallel > 1 and len(pairs) > 1:
-        from concurrent.futures import ThreadPoolExecutor
+  @staticmethod
+  def group_masks(ctx, group):
+    return [ctx["dense"][grow] == new_id for _, grow, new_id in group]
 
-        with ThreadPoolExecutor(max_workers=self.parallel) as ex:
-          finished = list(ex.map(_finish, pairs))
-      else:
-        finished = [_finish(p) for p in pairs]
-      for orig, mesh, bbx in finished:
-        meshes[orig] = mesh
-        label_bounds[orig] = bbx
+  @staticmethod
+  def group_offsets(ctx, group):
+    return [
+      np.asarray(ctx["origin"], dtype=np.float32)
+      + np.asarray([g.start for g in grow], dtype=np.float32)
+      for _, grow, _ in group
+    ]
 
-    self._upload(meshes, core, cutout, vol, label_bounds)
+  def finish_group(self, ctx, group, results):
+    """Host stage for one group of labels: weld/simplify/bbox, threaded
+    by self.parallel like the solo path."""
+    origin, res_int = ctx["origin"], ctx["res_int"]
+
+    def _finish(args):
+      (orig, grow, _), (verts, faces) = args
+      mesh = Mesh(verts, faces)
+      if self.simplification_factor > 1:
+        mesh = simplify(
+          mesh, self.simplification_factor, self.max_simplification_error
+        )
+      mn = (np.asarray([g.start for g in grow]) + np.asarray(origin)) * res_int
+      mx = (np.asarray([g.stop for g in grow]) + np.asarray(origin)) * res_int
+      return orig, mesh, Bbox(mn, mx)
+
+    pairs = list(zip(group, results))
+    if self.parallel > 1 and len(pairs) > 1:
+      from concurrent.futures import ThreadPoolExecutor
+
+      with ThreadPoolExecutor(max_workers=self.parallel) as ex:
+        finished = list(ex.map(_finish, pairs))
+    else:
+      finished = [_finish(p) for p in pairs]
+    for orig, mesh, bbx in finished:
+      ctx["meshes"][orig] = mesh
+      ctx["label_bounds"][orig] = bbx
+
+  def finalize(self, ctx):
+    self._upload(
+      ctx["meshes"], ctx["core"], ctx["cutout"], ctx["vol"],
+      ctx["label_bounds"],
+    )
 
   def _upload(self, meshes, core, cutout, vol, label_bounds=None):
     mdir = mesh_dir_for(vol, self.mesh_dir)
@@ -271,6 +304,108 @@ class MeshTask(RegisteredTask):
 
     if self.spatial_index and label_bounds is not None:
       SpatialIndex(cf, mdir).put(physical, label_bounds)
+
+
+class _CountingKernelExecutor:
+  """Wraps a BatchKernelExecutor to count device dispatches (the lease
+  batcher's stats surface asserts on these)."""
+
+  def __init__(self, inner):
+    self.inner = inner
+    self.calls = 0
+
+  def __call__(self, batch):
+    self.calls += 1
+    return self.inner(batch)
+
+
+def execute_mesh_tasks_batched(tasks, batch_size=None, mesh=None):
+  """Run K MeshTasks with the marching-cubes count pass batched ACROSS
+  tasks: all tasks' per-label masks feed one shared dispatch stream (per
+  mask-shape bucket) instead of each task filling its own partial
+  batches. Host stages (weld/simplify/upload) stay per task and
+  byte-identical to solo execution.
+
+  Callers group tasks by (layer, mip, mesher) — see
+  parallel/lease_batcher.py — so resolution and kernel agree across the
+  stream; ``mesh`` pins dispatches to an injected device mesh. Per-task
+  failures are stashed on ``task._batch_error`` (the lease batcher
+  re-raises them per member so only that lease recycles); returns the
+  number of device dispatches issued.
+  """
+  import concurrent.futures as cf
+
+  from ..ops.mesh import _count_kernel, _mc_count_kernel, _mc_executor, _mt_executor
+
+  bs = int(batch_size) if batch_size else MeshTask.MESH_BATCH
+  for t in tasks:
+    t._batch_error = None
+
+  def prep(task):
+    try:
+      return task.prepare_jobs()
+    except Exception as e:  # noqa: BLE001 - stashed, re-raised per lease
+      task._batch_error = e
+      return None
+
+  with cf.ThreadPoolExecutor(max_workers=8) as pool:
+    ctxs = list(pool.map(prep, tasks))
+
+  stream = []
+  for task, ctx in zip(tasks, ctxs):
+    if ctx is None:
+      continue
+    for job in ctx["jobs"]:
+      stream.append((task, ctx, job))
+
+  mesher = tasks[0].mesher
+  if mesh is not None:
+    from ..parallel.executor import BatchKernelExecutor
+
+    inner = BatchKernelExecutor(
+      _mc_count_kernel if mesher == "cubes" else _count_kernel, mesh=mesh
+    )
+  else:
+    inner = _mc_executor() if mesher == "cubes" else _mt_executor()
+  counting = _CountingKernelExecutor(inner)
+  mesher_batch = (
+    marching_cubes_batch if mesher == "cubes" else marching_tetrahedra_batch
+  )
+  for g0 in range(0, len(stream), bs):
+    grp = [e for e in stream[g0 : g0 + bs] if e[0]._batch_error is None]
+    if not grp:
+      continue
+    masks = [t.group_masks(ctx, [job])[0] for t, ctx, job in grp]
+    offsets = [t.group_offsets(ctx, [job])[0] for t, ctx, job in grp]
+    results = mesher_batch(
+      masks, anisotropy=grp[0][1]["resolution"], offsets=offsets,
+      executor=counting, batch_size=bs,
+    )
+    # hand each task its own labels' results
+    per_task = {}
+    for (task, ctx, job), res in zip(grp, results):
+      per_task.setdefault(id(task), (task, ctx, [], []))
+      per_task[id(task)][2].append(job)
+      per_task[id(task)][3].append(res)
+    for task, ctx, jobs, ress in per_task.values():
+      try:
+        task.finish_group(ctx, jobs, ress)
+      except Exception as e:  # noqa: BLE001
+        task._batch_error = e
+  dispatches = counting.calls
+
+  def final(args):
+    task, ctx = args
+    if ctx is None or task._batch_error is not None:
+      return
+    try:
+      task.finalize(ctx)
+    except Exception as e:  # noqa: BLE001
+      task._batch_error = e
+
+  with cf.ThreadPoolExecutor(max_workers=8) as pool:
+    list(pool.map(final, zip(tasks, ctxs)))
+  return dispatches
 
 
 class MeshManifestPrefixTask(RegisteredTask):

@@ -1,0 +1,319 @@
+"""Queue-leased batched execution (SURVEY §5.8 north star, VERDICT r3 #2).
+
+The contract under test: `igneous-tpu execute --batch K` leases up to K
+compatible tasks from fq://, runs their device stage as ONE dispatch, and
+every lease completes independently — with outputs byte-identical to solo
+execution (deterministic gzip makes that literal)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from igneous_tpu import task_creation as tc
+from igneous_tpu.downsample_scales import create_downsample_scales
+from igneous_tpu.parallel import make_mesh
+from igneous_tpu.parallel.lease_batcher import LeaseBatcher, poll_batched
+from igneous_tpu.queues import FileQueue
+from igneous_tpu.tasks.image import DownsampleTask
+from igneous_tpu.volume import Volume
+
+
+def _tree(root):
+  out = {}
+  for dirpath, _dirs, files in os.walk(root):
+    for f in files:
+      p = os.path.join(dirpath, f)
+      rel = os.path.relpath(p, root)
+      with open(p, "rb") as fh:
+        out[rel] = fh.read()
+  return out
+
+
+def assert_trees_identical(a, b, ignore=()):
+  ta, tb = _tree(a), _tree(b)
+  for pat in ignore:
+    ta = {k: v for k, v in ta.items() if pat not in k}
+    tb = {k: v for k, v in tb.items() if pat not in k}
+  assert set(ta) == set(tb), (
+    f"file sets differ: only-solo={sorted(set(ta)-set(tb))[:5]} "
+    f"only-batched={sorted(set(tb)-set(ta))[:5]}"
+  )
+  diff = [k for k in ta if ta[k] != tb[k]]
+  assert not diff, f"bytes differ for {diff[:10]}"
+
+
+def drain(queue, batch_size=8, mesh=None):
+  def stop_fn(executed, empty):
+    return empty
+
+  return poll_batched(
+    queue, batch_size=batch_size, lease_seconds=600, stop_fn=stop_fn,
+    mesh=mesh,
+  )
+
+
+@pytest.fixture
+def img_pair(tmp_path, rng):
+  """Two identical uint8 volumes (512x256x64) with 2 downsample scales."""
+  data = rng.integers(0, 255, (512, 256, 64)).astype(np.uint8)
+  paths = []
+  for name in ("solo", "batched"):
+    path = f"file://{tmp_path}/{name}"
+    vol = Volume.from_numpy(data, path, chunk_size=(32, 32, 32))
+    create_downsample_scales(
+      vol.meta, 0, (128, 128, 64), (2, 2, 1), num_mips=2
+    )
+    vol.commit_info()
+    paths.append(path)
+  return tmp_path, paths[0], paths[1]
+
+
+def _downsample_tasks(path):
+  return [
+    DownsampleTask(
+      layer_path=path, mip=0, shape=(128, 128, 64), offset=(x, y, 0),
+      num_mips=2, factor=(2, 2, 1),
+    )
+    for x in (0, 128, 256, 384)
+    for y in (0, 128)
+  ]
+
+
+def test_downsample_batch_one_dispatch_byte_identical(img_pair, tmp_path):
+  root, solo_path, batched_path = img_pair
+  for t in _downsample_tasks(solo_path):
+    t.execute()
+
+  q = FileQueue(f"fq://{tmp_path}/q1")
+  q.insert(_downsample_tasks(batched_path))
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+
+  assert executed == 8
+  assert stats["batched"] == 8
+  assert stats["dispatches"]["downsample"] == 1  # 8 cutouts, ONE dispatch
+  assert q.is_empty() and q.completed == 8
+  assert_trees_identical(f"{root}/solo", f"{root}/batched")
+
+
+def test_downsample_u64_mode_batch(tmp_path, rng):
+  """Segmentation (mode pooling, uint64 planes) through the lease path."""
+  blocks = (rng.integers(1, 2**40, (8, 4, 2)) * 7).astype(np.uint64)
+  data = np.kron(blocks, np.ones((32, 32, 32), dtype=np.uint64))
+  paths = []
+  for name in ("s", "b"):
+    path = f"file://{tmp_path}/seg_{name}"
+    vol = Volume.from_numpy(
+      data, path, chunk_size=(32, 32, 32), layer_type="segmentation"
+    )
+    create_downsample_scales(vol.meta, 0, (128, 64, 64), (2, 2, 1), num_mips=1)
+    vol.commit_info()
+    paths.append(path)
+
+  def tasks(path):
+    return [
+      DownsampleTask(
+        layer_path=path, mip=0, shape=(128, 64, 64), offset=(x, y, 0),
+        num_mips=1, factor=(2, 2, 1),
+      )
+      for x in (0, 128) for y in (0, 64)
+    ]
+
+  for t in tasks(paths[0]):
+    t.execute()
+  q = FileQueue(f"fq://{tmp_path}/qseg")
+  q.insert(tasks(paths[1]))
+  executed, stats = drain(q, batch_size=4, mesh=make_mesh(4))
+  assert executed == 4
+  assert stats["dispatches"]["downsample"] == 1
+  assert_trees_identical(f"{tmp_path}/seg_s", f"{tmp_path}/seg_b")
+
+
+@pytest.fixture
+def seg_pair(tmp_path, rng):
+  """Two identical labeled volumes (320x192x64) with blobs for forge tasks."""
+  g = np.indices((320, 192, 64)).astype(np.float32)
+  data = np.zeros((320, 192, 64), dtype=np.uint64)
+  lab = 1
+  for cx in (48, 160, 272):
+    for cy in (48, 144):
+      r = 20 + 3 * (lab % 3)
+      m = (
+        (g[0] - cx) ** 2 + (g[1] - cy) ** 2 + ((g[2] - 32) * 2.0) ** 2
+      ) < r * r
+      data[m] = lab
+      lab += 1
+  paths = []
+  for name in ("solo", "batched"):
+    path = f"file://{tmp_path}/seg-{name}"
+    Volume.from_numpy(
+      data, path, chunk_size=(64, 64, 64), layer_type="segmentation",
+      resolution=(16, 16, 40),
+    )
+    paths.append(path)
+  return tmp_path, paths[0], paths[1]
+
+
+def _interior_skeleton_tasks(path):
+  tasks = tc.create_skeletonizing_tasks(
+    path, mip=0, shape=(64, 64, 64), dust_threshold=30,
+    teasar_params={"scale": 4, "const": 80}, fix_borders=True,
+  )
+  # the 8 cutouts that share the (65, 65, 64) +1-overlap shape
+  return [
+    t for t in tasks
+    if t.offset[0] in (0, 64, 128, 192) and t.offset[1] in (0, 64)
+  ]
+
+
+def test_skeleton_batch_one_edt_dispatch_byte_identical(seg_pair):
+  root, solo_path, batched_path = seg_pair
+  solo_tasks = _interior_skeleton_tasks(solo_path)
+  batch_tasks = _interior_skeleton_tasks(batched_path)
+  assert len(solo_tasks) == 8
+  for t in solo_tasks:
+    t.execute()
+
+  q = FileQueue(f"fq://{root}/qskel")
+  q.insert(batch_tasks)
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+  assert executed == 8
+  assert stats["dispatches"]["skeleton"] == 1
+  assert_trees_identical(f"{root}/seg-solo", f"{root}/seg-batched")
+
+
+def test_mixed_queue_two_rounds_two_dispatches_per_type(img_pair, seg_pair):
+  """VERDICT r3 #2's done-condition: 8 DownsampleTasks + 8 SkeletonTasks
+  in one fq://, --batch 8 → ≤2 device dispatches per type, outputs
+  byte-identical to solo."""
+  iroot, isolo, ibatched = img_pair
+  sroot, ssolo, sbatched = seg_pair
+  for t in _downsample_tasks(isolo):
+    t.execute()
+  solo_sk = _interior_skeleton_tasks(ssolo)
+  for t in solo_sk:
+    t.execute()
+
+  q = FileQueue(f"fq://{iroot}/qmix")
+  q.insert(_downsample_tasks(ibatched))
+  q.insert(_interior_skeleton_tasks(sbatched))
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+
+  assert executed == 16
+  # 16 tasks at batch=8 = 2 lease rounds; each type groups once per round
+  assert 1 <= stats["dispatches"]["downsample"] <= 2
+  assert 1 <= stats["dispatches"]["skeleton"] <= 2
+  assert_trees_identical(f"{iroot}/solo", f"{iroot}/batched")
+  assert_trees_identical(f"{sroot}/seg-solo", f"{sroot}/seg-batched")
+
+
+def test_ccl_faces_batch(seg_pair, monkeypatch):
+  """CCL pass 1 through the lease batcher (device backend forced: on a
+  CPU host the native path deliberately stays solo)."""
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "device")
+  root, solo_path, batched_path = seg_pair
+
+  def interior(path):
+    tasks = tc.create_ccl_face_tasks(path, mip=0, shape=(64, 64, 64))
+    return [
+      t for t in tasks
+      if t.offset[0] in (0, 64, 128, 192) and t.offset[1] in (0, 64)
+    ]
+
+  solo_tasks = interior(solo_path)
+  assert len(solo_tasks) == 8
+  for t in solo_tasks:
+    t.execute()
+
+  q = FileQueue(f"fq://{root}/qccl")
+  q.insert(interior(batched_path))
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+  assert executed == 8
+  assert stats["dispatches"]["ccl_faces"] == 1
+  assert_trees_identical(f"{root}/seg-solo", f"{root}/seg-batched")
+
+
+def test_ccl_faces_native_backend_stays_solo(seg_pair, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_CCL_BACKEND", "native")
+  root, _solo_path, batched_path = seg_pair
+  tasks = tc.create_ccl_face_tasks(batched_path, mip=0, shape=(64, 64, 64))
+  q = FileQueue(f"fq://{root}/qccln")
+  q.insert(tasks)
+  executed, stats = drain(q, batch_size=8)
+  assert executed == len(list(
+    tc.create_ccl_face_tasks(batched_path, mip=0, shape=(64, 64, 64))
+  ))
+  assert stats["solo"] == executed
+  assert not stats["dispatches"]
+
+
+def test_mesh_batch_merges_count_passes_byte_identical(seg_pair):
+  root, solo_path, batched_path = seg_pair
+
+  def tasks(path):
+    vol = Volume(path)
+    vol.info["mesh"] = "mesh_mip_0"
+    vol.commit_info()
+    return list(tc.create_meshing_tasks(
+      path, mip=0, shape=(160, 96, 64), sharded=False, spatial_index=True,
+    ))
+
+  solo_tasks = tasks(solo_path)
+  assert len(solo_tasks) == 4
+  dispatches_solo = 0
+  for t in solo_tasks:
+    t.execute()
+
+  q = FileQueue(f"fq://{root}/qmesh")
+  q.insert(tasks(batched_path))
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+  assert executed == 4
+  assert stats["dispatches"]["mesh"] >= 1
+  assert_trees_identical(f"{root}/seg-solo", f"{root}/seg-batched")
+
+
+def test_failed_member_recycles_alone(img_pair, monkeypatch):
+  """One member's host stage fails → only its lease survives to recycle;
+  the other 7 complete. At-least-once, per lease, exactly like solo."""
+  root, _solo, batched_path = img_pair
+  import igneous_tpu.tasks.image as image_tasks
+
+  real = image_tasks.downsample_and_upload
+  poisoned_offset = (256, 128, 0)
+
+  def sometimes_broken(image, bounds, vol, **kw):
+    if tuple(int(v) for v in bounds.minpt) == poisoned_offset:
+      raise RuntimeError("injected upload failure")
+    return real(image, bounds, vol, **kw)
+
+  monkeypatch.setattr(image_tasks, "downsample_and_upload", sometimes_broken)
+
+  q = FileQueue(f"fq://{root}/qfail")
+  q.insert(_downsample_tasks(batched_path))
+
+  def stop_fn(executed, empty):
+    return empty
+
+  batcher = LeaseBatcher(q, batch_size=8, lease_seconds=600, mesh=make_mesh(8))
+  batcher.poll(stop_fn=stop_fn)
+  assert batcher.stats["executed"] == 7
+  assert batcher.stats["failed"] == 1
+  assert q.leased == 1  # the poisoned lease awaits its visibility timeout
+
+  # lease recycles (simulate timeout) and completes once the fault clears
+  monkeypatch.setattr(image_tasks, "downsample_and_upload", real)
+  q.release_all()
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+  assert executed == 1
+  assert q.is_empty()
+
+
+def test_unbatchable_tasks_run_solo(tmp_path):
+  from igneous_tpu.queues.registry import PrintTask
+
+  q = FileQueue(f"fq://{tmp_path}/qsolo")
+  q.insert([PrintTask(txt="a"), PrintTask(txt="b"), PrintTask(txt="c")])
+  executed, stats = drain(q, batch_size=8)
+  assert executed == 3
+  assert stats["solo"] == 3
+  assert q.is_empty()
