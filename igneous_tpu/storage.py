@@ -8,11 +8,18 @@ gzip/zstd compression, addressed by protocol URL.
 Protocols implemented here:
   - ``file://`` — local filesystem (the test + single-host path).
   - ``mem://``  — process-local in-memory store (unit tests, scratch).
+  - ``gs://``   — real GCS JSON-API client (storage_gcs.py): resumable
+    uploads, paginated listing, Range reads, service-account/static-token
+    auth from CloudVolume-style secret files.
+  - ``s3://``   — real S3 REST client (storage_s3.py): SigV4 signing,
+    multipart upload, ListObjectsV2 pagination.
 
-Cloud protocols (gs://, s3://) are accepted at the URL layer and routed to a
-single pluggable hook (`register_protocol`) so a deployment can attach
-google-cloud-storage / boto clients without touching task code. They are not
-implemented in-tree because this environment has zero egress.
+`register_protocol` remains the override hook (it takes precedence over
+the built-in clients): deployments can attach google-cloud-storage/boto
+backends, and `attach_memory_protocol` swaps any protocol for the
+in-memory double. Zero-egress note: the in-tree cloud clients are
+exercised against in-process fake servers (tests/fake_cloud_servers.py);
+the real endpoints are unreachable from this build image.
 
 Compression follows the CloudFiles file-layout convention: a file compressed
 with gzip is stored under ``<key>.gz`` and listed/read under ``<key>``.
@@ -260,6 +267,14 @@ def _make_backend(pth: ExtractedPath):
     return _MemBackend(pth.path)
   if pth.protocol in _PROTOCOL_HOOKS:
     return _PROTOCOL_HOOKS[pth.protocol](pth.path)
+  if pth.protocol == "gs":
+    from .storage_gcs import GCSBackend
+
+    return GCSBackend(pth.path)
+  if pth.protocol == "s3":
+    from .storage_s3 import S3Backend
+
+    return S3Backend(pth.path)
   raise ValueError(
     f"Protocol {pth.protocol}:// not available in this environment. "
     f"Use register_protocol() to attach a backend."

@@ -1,0 +1,75 @@
+"""Shared HTTP transport for the cloud object-store clients.
+
+stdlib-only (urllib) with the retry/backoff discipline both real object
+stores require: exponential backoff + jitter on connection errors, 429,
+and 5xx — the same policy cloud-files applies for the reference stack
+(SURVEY.md §2.2). gs:// (storage_gcs.py) and s3:// (storage_s3.py) ride
+this one transport so the policy can't drift between them.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+RETRYABLE_STATUS = (408, 429, 500, 502, 503, 504)
+MAX_RETRIES = 6
+BACKOFF_BASE_S = 0.25
+BACKOFF_CAP_S = 30.0
+
+
+class HttpError(Exception):
+  def __init__(self, status: int, url: str, body: bytes = b""):
+    self.status = status
+    self.url = url
+    self.body = body
+    super().__init__(f"HTTP {status} for {url}: {body[:200]!r}")
+
+
+def request(
+  method: str,
+  url: str,
+  headers: Optional[Dict[str, str]] = None,
+  data: Optional[bytes] = None,
+  timeout: float = 60.0,
+  retries: int = MAX_RETRIES,
+) -> Tuple[int, Dict[str, str], bytes]:
+  """One HTTP exchange with retry/backoff. Returns (status, headers, body).
+
+  404/416 return normally (callers map them to None); other non-retryable
+  4xx raise HttpError; retryable statuses and connection errors retry
+  with exponential backoff + full jitter, then raise."""
+  last_exc: Optional[Exception] = None
+  for attempt in range(retries):
+    req = urllib.request.Request(
+      url, data=data, method=method, headers=dict(headers or {})
+    )
+    try:
+      with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+      body = e.read()
+      # 404/416: caller maps to None/empty; 308: GCS resumable-session
+      # "resume incomplete" ack (urllib treats any non-2xx as an error)
+      if e.code in (308, 404, 416):
+        return e.code, dict(e.headers or {}), body
+      if e.code in RETRYABLE_STATUS and attempt + 1 < retries:
+        last_exc = HttpError(e.code, url, body)
+      else:
+        raise HttpError(e.code, url, body) from None
+    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+      if attempt + 1 >= retries:
+        raise
+      last_exc = e
+    delay = min(BACKOFF_CAP_S, BACKOFF_BASE_S * (2**attempt))
+    time.sleep(random.random() * delay)
+  raise last_exc  # pragma: no cover - loop always returns or raises
+
+
+def quote_path(segment: str) -> str:
+  import urllib.parse
+
+  return urllib.parse.quote(segment, safe="")

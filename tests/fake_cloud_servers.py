@@ -1,0 +1,351 @@
+"""In-process fake GCS / S3 servers for exercising the real HTTP clients.
+
+Faithful enough for the operations the clients implement: pagination is
+forced (page size 3) so the pageToken/continuation-token loops really
+run; resumable and multipart uploads track sessions; Range and 404/416
+semantics mirror the real services; the S3 fake verifies the SigV4
+envelope shape when an Authorization header is presented; a fault hook
+injects 503s to exercise retry/backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PAGE_SIZE = 3
+
+
+class _State:
+  def __init__(self):
+    self.objects = {}  # name -> bytes
+    self.sessions = {}  # id -> {"name": str, "parts": bytearray}
+    self.mpu = {}  # upload_id -> {"name": str, "parts": {n: bytes}}
+    self.fail_next = 0  # respond 503 to this many following requests
+    self.requests = []  # (method, path, has_auth) log
+    self.lock = threading.RLock()
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+  state: _State
+
+  def log_message(self, *args):
+    pass
+
+  def _read_body(self) -> bytes:
+    n = int(self.headers.get("Content-Length") or 0)
+    return self.rfile.read(n) if n else b""
+
+  def _respond(self, status, body=b"", headers=None):
+    self.send_response(status)
+    for k, v in (headers or {}).items():
+      self.send_header(k, v)
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    if body:
+      self.wfile.write(body)
+
+  def _maybe_fail(self) -> bool:
+    with self.state.lock:
+      if self.state.fail_next > 0:
+        self.state.fail_next -= 1
+        self._respond(503, b"injected")
+        return True
+    return False
+
+  def _serve_media(self, data: bytes):
+    rng = self.headers.get("Range")
+    if rng:
+      m = re.match(r"bytes=(\d+)-(\d+)", rng)
+      start, end = int(m.group(1)), int(m.group(2))
+      if start >= len(data):
+        self._respond(416, b"")
+        return
+      self._respond(206, data[start : end + 1])
+      return
+    self._respond(200, data)
+
+
+class _GCSHandler(_BaseHandler):
+  """GCS JSON API subset."""
+
+  def _object_name(self, path: str):
+    m = re.match(r"/storage/v1/b/[^/]+/o/(.+)", path)
+    return urllib.parse.unquote(m.group(1)) if m else None
+
+  def do_GET(self):
+    if self._maybe_fail():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query))
+    self.state.requests.append(("GET", self.path, bool(self.headers.get("Authorization"))))
+    name = self._object_name(parsed.path)
+    with self.state.lock:
+      if name is not None:
+        data = self.state.objects.get(name)
+        if data is None:
+          self._respond(404, b'{"error": {"code": 404}}')
+          return
+        if qs.get("alt") == "media":
+          self._serve_media(data)
+        else:
+          self._respond(200, json.dumps(
+            {"name": name, "size": str(len(data))}
+          ).encode())
+        return
+      if re.match(r"/storage/v1/b/[^/]+/o$", parsed.path):
+        prefix = qs.get("prefix", "")
+        names = sorted(
+          n for n in self.state.objects if n.startswith(prefix)
+        )
+        start = int(qs.get("pageToken") or 0)
+        page = names[start : start + PAGE_SIZE]
+        payload = {"items": [{"name": n} for n in page]}
+        if start + PAGE_SIZE < len(names):
+          payload["nextPageToken"] = str(start + PAGE_SIZE)
+        self._respond(200, json.dumps(payload).encode())
+        return
+    self._respond(404, b"")
+
+  def do_POST(self):
+    if self._maybe_fail():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query))
+    self.state.requests.append(("POST", self.path, bool(self.headers.get("Authorization"))))
+    body = self._read_body()
+    if parsed.path.startswith("/upload/storage/v1/b/"):
+      name = qs.get("name", "")  # parse_qsl already decoded once
+      if qs.get("uploadType") == "media":
+        with self.state.lock:
+          self.state.objects[name] = body
+        self._respond(200, json.dumps({"name": name}).encode())
+        return
+      if qs.get("uploadType") == "resumable":
+        with self.state.lock:
+          sid = f"sess-{len(self.state.sessions)}"
+          self.state.sessions[sid] = {"name": name, "parts": bytearray()}
+        host = self.headers.get("Host")
+        self._respond(200, b"", headers={
+          "Location": f"http://{host}/resumable/{sid}",
+        })
+        return
+    self._respond(400, b"bad request")
+
+  def do_PUT(self):
+    if self._maybe_fail():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    self.state.requests.append(("PUT", self.path, bool(self.headers.get("Authorization"))))
+    body = self._read_body()
+    m = re.match(r"/resumable/(.+)", parsed.path)
+    if m:
+      sid = m.group(1)
+      crange = self.headers.get("Content-Range", "")
+      cm = re.match(r"bytes (\d+)-(\d+)/(\d+)", crange)
+      with self.state.lock:
+        sess = self.state.sessions.get(sid)
+        if sess is None or cm is None:
+          self._respond(404, b"")
+          return
+        sess["parts"] += body
+        total = int(cm.group(3))
+        if len(sess["parts"]) >= total:
+          self.state.objects[sess["name"]] = bytes(sess["parts"])
+          del self.state.sessions[sid]
+          self._respond(200, json.dumps({"name": sess["name"]}).encode())
+        else:
+          self._respond(308, b"", headers={
+            "Range": f"bytes=0-{len(sess['parts']) - 1}"
+          })
+      return
+    self._respond(400, b"")
+
+  def do_DELETE(self):
+    if self._maybe_fail():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    self.state.requests.append(("DELETE", self.path, bool(self.headers.get("Authorization"))))
+    name = self._object_name(parsed.path)
+    with self.state.lock:
+      if name in self.state.objects:
+        del self.state.objects[name]
+        self._respond(204, b"")
+      else:
+        self._respond(404, b"")
+
+
+_SIGV4_RE = re.compile(
+  r"AWS4-HMAC-SHA256 Credential=[^/]+/\d{8}/[^/]+/s3/aws4_request, "
+  r"SignedHeaders=[a-z0-9;-]+, Signature=[0-9a-f]{64}"
+)
+
+
+class _S3Handler(_BaseHandler):
+  """S3 REST API subset (path-style)."""
+
+  def _check_auth(self) -> bool:
+    auth = self.headers.get("Authorization")
+    if auth is None:
+      return True  # anonymous allowed by the fake
+    if not _SIGV4_RE.match(auth):
+      self._respond(403, b"<Error><Code>BadSig</Code></Error>")
+      return False
+    return True
+
+  def _key(self, path: str):
+    m = re.match(r"/([^/]+)/(.+)", urllib.parse.unquote(path))
+    return m.group(2) if m else None
+
+  def do_GET(self):
+    if self._maybe_fail() or not self._check_auth():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query))
+    self.state.requests.append(("GET", self.path, bool(self.headers.get("Authorization"))))
+    with self.state.lock:
+      if qs.get("list-type") == "2":
+        prefix = qs.get("prefix", "")
+        names = sorted(
+          n for n in self.state.objects if n.startswith(prefix)
+        )
+        start = int(qs.get("continuation-token") or 0)
+        page = names[start : start + PAGE_SIZE]
+        truncated = start + PAGE_SIZE < len(names)
+        url_encode = qs.get("encoding-type") == "url"
+        xml = "<ListBucketResult>"
+        xml += f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+        for n in page:
+          shown = urllib.parse.quote(n) if url_encode else n
+          xml += f"<Contents><Key>{shown}</Key></Contents>"
+        if truncated:
+          xml += (
+            f"<NextContinuationToken>{start + PAGE_SIZE}"
+            "</NextContinuationToken>"
+          )
+        xml += "</ListBucketResult>"
+        self._respond(200, xml.encode())
+        return
+      key = self._key(parsed.path)
+      data = self.state.objects.get(key) if key else None
+      if data is None:
+        self._respond(404, b"<Error><Code>NoSuchKey</Code></Error>")
+        return
+      self._serve_media(data)
+
+  def do_HEAD(self):
+    if self._maybe_fail() or not self._check_auth():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    key = self._key(parsed.path)
+    with self.state.lock:
+      data = self.state.objects.get(key) if key else None
+    if data is None:
+      self.send_response(404)
+      self.send_header("Content-Length", "0")
+      self.end_headers()
+      return
+    # HEAD: Content-Length advertises the object size, body is empty
+    self.send_response(200)
+    self.send_header("Content-Length", str(len(data)))
+    self.end_headers()
+
+  def do_PUT(self):
+    if self._maybe_fail() or not self._check_auth():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query))
+    self.state.requests.append(("PUT", self.path, bool(self.headers.get("Authorization"))))
+    body = self._read_body()
+    key = self._key(parsed.path)
+    if "partNumber" in qs and "uploadId" in qs:
+      with self.state.lock:
+        mpu = self.state.mpu.get(qs["uploadId"])
+        if mpu is None:
+          self._respond(404, b"")
+          return
+        n = int(qs["partNumber"])
+        mpu["parts"][n] = body
+      self._respond(200, b"", headers={"ETag": f'"part-{n}"'})
+      return
+    with self.state.lock:
+      self.state.objects[key] = body
+    self._respond(200, b"", headers={"ETag": '"etag"'})
+
+  def do_POST(self):
+    if self._maybe_fail() or not self._check_auth():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+    self.state.requests.append(("POST", self.path, bool(self.headers.get("Authorization"))))
+    body = self._read_body()
+    key = self._key(parsed.path)
+    if "uploads" in qs:
+      with self.state.lock:
+        uid = f"mpu-{len(self.state.mpu)}"
+        self.state.mpu[uid] = {"name": key, "parts": {}}
+      xml = (
+        f"<InitiateMultipartUploadResult><UploadId>{uid}</UploadId>"
+        "</InitiateMultipartUploadResult>"
+      )
+      self._respond(200, xml.encode())
+      return
+    if "uploadId" in qs:
+      with self.state.lock:
+        mpu = self.state.mpu.pop(qs["uploadId"], None)
+        if mpu is None:
+          self._respond(404, b"")
+          return
+        assembled = b"".join(
+          mpu["parts"][n] for n in sorted(mpu["parts"])
+        )
+        self.state.objects[mpu["name"]] = assembled
+      self._respond(
+        200, b"<CompleteMultipartUploadResult></CompleteMultipartUploadResult>"
+      )
+      return
+    self._respond(400, b"")
+
+  def do_DELETE(self):
+    if self._maybe_fail() or not self._check_auth():
+      return
+    parsed = urllib.parse.urlsplit(self.path)
+    qs = dict(urllib.parse.parse_qsl(parsed.query))
+    key = self._key(parsed.path)
+    with self.state.lock:
+      if "uploadId" in qs:
+        self.state.mpu.pop(qs["uploadId"], None)
+        self._respond(204, b"")
+        return
+      self.state.objects.pop(key, None)
+    self._respond(204, b"")
+
+
+class FakeCloudServer:
+  """Threaded in-process server; use as a context manager."""
+
+  def __init__(self, kind: str):
+    handler = {"gcs": _GCSHandler, "s3": _S3Handler}[kind]
+    self.state = _State()
+    handler_cls = type(f"Bound{handler.__name__}", (handler,),
+                       {"state": self.state})
+    self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    self.thread = threading.Thread(
+      target=self.httpd.serve_forever, daemon=True
+    )
+
+  @property
+  def endpoint(self) -> str:
+    host, port = self.httpd.server_address
+    return f"http://{host}:{port}"
+
+  def __enter__(self):
+    self.thread.start()
+    return self
+
+  def __exit__(self, *exc):
+    self.httpd.shutdown()
+    self.httpd.server_close()
