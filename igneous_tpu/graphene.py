@@ -46,19 +46,27 @@ def register_graphene_client(factory):
 
 def require_graphene_client(cloudpath: str) -> None:
   if _GRAPHENE_CLIENT_FACTORY is None:
+    from .graphene_http import parse_graphene_server
+
+    if parse_graphene_server(watershed_path(cloudpath)):
+      return  # server-addressed: the HTTP client self-constructs
     raise NotImplementedError(
       f"{cloudpath!r}: graphene:// volumes need a chunk-graph client; "
-      "register one with "
+      "address a PyChunkGraph server directly "
+      "(graphene://https://server/...) or register one with "
       "igneous_tpu.graphene.register_graphene_client(factory) — e.g. "
       "use_local_chunkgraph(path, graph) for the in-process "
-      "LocalChunkGraph, or a PyChunkGraph server client in a deployment "
-      "with egress."
+      "LocalChunkGraph."
     )
 
 
 def graphene_client(cloudpath: str):
   require_graphene_client(cloudpath)
-  return _GRAPHENE_CLIENT_FACTORY(cloudpath)
+  if _GRAPHENE_CLIENT_FACTORY is not None:
+    return _GRAPHENE_CLIENT_FACTORY(cloudpath)
+  from .graphene_http import PCGClient, parse_graphene_server
+
+  return PCGClient(parse_graphene_server(watershed_path(cloudpath)))
 
 
 def is_graphene(cloudpath: str) -> bool:
@@ -273,7 +281,10 @@ class LocalGrapheneClient:
     return self.graph.get_l2_ids(supervoxels, voxel_chunks, timestamp)
 
   def voxel_connectivity_graph(self, supervoxels, connectivity=26,
-                               timestamp=None):
+                               timestamp=None, **placement):
+    # placement (offset/downsample_ratio) matters only to clients that
+    # shade graph-chunk planes; the edge-exact local graph ignores it
+    del placement
     return self.graph.voxel_connectivity_graph(
       supervoxels, connectivity, timestamp
     )
@@ -299,6 +310,13 @@ def use_local_chunkgraph(cloudpath: str, graph: LocalChunkGraph):
       return LocalGrapheneClient(path, _LOCAL_GRAPHS[path])
     if previous is not None and previous is not factory:
       return previous(path)
+    from .graphene_http import PCGClient, parse_graphene_server
+
+    server = parse_graphene_server(watershed_path(path))
+    if server:
+      # server-addressed paths keep self-constructing the HTTP client
+      # even while local graphs serve other paths in the same process
+      return PCGClient(server)
     raise NotImplementedError(
       f"{path!r}: no LocalChunkGraph attached for this path (see "
       "use_local_chunkgraph) and no other graphene client registered."

@@ -48,12 +48,16 @@ def _b64url(data: bytes) -> bytes:
   return base64.urlsafe_b64encode(data).rstrip(b"=")
 
 
+# process-wide token cache keyed by service-account identity: every
+# CloudFiles/Volume constructs a fresh backend, and per-instance caching
+# would re-run the OAuth exchange once per task (rate-limit bait)
+_TOKEN_CACHE: dict = {}
+
+
 class _GoogleAuth:
   """Bearer-token provider from CloudVolume-style secret files."""
 
   def __init__(self):
-    self._token: Optional[str] = None
-    self._expiry = 0.0
     self._secret = self._load_secret()
 
   @staticmethod
@@ -78,9 +82,12 @@ class _GoogleAuth:
     if "token" in self._secret:  # static token (emulators, proxies)
       return self._secret["token"]
     if self._secret.get("type") == "service_account":
-      if self._token is None or time.time() > self._expiry - 60:
-        self._token, self._expiry = self._exchange_jwt()
-      return self._token
+      key = self._secret.get("client_email", "")
+      tok, expiry = _TOKEN_CACHE.get(key, (None, 0.0))
+      if tok is None or time.time() > expiry - 60:
+        tok, expiry = self._exchange_jwt()
+        _TOKEN_CACHE[key] = (tok, expiry)
+      return tok
     return None
 
   def _exchange_jwt(self):

@@ -69,29 +69,28 @@ class SigV4:
     self.region = region
     self.service = service
 
-  def sign(self, method: str, url: str, headers: dict, payload: bytes) -> dict:
-    parsed = urllib.parse.urlsplit(url)
-    now = datetime.datetime.now(datetime.timezone.utc)
-    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-    datestamp = now.strftime("%Y%m%d")
-    payload_hash = hashlib.sha256(payload or b"").hexdigest()
-
-    headers = dict(headers)
-    headers["Host"] = parsed.netloc
-    headers["x-amz-date"] = amz_date
-    headers["x-amz-content-sha256"] = payload_hash
-
+  def _signature(
+    self,
+    method: str,
+    path: str,
+    query: str,
+    signed: dict,
+    payload_hash: str,
+    amz_date: str,
+    datestamp: str,
+  ) -> Tuple[str, str]:
+    """Core SigV4 math over an EXACT header set; shared by sign() and
+    verify() so server-side verification recomputes the same canonical
+    request from wire-observed values."""
     canonical_query = "&".join(
       sorted(
         f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
-        for k, v in urllib.parse.parse_qsl(
-          parsed.query, keep_blank_values=True
-        )
+        for k, v in urllib.parse.parse_qsl(query, keep_blank_values=True)
       )
     )
-    signed_names = sorted(h.lower() for h in headers)
+    signed_names = sorted(h.lower() for h in signed)
     canonical_headers = "".join(
-      f"{name}:{str(headers[next(h for h in headers if h.lower() == name)]).strip()}\n"
+      f"{name}:{str(signed[next(h for h in signed if h.lower() == name)]).strip()}\n"
       for name in signed_names
     )
     signed_headers = ";".join(signed_names)
@@ -99,7 +98,7 @@ class SigV4:
     # percent-encoded once by _url); re-quoting here would double-encode
     # and yield SignatureDoesNotMatch against real AWS
     canonical_request = "\n".join([
-      method, parsed.path or "/", canonical_query,
+      method, path or "/", canonical_query,
       canonical_headers, signed_headers, payload_hash,
     ])
     scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
@@ -118,12 +117,61 @@ class SigV4:
     signature = hmac.new(
       k, string_to_sign.encode(), hashlib.sha256
     ).hexdigest()
+    return signature, signed_headers
+
+  def sign(self, method: str, url: str, headers: dict, payload: bytes) -> dict:
+    parsed = urllib.parse.urlsplit(url)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload or b"").hexdigest()
+
+    headers = dict(headers)
+    headers["Host"] = parsed.netloc
+    headers["x-amz-date"] = amz_date
+    headers["x-amz-content-sha256"] = payload_hash
+
+    signature, signed_headers = self._signature(
+      method, parsed.path, parsed.query, headers, payload_hash,
+      amz_date, datestamp,
+    )
+    scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
     headers["Authorization"] = (
       f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
       f"SignedHeaders={signed_headers}, Signature={signature}"
     )
     del headers["Host"]  # urllib sets it; keeping both would desync
     return headers
+
+  def verify(
+    self, method: str, path: str, query: str, wire_headers, payload: bytes
+  ) -> bool:
+    """Server-side check: recompute the signature from the wire-observed
+    request (used by the fake S3 server so canonicalization drift between
+    signing and sending fails tests, not production)."""
+    auth = wire_headers.get("Authorization", "")
+    m = re.match(
+      r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/([^/]+)/"
+      r"aws4_request, SignedHeaders=([a-z0-9;-]+), Signature=([0-9a-f]{64})",
+      auth,
+    )
+    if not m:
+      return False
+    _access, datestamp, _region, _svc, signed_names, signature = m.groups()
+    signed = {}
+    for name in signed_names.split(";"):
+      val = wire_headers.get(name)
+      if val is None:
+        return False
+      signed[name] = val
+    payload_hash = hashlib.sha256(payload or b"").hexdigest()
+    if signed.get("x-amz-content-sha256") not in (payload_hash, None):
+      return False
+    expect, _ = self._signature(
+      method, path, query, signed, payload_hash,
+      signed.get("x-amz-date", ""), datestamp,
+    )
+    return hmac.compare_digest(expect, signature)
 
 
 class S3Backend:

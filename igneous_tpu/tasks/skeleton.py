@@ -398,7 +398,14 @@ class SkeletonTask(RegisteredTask):
       else:
         self._graphene_sv = None
       voxel_graph = vol.graphene.voxel_connectivity_graph(
-        sv, 26, self.timestamp
+        sv, 26, self.timestamp,
+        # chunk-grid placement for clients that shade graph-chunk
+        # boundaries (graphene_http.PCGClient): global cutout offset at
+        # this mip + the mip->base scale
+        offset=tuple(int(v) for v in cutout.minpt),
+        downsample_ratio=tuple(
+          int(v) for v in vol.meta.downsample_ratio(self.mip)
+        ),
       )
       del sv
 

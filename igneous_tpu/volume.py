@@ -78,7 +78,13 @@ class Volume:
       # (supervoxel) layer; the chunk-graph client supplies the
       # supervoxel->root and ->L2 mappings on download
       self.graphene = graphene_client(cloudpath)
-      cloudpath = watershed_path(cloudpath)
+      # server-addressed graphene volumes publish the watershed layer
+      # location in their /info (data_dir); local doubles embed it in
+      # the cloudpath itself
+      cloudpath = (
+        getattr(self.graphene, "data_dir", None)
+        or watershed_path(cloudpath)
+      )
     self.meta = PrecomputedMetadata(cloudpath, info=info)
     self.cloudpath = self.meta.cloudpath
     self.cf = self.meta.cf

@@ -25,6 +25,7 @@ class _State:
     self.sessions = {}  # id -> {"name": str, "parts": bytearray}
     self.mpu = {}  # upload_id -> {"name": str, "parts": {n: bytes}}
     self.fail_next = 0  # respond 503 to this many following requests
+    self.s3_creds = None  # (access_key, secret_key): enables signature checks
     self.requests = []  # (method, path, has_auth) log
     self.lock = threading.RLock()
 
@@ -187,13 +188,30 @@ _SIGV4_RE = re.compile(
 class _S3Handler(_BaseHandler):
   """S3 REST API subset (path-style)."""
 
-  def _check_auth(self) -> bool:
+  def _check_auth(self, body: bytes = b"") -> bool:
     auth = self.headers.get("Authorization")
     if auth is None:
       return True  # anonymous allowed by the fake
     if not _SIGV4_RE.match(auth):
       self._respond(403, b"<Error><Code>BadSig</Code></Error>")
       return False
+    creds = self.state.s3_creds
+    if creds:
+      # FULL verification: recompute the signature from the wire-observed
+      # request so sign-vs-send canonicalization drift fails tests here
+      # instead of as SignatureDoesNotMatch against real AWS
+      from igneous_tpu.storage_s3 import SigV4
+
+      m = re.match(r"AWS4-HMAC-SHA256 Credential=[^/]+/\d{8}/([^/]+)/", auth)
+      parsed = urllib.parse.urlsplit(self.path)
+      ok = SigV4(creds[0], creds[1], m.group(1)).verify(
+        self.command, parsed.path, parsed.query, self.headers, body
+      )
+      if not ok:
+        self._respond(
+          403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>"
+        )
+        return False
     return True
 
   def _key(self, path: str):
@@ -254,12 +272,12 @@ class _S3Handler(_BaseHandler):
     self.end_headers()
 
   def do_PUT(self):
-    if self._maybe_fail() or not self._check_auth():
+    body = self._read_body()
+    if self._maybe_fail() or not self._check_auth(body):
       return
     parsed = urllib.parse.urlsplit(self.path)
     qs = dict(urllib.parse.parse_qsl(parsed.query))
     self.state.requests.append(("PUT", self.path, bool(self.headers.get("Authorization"))))
-    body = self._read_body()
     key = self._key(parsed.path)
     if "partNumber" in qs and "uploadId" in qs:
       with self.state.lock:
@@ -276,12 +294,12 @@ class _S3Handler(_BaseHandler):
     self._respond(200, b"", headers={"ETag": '"etag"'})
 
   def do_POST(self):
-    if self._maybe_fail() or not self._check_auth():
+    body = self._read_body()
+    if self._maybe_fail() or not self._check_auth(body):
       return
     parsed = urllib.parse.urlsplit(self.path)
     qs = dict(urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
     self.state.requests.append(("POST", self.path, bool(self.headers.get("Authorization"))))
-    body = self._read_body()
     key = self._key(parsed.path)
     if "uploads" in qs:
       with self.state.lock:
@@ -327,9 +345,10 @@ class _S3Handler(_BaseHandler):
 class FakeCloudServer:
   """Threaded in-process server; use as a context manager."""
 
-  def __init__(self, kind: str):
+  def __init__(self, kind: str, s3_creds=None):
     handler = {"gcs": _GCSHandler, "s3": _S3Handler}[kind]
     self.state = _State()
+    self.state.s3_creds = s3_creds
     handler_cls = type(f"Bound{handler.__name__}", (handler,),
                        {"state": self.state})
     self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)

@@ -1,0 +1,123 @@
+"""In-process fake PyChunkGraph server for exercising PCGClient.
+
+Serves the REST surface graphene_http.PCGClient speaks, backed by a
+LocalChunkGraph (the semantics double) plus an sv→chunk assignment —
+modeling the real PCG property that a supervoxel id encodes its chunk,
+which is what lets ``roots_binary?stop_layer=2`` answer per-supervoxel.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class FakePCGServer:
+  def __init__(self, graph, sv_chunks=None, data_dir=None):
+    """graph: LocalChunkGraph; sv_chunks: {sv_id: linear_chunk_index}
+    (defaults to chunk 0 for every sv); data_dir: watershed layer path
+    advertised in /info."""
+    self.graph = graph
+    self.sv_chunks = dict(sv_chunks or {})
+    self.data_dir = data_dir
+    self.requests = []
+    outer = self
+
+    class Handler(BaseHTTPRequestHandler):
+      def log_message(self, *args):
+        pass
+
+      def _respond(self, status, body=b"", ctype="application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+          self.wfile.write(body)
+
+      def do_GET(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        outer.requests.append(("GET", self.path))
+        if parsed.path.endswith("/info"):
+          info = {
+            "graph": {
+              "chunk_size": list(outer.graph.chunk_size),
+              "n_layers": 4,
+            },
+          }
+          if outer.data_dir:
+            info["data_dir"] = outer.data_dir
+          self._respond(200, json.dumps(info).encode())
+          return
+        m = re.match(r".*/root/(\d+)/tabular_change_log$", parsed.path)
+        if m:
+          root_id = int(m.group(1))
+          events = [
+            e for e in outer.graph._events if math.isfinite(e[0])
+          ]  # initial edges are not proofreading operations
+          svs = sorted({e[2] for e in events} | {e[3] for e in events})
+          roots = (
+            outer.graph.get_roots(np.asarray(svs, np.uint64), None)
+            if svs else []
+          )
+          rootmap = {sv: int(r) for sv, r in zip(svs, roots)}
+          ops = [
+            {
+              "is_merge": kind == "add",
+              "timestamp": t,
+              "source": [a],
+              "sink": [b],
+            }
+            for t, kind, a, b in events
+            if rootmap.get(a) == root_id or rootmap.get(b) == root_id
+          ]
+          self._respond(200, json.dumps({"operations": ops}).encode())
+          return
+        self._respond(404, b"{}")
+
+      def do_POST(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(parsed.query))
+        outer.requests.append(("POST", self.path))
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        if parsed.path.endswith("/node/roots_binary"):
+          sv = np.frombuffer(body, dtype="<u8")
+          ts = float(qs["timestamp"]) if "timestamp" in qs else None
+          roots = outer.graph.get_roots(sv, ts)
+          if qs.get("stop_layer") == "2":
+            chunks = np.array(
+              [outer.sv_chunks.get(int(s), 0) for s in sv], dtype=np.uint64
+            )
+            out = outer.graph.get_l2_ids(sv, chunks, ts)
+          else:
+            out = roots
+          self._respond(
+            200, out.astype("<u8").tobytes(), "application/octet-stream"
+          )
+          return
+        self._respond(404, b"{}")
+
+    self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    self.thread = threading.Thread(
+      target=self.httpd.serve_forever, daemon=True
+    )
+
+  @property
+  def base_url(self) -> str:
+    host, port = self.httpd.server_address
+    return f"http://{host}:{port}/segmentation/api/v1/table/test"
+
+  def __enter__(self):
+    self.thread.start()
+    return self
+
+  def __exit__(self, *exc):
+    self.httpd.shutdown()
+    self.httpd.server_close()

@@ -88,11 +88,60 @@ def make_graphene_volume(tmp_path, data, edges, chunk_size=(32, 32, 32)):
   return gpath
 
 
-def test_graphene_volume_downloads(tmp_path):
+def _sv_chunks_from_data(data, chunk_size):
+  """{sv: linear chunk index} — models real PCG ids encoding their chunk
+  (supervoxels are chunk-local by watershed construction). Uses the same
+  linearization as graphene.voxel_chunk_index."""
+  from igneous_tpu.graphene import voxel_chunk_index
+
+  arr = np.asarray(data, np.uint64)
+  chunks = voxel_chunk_index((0, 0, 0), arr.shape, chunk_size)
+  out = {}
+  for sv in np.unique(arr):
+    if sv == 0:
+      continue
+    out[int(sv)] = int(chunks[arr == sv][0])
+  return out
+
+
+@pytest.fixture(params=["local", "http"])
+def graphene_volume_factory(request):
+  """Build a graphene volume on either backend: the in-process
+  LocalChunkGraph client, or the REAL PCG HTTP client (graphene_http)
+  speaking to a fake server wrapping the same graph — both must pass the
+  identical pipeline tests (VERDICT r3 item 8)."""
+  from fake_pcg_server import FakePCGServer
+
+  servers = []
+
+  def make(tmp_path, data, edges, chunk_size=(32, 32, 32)):
+    if request.param == "local":
+      return make_graphene_volume(tmp_path, data, edges, chunk_size)
+    inner = f"file://{tmp_path}/watershed"
+    Volume.from_numpy(
+      np.asarray(data, np.uint64), inner, resolution=(16, 16, 16),
+      layer_type="segmentation", chunk_size=chunk_size,
+    )
+    graph = LocalChunkGraph(initial_edges=edges, chunk_size=chunk_size)
+    srv = FakePCGServer(
+      graph, _sv_chunks_from_data(data, chunk_size), data_dir=inner
+    )
+    srv.__enter__()
+    servers.append(srv)
+    # server-addressed: the PCG client self-constructs, watershed layer
+    # resolves through /info data_dir
+    return f"graphene://{srv.base_url}"
+
+  yield make
+  for s in servers:
+    s.__exit__()
+
+
+def test_graphene_volume_downloads(tmp_path, graphene_volume_factory):
   data = np.zeros((64, 32, 32), np.uint64)
   data[0:32, 10:20, 10:20] = 5
   data[32:64, 10:20, 10:20] = 6
-  gpath = make_graphene_volume(tmp_path, data, edges=[(5, 6)])
+  gpath = graphene_volume_factory(tmp_path, data, edges=[(5, 6)])
   vol = Volume(gpath)
   assert vol.graphene is not None
   raw = vol.download(vol.bounds)[..., 0]
@@ -149,7 +198,7 @@ def test_graphene_skeleton_autapse_fix(tmp_path):
   assert (vx < 470).any() and (vx > 490).any()
 
 
-def test_graphene_csa_repair_uses_root_ids(tmp_path):
+def test_graphene_csa_repair_uses_root_ids(tmp_path, graphene_volume_factory):
   """Cross-section contact repair on a graphene volume must download
   AGGLOMERATED ids: the skeletons are keyed by root ids, so a raw
   supervoxel download would make every repair mask empty and leave all
@@ -157,7 +206,7 @@ def test_graphene_csa_repair_uses_root_ids(tmp_path):
   data = np.zeros((64, 16, 16), np.uint64)
   data[2:32, 5:11, 5:11] = 7
   data[32:62, 5:11, 5:11] = 8
-  gpath = make_graphene_volume(
+  gpath = graphene_volume_factory(
     tmp_path, data, edges=[(7, 8)], chunk_size=(32, 16, 16)
   )
   run(tc.create_skeletonizing_tasks(
@@ -188,10 +237,15 @@ def test_graphene_csa_repair_uses_root_ids(tmp_path):
   assert saw_vertex
 
 
-def test_graphene_mesh_forge_l2(tmp_path):
+def test_graphene_mesh_forge_l2(tmp_path, graphene_volume_factory):
+  # one proofread object built from two chunk-local supervoxels (real
+  # watershed property: a supervoxel never crosses a graph chunk)
   data = np.zeros((64, 32, 32), np.uint64)
-  data[4:60, 10:22, 10:22] = 5
-  gpath = make_graphene_volume(tmp_path, data, edges=[], chunk_size=(32, 32, 32))
+  data[4:32, 10:22, 10:22] = 5
+  data[32:60, 10:22, 10:22] = 6
+  gpath = graphene_volume_factory(
+    tmp_path, data, edges=[(5, 6)], chunk_size=(32, 32, 32)
+  )
   run(tc.create_graphene_meshing_tasks(gpath, shape=(64, 32, 32)))
   vol = Volume(gpath)
   mdir = vol.info["mesh"]
@@ -212,7 +266,7 @@ def test_graphene_mesh_forge_l2(tmp_path):
   assert all(l >= int(LocalChunkGraph.L2_BASE) for l in labels)
 
 
-def test_transfer_task_agglomerate(tmp_path):
+def test_transfer_task_agglomerate(tmp_path, graphene_volume_factory):
   """TransferTask(agglomerate=True) materializes proofread root ids from
   a graphene volume into a plain Precomputed layer (reference
   TransferTask agglomerate/timestamp, image.py:434-517)."""
@@ -222,7 +276,7 @@ def test_transfer_task_agglomerate(tmp_path):
   data = np.zeros((64, 32, 32), np.uint64)
   data[0:32, 10:20, 10:20] = 5
   data[32:64, 10:20, 10:20] = 6
-  gpath = make_graphene_volume(tmp_path, data, edges=[(5, 6)])
+  gpath = graphene_volume_factory(tmp_path, data, edges=[(5, 6)])
   dest = f"file://{tmp_path}/roots"
   tq = LocalTaskQueue(parallel=1, progress=False)
   tq.insert(tc.create_transfer_tasks(
@@ -305,3 +359,76 @@ def test_transfer_agglomerate_validation(tmp_path):
   with pytest.raises(ValueError, match="uint64"):
     tc.create_transfer_tasks(gpath, dest, shape=(16, 16, 16),
                              agglomerate=True)
+
+
+# -- PCG HTTP protocol specifics ---------------------------------------------
+
+
+def test_pcg_client_timestamps_and_dedupe():
+  """Timestamp semantics ride the wire; big cutouts dedupe to ONE
+  roots_binary POST of unique ids."""
+  from fake_pcg_server import FakePCGServer
+
+  from igneous_tpu.graphene_http import PCGClient
+
+  g = LocalChunkGraph(initial_edges=[(1, 2)])
+  g.merge(2, 3, timestamp=10)
+  with FakePCGServer(g, {1: 0, 2: 0, 3: 1}) as srv:
+    c = PCGClient(srv.base_url)
+    sv = np.zeros((64, 8, 8), np.uint64)
+    sv[0:20] = 1
+    sv[20:40] = 2
+    sv[40:64] = 3
+    before = g.get_roots(np.asarray([1, 3], np.uint64), timestamp=5)
+    r5 = c.get_roots(sv, timestamp=5)
+    assert r5[0, 0, 0] == before[0] and r5[63, 0, 0] == before[1]
+    assert r5[0, 0, 0] != r5[63, 0, 0]  # merge not yet visible at t=5
+    r20 = c.get_roots(sv, timestamp=20)
+    assert len(np.unique(r20)) == 1  # one object after the merge
+    posts = [p for m, p in srv.requests if m == "POST"]
+    assert len(posts) == 2  # one POST per get_roots despite 4096 voxels
+    assert c.chunk_size == tuple(g.chunk_size)
+
+
+def test_pcg_client_change_log():
+  from fake_pcg_server import FakePCGServer
+
+  from igneous_tpu.graphene_http import PCGClient
+
+  g = LocalChunkGraph(initial_edges=[(1, 2)])
+  g.merge(2, 3, timestamp=10)
+  g.split([1], [2, 3], timestamp=20)
+  with FakePCGServer(g, {1: 0, 2: 0, 3: 0}) as srv:
+    c = PCGClient(srv.base_url)
+    root = int(c.get_roots(np.asarray([3], np.uint64))[0])
+    log = c.change_log(root)
+    kinds = [op["is_merge"] for op in log["operations"]]
+    times = [op["timestamp"] for op in log["operations"]]
+    assert True in kinds and False in kinds  # merge AND split recorded
+    assert times == sorted(times)
+
+
+def test_pcg_client_voxel_graph_reference_style():
+  """The HTTP client builds the autapse voxel graph the way the reference
+  does (L2 field + root shading, skeleton.py:337-400): an L2 boundary
+  INSIDE one root severs; within one L2 it connects."""
+  from fake_pcg_server import FakePCGServer
+
+  from igneous_tpu.graphene_http import PCGClient
+  from igneous_tpu.ops.ccl import graph_bit
+
+  # two chunk-local svs merged into one root; chunk size 2 along x splits
+  # them into different graph chunks -> different L2 ids
+  g = LocalChunkGraph(initial_edges=[(1, 2)], chunk_size=(2, 8, 8))
+  with FakePCGServer(g, {1: 0, 2: 1}) as srv:
+    c = PCGClient(srv.base_url)
+    sv = np.zeros((4, 1, 1), np.uint64)
+    sv[0:2] = 1
+    sv[2:4] = 2
+    vg = c.voxel_connectivity_graph(sv, connectivity=6)
+    # same L2 (same sv): connected
+    assert (vg[0, 0, 0] >> graph_bit((1, 0, 0))) & 1 == 1
+    # x=1|x=2 is BOTH an L2 boundary and a graph-chunk boundary: the
+    # reference shades chunk-boundary planes with ROOT connectivity, and
+    # 1,2 share a root -> connected there
+    assert (vg[1, 0, 0] >> graph_bit((1, 0, 0))) & 1 == 1

@@ -100,8 +100,15 @@ def test_fill_holes_level3_closes_cracked_cavity():
 
 
 def test_graphene_gate_on_volume():
+  from igneous_tpu.graphene import graphene_client
+  from igneous_tpu.graphene_http import PCGClient
   from igneous_tpu.volume import Volume
 
+  # non-server graphene paths without a registered client: curated gate
   with pytest.raises(NotImplementedError) as e:
-    Volume("graphene://https://example.com/seg")
+    Volume("graphene://file:///tmp/no-such-watershed")
   assert "PyChunkGraph" in str(e.value)
+  # server-addressed paths self-construct the real HTTP client instead
+  # (no network touched until a request is made)
+  c = graphene_client("graphene://https://example.com/segmentation/table/x")
+  assert isinstance(c, PCGClient)

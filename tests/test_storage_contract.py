@@ -33,7 +33,7 @@ def gcs_server(monkeypatch):
 @pytest.fixture
 def s3_server(monkeypatch):
   storage._PROTOCOL_HOOKS.pop("s3", None)
-  with FakeCloudServer("s3") as srv:
+  with FakeCloudServer("s3", s3_creds=("AKIAFAKE", "fakesecret")) as srv:
     monkeypatch.setenv("S3_ENDPOINT_URL", srv.endpoint)
     monkeypatch.setenv("IGNEOUS_S3_MULTIPART_THRESHOLD", "4096")
     monkeypatch.setenv("IGNEOUS_S3_MULTIPART_CHUNK", "1024")
