@@ -21,9 +21,13 @@ RUN pip install --no-cache-dir "jax[tpu]" \
     && pip install --no-cache-dir .
 
 ENV QUEUE_URL="fq:///queue" \
-    LEASE_SECONDS="600"
+    LEASE_SECONDS="600" \
+    WORKER_BATCH="1"
 
 # the same worker loop the reference container runs (its Dockerfile CMD is
 # `igneous execute -q --lease-sec $LEASE_SECONDS $SQS_URL`). exec keeps the
 # worker as PID 1 so Kubernetes SIGTERM reaches it and leases release fast.
-CMD ["sh", "-c", "exec igneous-tpu execute \"$QUEUE_URL\" --lease-sec \"$LEASE_SECONDS\" --time"]
+# WORKER_BATCH>1 turns on queue-leased batched execution (SURVEY §5.8):
+# a TPU host leases K compatible tasks per round and runs their device
+# stage as one sharded dispatch. Leave 1 on CPU-only workers.
+CMD ["sh", "-c", "exec igneous-tpu execute \"$QUEUE_URL\" --lease-sec \"$LEASE_SECONDS\" --batch \"$WORKER_BATCH\" --time"]

@@ -1412,11 +1412,6 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   if batch_size > 1:
     from .parallel.lease_batcher import poll_batched
 
-    if timing:
-      click.echo(
-        "--time is per-task; batched rounds share device dispatches, so "
-        "it is ignored with --batch > 1", err=True,
-      )
     # honor --num-tasks / the min_sec==0 single-task special exactly: the
     # lease loop must not lease past the remaining budget
     task_budget = None
@@ -1427,6 +1422,7 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     executed, stats = poll_batched(
       tq, batch_size=batch_size, lease_seconds=lease_sec,
       verbose=not quiet, stop_fn=stop_fn, task_budget=task_budget,
+      timing=timing,  # per-ROUND JSON lines (tasks share dispatches)
     )
     if not quiet:
       click.echo(

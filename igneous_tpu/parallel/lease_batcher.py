@@ -129,12 +129,17 @@ class LeaseBatcher:
     lease_seconds: float = 600,
     mesh=None,
     verbose: bool = False,
+    timing: bool = False,
   ):
     self.queue = queue
     self.batch_size = int(batch_size)
     self.lease_seconds = lease_seconds
     self.mesh = mesh
     self.verbose = verbose
+    # --time equivalent for batched rounds: per-task stage timing makes
+    # no sense when K tasks share one dispatch, so emit one JSON line
+    # per lease ROUND instead (wall, members, dispatches delta)
+    self.timing = timing
     self.stats = {
       "executed": 0, "batched": 0, "solo": 0, "failed": 0,
       "dispatches": defaultdict(int),
@@ -180,7 +185,25 @@ class LeaseBatcher:
         backoff = min(backoff * 2, max_backoff_window)
         continue
       backoff = 1.0
-      self.run_round(members)
+      if self.timing:
+        import json
+
+        before = dict(self.stats, dispatches=dict(self.stats["dispatches"]))
+        t0 = time.perf_counter()
+        self.run_round(members)
+        print(json.dumps({
+          "round_members": len(members),
+          "wall_s": round(time.perf_counter() - t0, 3),
+          "executed": self.stats["executed"] - before["executed"],
+          "failed": self.stats["failed"] - before["failed"],
+          "dispatches": {
+            k: v - before["dispatches"].get(k, 0)
+            for k, v in self.stats["dispatches"].items()
+            if v - before["dispatches"].get(k, 0)
+          },
+        }))
+      else:
+        self.run_round(members)
 
   def run_round(self, members):
     """Execute one lease round: group, dispatch groups, solo the rest."""
@@ -415,11 +438,12 @@ def poll_batched(
   max_backoff_window: float = 30.0,
   mesh=None,
   task_budget: Optional[int] = None,
+  timing: bool = False,
 ):
   """Functional entry point mirroring queues.filequeue.poll_loop."""
   batcher = LeaseBatcher(
     queue, batch_size=batch_size, lease_seconds=lease_seconds,
-    mesh=mesh, verbose=verbose,
+    mesh=mesh, verbose=verbose, timing=timing,
   )
   executed = batcher.poll(
     stop_fn=stop_fn, max_backoff_window=max_backoff_window,
