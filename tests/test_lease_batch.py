@@ -317,3 +317,25 @@ def test_unbatchable_tasks_run_solo(tmp_path):
   assert executed == 3
   assert stats["solo"] == 3
   assert q.is_empty()
+
+
+def test_batched_execution_over_sqs(img_pair):
+  """The lease batcher is queue-agnostic: the same round/grouping
+  machinery drains an sqs:// queue (fake transport with real visibility
+  semantics), deleting each lease independently."""
+  from igneous_tpu.queues import FakeSQSTransport, SQSQueue
+
+  root, solo_path, batched_path = img_pair
+  for t in _downsample_tasks(solo_path):
+    t.execute()
+
+  q = SQSQueue(
+    "sqs://fake/batch", transport=FakeSQSTransport(),
+    empty_confirmation_sec=0,
+  )
+  q.insert(_downsample_tasks(batched_path))
+  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
+  assert executed == 8
+  assert stats["dispatches"]["downsample"] == 1
+  assert q.is_empty()
+  assert_trees_identical(f"{root}/solo", f"{root}/batched")
