@@ -80,12 +80,28 @@ def _downsample_tasks(path):
   ]
 
 
-def test_downsample_batch_one_dispatch_byte_identical(img_pair, tmp_path):
+@pytest.fixture(params=["fq", "sqs"])
+def queue_factory(request, tmp_path):
+  """The lease batcher is queue-agnostic: both backends must drain with
+  identical round/grouping behavior."""
+  def make():
+    if request.param == "fq":
+      return FileQueue(f"fq://{tmp_path}/q1")
+    from igneous_tpu.queues import FakeSQSTransport, SQSQueue
+
+    return SQSQueue(
+      "sqs://fake/batch", transport=FakeSQSTransport(),
+      empty_confirmation_sec=0,
+    )
+  return make
+
+
+def test_downsample_batch_one_dispatch_byte_identical(img_pair, queue_factory):
   root, solo_path, batched_path = img_pair
   for t in _downsample_tasks(solo_path):
     t.execute()
 
-  q = FileQueue(f"fq://{tmp_path}/q1")
+  q = queue_factory()
   q.insert(_downsample_tasks(batched_path))
   executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
 
@@ -318,24 +334,3 @@ def test_unbatchable_tasks_run_solo(tmp_path):
   assert stats["solo"] == 3
   assert q.is_empty()
 
-
-def test_batched_execution_over_sqs(img_pair):
-  """The lease batcher is queue-agnostic: the same round/grouping
-  machinery drains an sqs:// queue (fake transport with real visibility
-  semantics), deleting each lease independently."""
-  from igneous_tpu.queues import FakeSQSTransport, SQSQueue
-
-  root, solo_path, batched_path = img_pair
-  for t in _downsample_tasks(solo_path):
-    t.execute()
-
-  q = SQSQueue(
-    "sqs://fake/batch", transport=FakeSQSTransport(),
-    empty_confirmation_sec=0,
-  )
-  q.insert(_downsample_tasks(batched_path))
-  executed, stats = drain(q, batch_size=8, mesh=make_mesh(8))
-  assert executed == 8
-  assert stats["dispatches"]["downsample"] == 1
-  assert q.is_empty()
-  assert_trees_identical(f"{root}/solo", f"{root}/batched")

@@ -1,0 +1,214 @@
+"""Crackle (.ckl) reverse-engineering probe — round-4 state (ROADMAP).
+
+Run against the reference checkout's fixture:
+
+    python tools/crackle_probe.py /root/reference/test/connectomics.npy.ckl.gz
+
+Everything in `parse_*` below is VALIDATED byte-exactly against that
+fixture (every slice and section accounted for, all 512 slices):
+
+  container := header | crack_index | labels | crack_streams
+  header (24B) := 'crkl' | u8 version(0) | u16 format(0x008a:
+      data_width=4, stored_width=4, label_format=FLAT, flag bit7) |
+      u32 sx,sy,sz | u8 grid_log2(31 = whole-slice grid) |
+      u32 num_label_bytes
+  crack_index := sz * u32 per-slice crack byte lengths
+  labels(FLAT) := u64 num_uniq | u32 uniq (sorted) |
+      sz * u32 components-per-slice | u16 keys (uniq index per component)
+  crack stream (per slice) := u32 L | u16 seed-table (L bytes) | moves
+  seed-table := records (x, dy, k, dx*(k-1)) ascending rows (dy sums to
+      ~image height; k same-row seeds as x-deltas — CAVEAT: accumulated
+      x occasionally exceeds the grid width, so the extras' reading is
+      not final) + ONE trailing u16 in every slice (suspected y=0 seed
+      x; unproven). Record count
+      anti-correlates with slice component count => seeds are per
+      crack-graph component (dense slices have ~1 big network + islands).
+  moves := 2-bit symbols, LSB-first within each byte. Relative turn code:
+      0 = straight (37%), 1/3 = the two turns (staircase alternation
+      dominates their bigrams), 2 = special (8.5%), runs of exactly 1-2.
+
+What is PROVEN about the semantics (see decode_best for the closest VM):
+  * the walk is CONTINUOUS through '2' symbols (inter-'2' manhattan
+    distances match the move counts exactly) => '2' marks a junction in
+    passing without moving;
+  * '2' totals per slice ~= 2x the slice's component count — the return
+    budget of a trivalent junction graph (singles=deg-3, doubles=deg-4);
+  * walks legitimately close small loops through visited vertices
+    (1-pixel detours observed) and run into the border wanting more —
+    so the dead-end/resume trigger is an impossible (off-grid) move.
+
+What is NOT yet pinned: the resume-target rule. Mark-stack LIFO/FIFO,
+collision anchors, and derived-undrawn-edge resumes all decode the full
+stream with <=3 dangling interior endpoints but land at ~2000-2500
+components where the labels section says 1225 — right texture, wrong
+excursion placement. Round-5 plan (ROADMAP): write the ENCODER for a
+synthetic trivalent tessellation and fit the policy by matching stream
+statistics, then transplant the matched rule here.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+import sys
+
+import numpy as np
+
+DXY = [(0, -1), (1, 0), (0, 1), (-1, 0)]  # up right down left (clockwise)
+
+
+def parse_container(blob: bytes) -> dict:
+  if blob[:2] == b"\x1f\x8b":
+    blob = gzip.decompress(blob)
+  assert blob[:4] == b"crkl", "not a crackle stream"
+  version = blob[4]
+  fmt = struct.unpack("<H", blob[5:7])[0]
+  sx, sy, sz = struct.unpack("<III", blob[7:19])
+  grid_log2 = blob[19]
+  num_label_bytes = struct.unpack("<I", blob[20:24])[0]
+  idx = np.frombuffer(blob, dtype="<u4", count=sz, offset=24)
+  label_off = 24 + 4 * sz
+  nuniq = struct.unpack("<Q", blob[label_off:label_off + 8])[0]
+  uniq = np.frombuffer(blob, dtype="<u4", count=nuniq, offset=label_off + 8)
+  cc_off = label_off + 8 + 4 * nuniq
+  cc_per_slice = np.frombuffer(blob, dtype="<u4", count=sz, offset=cc_off)
+  keys = np.frombuffer(
+    blob, dtype="<u2", count=int(cc_per_slice.sum()), offset=cc_off + 4 * sz
+  )
+  crack_off = label_off + num_label_bytes
+  offs = crack_off + np.concatenate(
+    [[0], np.cumsum(idx[:-1])]
+  ).astype(np.int64)
+  assert crack_off + int(idx.sum()) == len(blob), "size accounting failed"
+  return {
+    "version": version, "format": fmt, "shape": (sx, sy, sz),
+    "grid_log2": grid_log2, "uniq": uniq, "cc_per_slice": cc_per_slice,
+    "keys": keys, "crack_index": idx, "slice_offsets": offs, "blob": blob,
+  }
+
+
+def parse_slice(c: dict, z: int):
+  """-> (seeds [(x, y)...] ascending rows, trailing u16s, 2-bit symbols).
+
+  The final byte's unused bit pairs decode as up-to-3 phantom '0'
+  symbols — the stream carries no explicit symbol count, so consumers
+  doing statistics should ignore the last byte's worth of symbols."""
+  blob = c["blob"]
+  s = blob[c["slice_offsets"][z]:c["slice_offsets"][z] + c["crack_index"][z]]
+  L = struct.unpack("<I", s[:4])[0]
+  t = np.frombuffer(s[4:4 + L], dtype="<u2")
+  mv = np.frombuffer(s[4 + L:], dtype=np.uint8)
+  syms = np.stack(
+    [mv & 3, (mv >> 2) & 3, (mv >> 4) & 3, (mv >> 6) & 3], axis=1
+  ).ravel()
+  i = 0
+  seeds = []
+  y = 0
+  trailing = []
+  while i < len(t):
+    if i + 3 > len(t):
+      trailing = [int(v) for v in t[i:]]
+      break
+    x, dy, k = int(t[i]), int(t[i + 1]), int(t[i + 2])
+    i += 3
+    y += dy
+    xs = [x]
+    for _ in range(k - 1):
+      xs.append(xs[-1] + int(t[i]))
+      i += 1
+    seeds.extend((xx, y) for xx in xs)
+  return seeds, trailing, syms
+
+
+def decode_best(seeds, syms, sx=512, sy=512, chir=True, d0=0):
+  """Closest VM so far (NOT correct — see module docstring): continuous
+  relative walk, '2' pushes a junction mark, an off-grid move pops the
+  most recent mark and resumes along its first undrawn edge."""
+  x, y = seeds[0]
+  d = d0
+  ci = 1
+  marks = []
+  vcr = np.zeros((sx + 1, sy), bool)
+  hcr = np.zeros((sx, sy + 1), bool)
+
+  def draw(x, y, d, nx, ny):
+    if d == 0: vcr[x, ny] = True
+    elif d == 2: vcr[x, y] = True
+    elif d == 1: hcr[x, y] = True
+    else: hcr[nx, y] = True
+
+  def undrawn(x, y):
+    out = []
+    if y - 1 >= 0 and not vcr[x, y - 1]: out.append(0)
+    if x + 1 <= sx and x <= sx - 1 and not hcr[x, y]: out.append(1)
+    if y + 1 <= sy and y <= sy - 1 and not vcr[x, y]: out.append(2)
+    if x - 1 >= 0 and not hcr[x - 1, y]: out.append(3)
+    return out
+
+  n = len(syms)
+  si = 0
+  while si < n:
+    s = int(syms[si]); si += 1
+    if chir and s in (1, 3): s = 4 - s
+    if s == 2:
+      marks.append((x, y))
+      continue
+    d2 = (d + s) % 4
+    nx, ny = x + DXY[d2][0], y + DXY[d2][1]
+    if not (0 <= nx <= sx and 0 <= ny <= sy):
+      if marks:
+        x, y = marks.pop()
+        free = undrawn(x, y)
+        if free:
+          d = free[0]
+          nx, ny = x + DXY[d][0], y + DXY[d][1]
+          draw(x, y, d, nx, ny)
+          x, y = nx, ny
+        continue
+      if ci < len(seeds):
+        x, y = seeds[ci]; ci += 1; d = d0
+        continue
+      break
+    d = d2
+    draw(x, y, d, nx, ny)
+    x, y = nx, ny
+  return vcr, hcr
+
+
+def components(vcr, hcr, sx=512, sy=512) -> int:
+  parent = np.arange(sx * sy, dtype=np.int64)
+
+  def find(a):
+    while parent[a] != a:
+      parent[a] = parent[parent[a]]
+      a = parent[a]
+    return a
+
+  xs, ys = np.where(~vcr[1:sx, :])
+  for x, y in zip(xs, ys):
+    ra, rb = find(x * sy + y), find((x + 1) * sy + y)
+    if ra != rb: parent[rb] = ra
+  xs, ys = np.where(~hcr[:, 1:sy])
+  for x, y in zip(xs, ys):
+    ra, rb = find(x * sy + y), find(x * sy + y + 1)
+    if ra != rb: parent[rb] = ra
+  return len({find(i) for i in range(sx * sy)})
+
+
+if __name__ == "__main__":
+  path = sys.argv[1] if len(sys.argv) > 1 else (
+    "/root/reference/test/connectomics.npy.ckl.gz"
+  )
+  with open(path, "rb") as f:
+    c = parse_container(f.read())
+  sx, sy, sz = c["shape"]
+  print(f"crackle v{c['version']} format=0x{c['format']:04x} "
+        f"{sx}x{sy}x{sz} labels={len(c['uniq'])} "
+        f"components={int(c['cc_per_slice'].sum())}")
+  for z in (0, sz // 2, sz - 1):
+    seeds, trailing, syms = parse_slice(c, z)
+    n2 = int((syms == 2).sum())
+    vcr, hcr = decode_best(seeds, syms, sx, sy)
+    cc = components(vcr, hcr, sx, sy)
+    print(f"  z={z}: seeds={len(seeds)}+{trailing} syms={len(syms)} "
+          f"twos={n2} decode_best cc={cc} vs truth {c['cc_per_slice'][z]}")
