@@ -334,3 +334,18 @@ def test_unbatchable_tasks_run_solo(tmp_path):
   assert stats["solo"] == 3
   assert q.is_empty()
 
+
+
+def test_task_budget_caps_lease_round(img_pair):
+  """--num-tasks N with --batch K > N must execute exactly N (the lease
+  loop itself is capped; stop_fn alone would overshoot by up to K-1)."""
+  root, _solo, batched_path = img_pair
+  q = FileQueue(f"fq://{root}/qbudget")
+  q.insert(_downsample_tasks(batched_path))
+  executed, stats = poll_batched(
+    q, batch_size=8, lease_seconds=600,
+    stop_fn=lambda executed, empty: empty or executed >= 3,
+    task_budget=3, mesh=make_mesh(8),
+  )
+  assert executed == 3
+  assert q.enqueued == 5  # the other five leases were never taken
