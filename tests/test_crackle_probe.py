@@ -51,11 +51,9 @@ def test_slice_streams_parse_cleanly(container):
     # seeds sit inside the vertex grid in ascending rows
     assert len(trailing) == 1
     assert seeds, f"slice {z} produced no seeds"
+    xs = np.array([s[0] for s in seeds])
     ys = np.array([s[1] for s in seeds])
-    # NOTE: first-of-row x values stay in [0, 512]; the same-row
-    # delta-accumulated extras occasionally exceed it, so the (x, dy,
-    # k, dx...) record reading is still imperfect — rows are proven,
-    # columns are not (ROADMAP round-5 item)
+    assert xs.min() >= 0 and xs.max() <= 512
     assert ys.min() >= 0 and ys.max() <= 512
     assert bool(np.all(np.diff(ys) >= 0))
     # the '2' budget tracks the junction count: ~2x the slice's

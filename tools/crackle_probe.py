@@ -16,13 +16,13 @@ fixture (every slice and section accounted for, all 512 slices):
   labels(FLAT) := u64 num_uniq | u32 uniq (sorted) |
       sz * u32 components-per-slice | u16 keys (uniq index per component)
   crack stream (per slice) := u32 L | u16 seed-table (L bytes) | moves
-  seed-table := records (x, dy, k, dx*(k-1)) ascending rows (dy sums to
-      ~image height; k same-row seeds as x-deltas — CAVEAT: accumulated
-      x occasionally exceeds the grid width, so the extras' reading is
-      not final) + ONE trailing u16 in every slice (suspected y=0 seed
-      x; unproven). Record count
-      anti-correlates with slice component count => seeds are per
-      crack-graph component (dense slices have ~1 big network + islands).
+  seed-table := records (x, dy, k, extra_x*(k-1)) ascending rows (dy
+      sums to ~image height; k seeds on the row — the k-1 extras are
+      ABSOLUTE x values, not deltas: raw extras never exceed the grid
+      width while delta-accumulation overruns it in 280/512 slices) +
+      ONE trailing u16 in every slice (suspected y=0 seed x; unproven).
+      Record count anti-correlates with slice component count => seeds
+      are per crack-graph component (~1 big network + islands).
   moves := 2-bit symbols, LSB-first within each byte. Relative turn code:
       0 = straight (37%), 1/3 = the two turns (staircase alternation
       dominates their bigrams), 2 = special (8.5%), runs of exactly 1-2.
@@ -114,7 +114,7 @@ def parse_slice(c: dict, z: int):
     y += dy
     xs = [x]
     for _ in range(k - 1):
-      xs.append(xs[-1] + int(t[i]))
+      xs.append(int(t[i]))  # absolute x, not a delta (see docstring)
       i += 1
     seeds.extend((xx, y) for xx in xs)
   return seeds, trailing, syms
