@@ -67,6 +67,57 @@ def compute_factors(
   return factors
 
 
+def chunk_writable_factors(
+  task_shape: Sequence[int],
+  factor,
+  num_mips: int,
+  chunk_size: Sequence[int],
+  mip_extent: Sequence[int],
+) -> List[Tuple[int, int, int]]:
+  """compute_factors truncated at the first mip whose task-level output
+  could not legally be uploaded: each produced cutout must land on the
+  chunk grid, except along axes where a single task spans the whole mip
+  extent (those writes clip to dataset bounds, which upload allows).
+
+  ``mip_extent`` is the dataset size3() at the SOURCE mip. This guards
+  the task factories against a memory_target (or explicit shape) too
+  small for the requested num_mips: without it they emit tasks whose
+  deeper mips fail AlignmentError at upload (e.g. 128-wide tasks asked
+  for 2 mips over 64^3 chunks write 32-wide mip-2 cutouts)."""
+  extent = np.asarray(mip_extent, dtype=np.int64)
+  cs = np.asarray(chunk_size, dtype=np.int64)
+
+  def per_mip(i, cum):
+    return cs, -(-extent // cum)  # ceil — scale geometry is ceil-size
+
+  return truncate_writable_factors(
+    task_shape, compute_factors(task_shape, factor, num_mips), per_mip
+  )
+
+
+def truncate_writable_factors(task_shape, factors, per_mip):
+  """Shared invariant behind chunk_writable_factors and the task-side
+  guard (tasks/image.py _resolve_factors): truncate ``factors`` at the
+  first mip where some produced cutout axis is neither chunk-aligned nor
+  extent-spanning. ``per_mip(i, cum)`` supplies that mip's (chunk_size,
+  extent) — planning uses one chunk size + the scaled source extent,
+  execution reads each destination scale's own geometry."""
+  shape = np.asarray(task_shape, dtype=np.int64)
+  out: List[Tuple[int, int, int]] = []
+  cum = np.ones(3, dtype=np.int64)
+  for i, f in enumerate(factors):
+    cum = cum * np.asarray(f, dtype=np.int64)
+    nxt = shape // cum
+    cs, msize = per_mip(i, cum)
+    if np.any(
+      (nxt % np.asarray(cs, dtype=np.int64) != 0)
+      & (nxt < np.asarray(msize, dtype=np.int64))
+    ):
+      break
+    out.append(f)
+  return out
+
+
 def near_isotropic_factor_sequence(
   resolution: Sequence[int], num_mips: int
 ) -> List[Tuple[int, int, int]]:

@@ -536,6 +536,16 @@ def host_downsample(
   return results
 
 
+def _host_pool_active() -> bool:
+  """True when downsample_auto would try the native host kernels first.
+  Exposed so batching policy (parallel/lease_batcher._group_key) can keep
+  downsamples solo on accelerator-less workers, where per-cutout native
+  pooling IS the fast path and an XLA-CPU batch dispatch is a ~9x
+  pessimization."""
+  mode = os.environ.get("IGNEOUS_POOL_HOST", "auto").lower()
+  return mode != "0" and (mode == "1" or _backend_is_cpu())
+
+
 def downsample_auto(
   img: np.ndarray,
   factor,
@@ -545,8 +555,7 @@ def downsample_auto(
 ) -> List[np.ndarray]:
   """Production dispatch: native host kernels when jax would run on CPU
   anyway (or when forced), device kernels otherwise."""
-  mode = os.environ.get("IGNEOUS_POOL_HOST", "auto").lower()
-  if mode != "0" and (mode == "1" or _backend_is_cpu()):
+  if _host_pool_active():
     out = host_downsample(img, factor, num_mips, method=method, sparse=sparse)
     if out is not None:
       return out

@@ -96,6 +96,26 @@ def batched_downsample(
   bounds = get_bounds(vol, bounds, mip, mip)
   shape = Vec(*shape)
 
+  if pooling._host_pool_active():
+    # CPU-only host: per-cutout native pooling is the production path
+    # (same policy as batched_ccl_faces) — an XLA-CPU batch dispatch is
+    # a ~9x pessimization on the most common task type
+    stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
+             "native_cutouts": 0}
+    from ..lib import chunk_bboxes
+
+    for gbox in chunk_bboxes(bounds, shape, offset=bounds.minpt, clamp=False):
+      if Bbox.intersection(gbox, bounds).empty():
+        continue
+      DownsampleTask(
+        layer_path=layer_path, mip=mip, shape=shape.tolist(),
+        offset=[int(v) for v in gbox.minpt], fill_missing=fill_missing,
+        sparse=sparse, num_mips=len(factors), factor=tuple(factor),
+        compress=compress, downsample_method=method,
+      ).execute()
+      stats["native_cutouts"] += 1
+    return stats
+
   full_boxes = []
   edge_offsets = []  # nominal grid offsets; the per-task path clamps itself
   from ..lib import chunk_bboxes

@@ -12,7 +12,9 @@ mesh — while every lease still completes independently:
   * a member whose host stage fails keeps its lease and recycles alone
     after the visibility timeout (at-least-once, exactly like the solo
     poll loop in queues/filequeue.py:36-80);
-  * a failed group dispatch fails all members the same way;
+  * a failed group dispatch falls back to running the incomplete
+    members solo within the same round, so one poisoned member can't
+    repeatedly drag K-1 healthy leases into recycling;
   * outputs are byte-identical to solo execution — the group handlers
     feed the batched device results back through the SAME completion
     code paths the solo tasks use (downsample_and_upload(_mips_out=...),
@@ -61,6 +63,13 @@ def _group_key(task, volmeta_cache):
     return volmeta_cache[key]
 
   if type(task) is DownsampleTask:
+    from ..ops.pooling import _host_pool_active
+
+    if _host_pool_active():
+      # accelerator-less host: per-cutout native pooling IS the fast
+      # path (same policy as the CCL native check below); an XLA-CPU
+      # batch dispatch would be a ~9x pessimization
+      return None
     bounds = bounds_of(task.src_path, task.mip, task.fill_missing)
     box = Bbox.intersection(
       Bbox(task.offset, task.offset + task.shape), bounds
@@ -142,8 +151,10 @@ class LeaseBatcher:
     self.timing = timing
     self.stats = {
       "executed": 0, "batched": 0, "solo": 0, "failed": 0,
+      "group_fallbacks": 0,
       "dispatches": defaultdict(int),
     }
+    self._completed_in_group = set()
 
   # -- poll loop ------------------------------------------------------------
 
@@ -230,15 +241,24 @@ class LeaseBatcher:
         "ccl_faces": self._run_ccl_group,
         "mesh": self._run_mesh_group,
       }[key[0]]
+      self._completed_in_group = set()
       try:
         handler(key, group)
       except Exception:
-        # group-stage failure: every member keeps its lease and recycles
+        # group-stage failure (one member's corrupt chunk poisoning the
+        # shared download/dispatch, say): don't let it drag K-1 healthy
+        # leases into recycling — rerun the incomplete members solo
+        # within the same round, so only genuinely bad leases recycle.
+        # Tasks are idempotent (at-least-once), so a member whose work
+        # finished but whose completion raised is safe to rerun.
         if self.verbose:
           import traceback
 
           traceback.print_exc()
-        self.stats["failed"] += len(group)
+        self.stats["group_fallbacks"] += 1
+        solo.extend(
+          m for m in group if m[1] not in self._completed_in_group
+        )
 
     for task, lease_id in solo:
       if self.verbose:
@@ -262,6 +282,7 @@ class LeaseBatcher:
     self.queue.delete(lease_id)
     self.stats["executed"] += 1
     self.stats["batched"] += 1
+    self._completed_in_group.add(lease_id)
 
   def _finish_members(self, group, finish_one):
     """Run each member's host completion; a failure keeps that member's

@@ -16,7 +16,7 @@ from ..volume import Volume
 from ..downsample_scales import (
   DEFAULT_FACTOR,
   axis_to_factor,
-  compute_factors,
+  chunk_writable_factors,
   create_downsample_scales,
   downsample_shape_from_memory_target,
 )
@@ -141,7 +141,20 @@ def create_downsampling_tasks(
     factor = axis_to_factor(axis) if axis != "z" else DEFAULT_FACTOR
 
   shape = _pick_task_shape(vol, mip, factor, memory_target, num_mips, chunk_size)
-  factors = compute_factors(shape, factor, num_mips)
+  factors = chunk_writable_factors(
+    shape, factor, num_mips,
+    chunk_size if chunk_size is not None else vol.meta.chunk_size(mip),
+    vol.meta.bounds(mip).size3(),
+  )
+  if num_mips > 0 and not factors:
+    # a silent no-op plan (0 scales, 0-mip tasks) reads as success while
+    # downsampling nothing; batched_downsample raises here too
+    raise ValueError(
+      f"task shape {shape.tolist()} admits no chunk-writable downsample "
+      f"by {list(factor)} (chunk "
+      f"{list(chunk_size) if chunk_size is not None else vol.meta.chunk_size(mip).tolist()}); "
+      f"raise memory_target or pass a larger/even shape"
+    )
   create_downsample_scales(
     vol.meta, mip, shape, factor,
     num_mips=len(factors),
@@ -341,11 +354,23 @@ def create_transfer_tasks(
   shape = Vec(*shape)
 
   if num_mips > 0:
-    factors = compute_factors(shape, factor, num_mips)
+    factors = chunk_writable_factors(
+      shape, factor, num_mips, dest_chunk, dest.meta.bounds(mip).size3()
+    )
+    if not factors:
+      raise ValueError(
+        f"task shape {shape.tolist()} admits no chunk-writable downsample "
+        f"by {list(factor)} (chunk {list(dest_chunk)}); raise "
+        f"memory_target, pass a larger/even shape, or num_mips=0"
+      )
     create_downsample_scales(
       dest.meta, mip, shape, factor, num_mips=len(factors),
       chunk_size=dest_chunk, encoding=encoding,
     )
+    # the tasks must carry the truncated plan too: deeper scales may
+    # already exist in the destination (truncate_scales=False), and
+    # execution would otherwise write unaligned deep mips
+    num_mips = len(factors)
   if encoding_level is not None or encoding_effort is not None:
     for m in range(mip, len(dest.info["scales"])):
       dest.meta.set_encoding(m, None, encoding_level, encoding_effort)

@@ -22,7 +22,11 @@ import numpy as np
 from ..lib import Bbox, Vec
 from ..queues.registry import RegisteredTask, queueable
 from ..volume import Volume
-from ..downsample_scales import compute_factors, DEFAULT_FACTOR
+from ..downsample_scales import (
+  DEFAULT_FACTOR,
+  compute_factors,
+  truncate_writable_factors,
+)
 from ..ops import pooling
 from .. import telemetry
 
@@ -43,7 +47,20 @@ def _resolve_factors(
   if num_mips is None:
     num_mips = available
   num_mips = min(num_mips, available)
-  return compute_factors(task_shape, factor, num_mips)
+  factors = compute_factors(task_shape, factor, num_mips)
+
+  # chunk-writability guard, per destination mip with that mip's own
+  # geometry: a task pointed at pre-existing deep scales the planner
+  # didn't create must stop at the last mip whose cutouts land on the
+  # chunk grid — unless a single task spans the whole extent (clipped
+  # writes at dataset bounds are legal)
+  def per_mip(i, cum):
+    dest_mip = mip + i + 1
+    return (
+      vol.meta.chunk_size(dest_mip), vol.meta.bounds(dest_mip).size3()
+    )
+
+  return truncate_writable_factors(task_shape, factors, per_mip)
 
 
 def downsample_and_upload(

