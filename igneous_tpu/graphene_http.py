@@ -48,17 +48,19 @@ _AUTH_CACHE: dict = {}
 def _auth_header() -> dict:
   """CAVE/PCG deployments use a bearer token from
   ``~/.cloudvolume/secrets/cave-secret.json`` (or chunkedgraph-secret) —
-  honor the same convention. Resolved once per secrets dir (this sits on
-  the hot download path), and a secret file without a usable ``token``
-  key falls through to the next candidate instead of ending the search."""
+  honor the same convention. Successful loads cache per secrets dir
+  (this sits on the hot download path); a MISSING token is never cached,
+  so a long-running worker picks up a token provisioned after startup,
+  and a 401/403 invalidates the cache (_invalidate_auth) so a rotated
+  secret file is re-read. A secret file without a usable ``token`` key
+  falls through to the next candidate instead of ending the search."""
   from . import secrets
 
   tok = os.environ.get("CAVE_TOKEN")
   if not tok:
     sdir = secrets.secrets_dir()
-    if sdir in _AUTH_CACHE:
-      tok = _AUTH_CACHE[sdir]
-    else:
+    tok = _AUTH_CACHE.get(sdir)
+    if not tok:
       for name in ("cave-secret.json", "chunkedgraph-secret.json"):
         path = os.path.join(sdir, name)
         if not os.path.exists(path):
@@ -67,9 +69,31 @@ def _auth_header() -> dict:
           blob = json.load(f)
         tok = blob.get("token")
         if tok:
+          _AUTH_CACHE[sdir] = tok
           break
-      _AUTH_CACHE[sdir] = tok
   return {"Authorization": f"Bearer {tok}"} if tok else {}
+
+
+def _invalidate_auth() -> None:
+  _AUTH_CACHE.clear()
+
+
+def _auth_request(method: str, url: str, data=None, extra_headers=None):
+  """request() with the bearer header, retried ONCE with a re-read token
+  on 401/403 — so a worker whose secret was rotated (or provisioned late)
+  recovers without a restart."""
+  headers = dict(extra_headers or {})
+  try:
+    return request(method, url, data=data,
+                   headers={**headers, **_auth_header()})
+  except HttpError as e:
+    # an env-var token can't be refreshed by re-reading secret files —
+    # retrying would resend the identical request
+    if e.status not in (401, 403) or os.environ.get("CAVE_TOKEN"):
+      raise
+    _invalidate_auth()
+    return request(method, url, data=data,
+                   headers={**headers, **_auth_header()})
 
 
 class PCGClient:
@@ -84,9 +108,7 @@ class PCGClient:
   @property
   def info(self) -> dict:
     if self._info is None:
-      status, _h, body = request(
-        "GET", f"{self.base}/info", headers=_auth_header()
-      )
+      status, _h, body = _auth_request("GET", f"{self.base}/info")
       if status != 200:
         raise HttpError(status, f"{self.base}/info", body)
       self._info = json.loads(body)
@@ -122,11 +144,9 @@ class PCGClient:
       url = f"{self.base}/node/roots_binary"
       if params:
         url += "?" + "&".join(params)
-      status, _h, body = request(
+      status, _h, body = _auth_request(
         "POST", url, data=send.astype("<u8").tobytes(),
-        headers={
-          "Content-Type": "application/octet-stream", **_auth_header(),
-        },
+        extra_headers={"Content-Type": "application/octet-stream"},
       )
       if status != 200:
         raise HttpError(status, url, body)
@@ -156,7 +176,7 @@ class PCGClient:
     (``tabular_change_log``): {"operations": [{"is_merge": bool,
     "timestamp": float, "sink": [...], "source": [...]}, ...]}."""
     url = f"{self.base}/root/{int(root_id)}/tabular_change_log"
-    status, _h, body = request("GET", url, headers=_auth_header())
+    status, _h, body = _auth_request("GET", url)
     if status != 200:
       raise HttpError(status, url, body)
     return json.loads(body)

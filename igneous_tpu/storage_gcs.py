@@ -205,6 +205,7 @@ class GCSBackend:
         "PUT", session, data=chunk,
         headers={"Content-Range": f"bytes {start}-{end}/{total}",
                  **self.auth.header()},
+        allow_status=(308,),
       )
       # 308 = chunk accepted, session continues; 200/201 = final chunk
       if status not in (200, 201) and status != 308:

@@ -36,12 +36,16 @@ def request(
   data: Optional[bytes] = None,
   timeout: float = 60.0,
   retries: int = MAX_RETRIES,
+  allow_status: Tuple[int, ...] = (),
 ) -> Tuple[int, Dict[str, str], bytes]:
   """One HTTP exchange with retry/backoff. Returns (status, headers, body).
 
-  404/416 return normally (callers map them to None); other non-retryable
-  4xx raise HttpError; retryable statuses and connection errors retry
-  with exponential backoff + full jitter, then raise."""
+  404/416 return normally (callers map them to None); ``allow_status``
+  passes additional statuses through (GCS resumable-chunk PUTs expect
+  308 "resume incomplete" — but only that caller: a get() must never
+  hand a redirect body back as object content); other non-retryable
+  statuses raise HttpError; retryable statuses and connection errors
+  retry with exponential backoff + full jitter, then raise."""
   last_exc: Optional[Exception] = None
   for attempt in range(retries):
     req = urllib.request.Request(
@@ -52,9 +56,8 @@ def request(
         return resp.status, dict(resp.headers), resp.read()
     except urllib.error.HTTPError as e:
       body = e.read()
-      # 404/416: caller maps to None/empty; 308: GCS resumable-session
-      # "resume incomplete" ack (urllib treats any non-2xx as an error)
-      if e.code in (308, 404, 416):
+      # 404/416: caller maps to None/empty (urllib raises on non-2xx)
+      if e.code in (404, 416) or e.code in allow_status:
         return e.code, dict(e.headers or {}), body
       if e.code in RETRYABLE_STATUS and attempt + 1 < retries:
         last_exc = HttpError(e.code, url, body)

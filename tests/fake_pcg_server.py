@@ -19,13 +19,17 @@ import numpy as np
 
 
 class FakePCGServer:
-  def __init__(self, graph, sv_chunks=None, data_dir=None):
+  def __init__(self, graph, sv_chunks=None, data_dir=None,
+               required_token=None):
     """graph: LocalChunkGraph; sv_chunks: {sv_id: linear_chunk_index}
     (defaults to chunk 0 for every sv); data_dir: watershed layer path
-    advertised in /info."""
+    advertised in /info; required_token: when set, requests must carry
+    ``Authorization: Bearer <token>`` or get 401 (mutable — reassign to
+    model CAVE token rotation)."""
     self.graph = graph
     self.sv_chunks = dict(sv_chunks or {})
     self.data_dir = data_dir
+    self.required_token = required_token
     self.requests = []
     outer = self
 
@@ -41,9 +45,18 @@ class FakePCGServer:
         if body:
           self.wfile.write(body)
 
+      def _authorized(self):
+        if outer.required_token is None:
+          return True
+        got = self.headers.get("Authorization")
+        return got == f"Bearer {outer.required_token}"
+
       def do_GET(self):
         parsed = urllib.parse.urlsplit(self.path)
         outer.requests.append(("GET", self.path))
+        if not self._authorized():
+          self._respond(401, b'{"error": "missing or invalid token"}')
+          return
         if parsed.path.endswith("/info"):
           info = {
             "graph": {
@@ -85,6 +98,9 @@ class FakePCGServer:
         parsed = urllib.parse.urlsplit(self.path)
         qs = dict(urllib.parse.parse_qsl(parsed.query))
         outer.requests.append(("POST", self.path))
+        if not self._authorized():
+          self._respond(401, b'{"error": "missing or invalid token"}')
+          return
         n = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(n)
         if parsed.path.endswith("/node/roots_binary"):

@@ -408,6 +408,43 @@ def test_pcg_client_change_log():
     assert times == sorted(times)
 
 
+def test_pcg_auth_late_provision_and_rotation(tmp_path, monkeypatch):
+  """ADVICE r4: a long-running worker must pick up a CAVE token
+  provisioned AFTER startup (missing tokens are never cached) and
+  recover from a 401 after token rotation (cache invalidated + one
+  retry with the re-read secret)."""
+  import json as _json
+
+  from fake_pcg_server import FakePCGServer
+
+  from igneous_tpu import graphene_http
+  from igneous_tpu.graphene_http import PCGClient
+  from igneous_tpu.storage_http import HttpError
+
+  monkeypatch.setenv("IGNEOUS_TPU_SECRETS", str(tmp_path))
+  monkeypatch.delenv("CAVE_TOKEN", raising=False)
+  graphene_http._AUTH_CACHE.clear()
+
+  g = LocalChunkGraph(initial_edges=[(1, 2)])
+  with FakePCGServer(g, {1: 0, 2: 0}, required_token="tok-v1") as srv:
+    c = PCGClient(srv.base_url)
+    sv = np.asarray([1, 2], np.uint64)
+    with pytest.raises(HttpError) as exc:  # no token anywhere yet
+      c.get_roots(sv)
+    assert exc.value.status == 401
+
+    # token provisioned after startup: next call must see it
+    secret = tmp_path / "cave-secret.json"
+    secret.write_text(_json.dumps({"token": "tok-v1"}))
+    assert len(np.unique(c.get_roots(sv))) == 1
+
+    # rotation: server now requires tok-v2; the stale cached token 401s,
+    # the client re-reads the secret and retries once
+    srv.required_token = "tok-v2"
+    secret.write_text(_json.dumps({"token": "tok-v2"}))
+    assert len(np.unique(c.get_roots(sv))) == 1
+
+
 def test_pcg_client_voxel_graph_reference_style():
   """The HTTP client builds the autapse voxel graph the way the reference
   does (L2 field + root shading, skeleton.py:337-400): an L2 boundary
