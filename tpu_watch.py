@@ -72,7 +72,13 @@ def run_stage(name: str, cmd, env_extra, timeout_s: float, out_path=None):
       break
     except (json.JSONDecodeError, ValueError):
       continue
-  if out_path and result is not None:
+  if out_path:
+    if result is None:
+      # rc 0 but no JSON = no artifact: report failure, or the watcher
+      # would re-run this stage on every window yet never complete
+      log_event(stage=name, ok=False, took_s=took,
+                error="no JSON line in child stdout")
+      return False
     with open(out_path, "w") as f:
       json.dump(result, f)
   platform = (result or {}).get("detail", {}).get("platform", "?")
@@ -105,54 +111,110 @@ print(json.dumps(out))
 """
 
 
+BATCH_E2E_SNIPPET = r"""
+import json, os, tempfile, time
+import numpy as np
+
+os.environ["IGNEOUS_POOL_HOST"] = "0"  # this measures the chip, not the host
+import jax
+from igneous_tpu import task_creation as tc
+from igneous_tpu.parallel import make_mesh
+from igneous_tpu.parallel.lease_batcher import poll_batched
+from igneous_tpu.queues import FileQueue
+from igneous_tpu.volume import Volume
+
+rng = np.random.default_rng(0)
+data = rng.integers(0, 255, (1024, 512, 64)).astype(np.uint8)
+td = tempfile.mkdtemp()
+stats = None
+for rep in ("warmup", "timed"):  # rep 1 pays the XLA compile
+  path = f"file://{td}/img_{rep}"
+  Volume.from_numpy(data, path, chunk_size=(64, 64, 64))
+  tasks = tc.create_downsampling_tasks(
+    path, mip=0, num_mips=2, compress=None, memory_target=int(4e6))
+  q = FileQueue(f"fq://{td}/q_{rep}")
+  q.insert(tasks)
+  t0 = time.perf_counter()
+  executed, stats = poll_batched(
+    q, batch_size=8, lease_seconds=600,
+    stop_fn=lambda executed, empty: empty, mesh=make_mesh())
+  dt = time.perf_counter() - t0
+voxps = data.size / dt
+print(json.dumps({
+  "metric": "tpu_batch_e2e_voxps", "value": round(voxps, 1), "unit": "vox/s",
+  "detail": {"executed": executed, "stats": {
+    k: (dict(v) if hasattr(v, "items") else v) for k, v in stats.items()},
+    "wall_s": round(dt, 2), "platform": jax.default_backend()},
+}))
+"""
+
+
+# (name, cmd, env_extra, timeout_s, artifact) — quick bench FIRST so an
+# artifact lands within minutes of any healthy window
+def _stages():
+  return [
+    ("bench-quick", [sys.executable, "bench.py", "--child", "tpu"],
+     {"BENCH_QUICK": "1"}, 1200, "BENCH_TPU_QUICK.json"),
+    ("bench-full", [sys.executable, "bench.py", "--child", "tpu"],
+     {}, 3600, "BENCH_TPU_FULL.json"),
+    ("bench-kernels", [sys.executable, "-c", KERNEL_AB_SNIPPET],
+     {}, 3600, "BENCH_TPU_KERNELS.json"),
+    # north-star path on hardware: queue-leased --batch worker on-chip
+    ("bench-batch", [sys.executable, "-c", BATCH_E2E_SNIPPET],
+     {}, 3600, "BENCH_TPU_BATCH.json"),
+  ]
+
+
+def missing_stages():
+  return [
+    s for s in _stages() if not os.path.exists(os.path.join(_REPO, s[4]))
+  ]
+
+
 def on_revival():
-  log_event(stage="revival-detected", ok=True)
-  # 1. quick bench FIRST: minutes, artifact lands immediately
-  ok_quick = run_stage(
-    "bench-quick",
-    [sys.executable, "bench.py", "--child", "tpu"],
-    {"BENCH_QUICK": "1"},
-    timeout_s=1200,
-    out_path=os.path.join(_REPO, "BENCH_TPU_QUICK.json"),
-  )
-  if not ok_quick:
-    return False
-  # 2. full bench
-  run_stage(
-    "bench-full",
-    [sys.executable, "bench.py", "--child", "tpu"],
-    {},
-    timeout_s=3600,
-    out_path=os.path.join(_REPO, "BENCH_TPU_FULL.json"),
-  )
-  # 3. parked kernel decisions (pool A/B, CCL scan-vs-relax, EDT 512^3)
-  run_stage(
-    "bench-kernels",
-    [sys.executable, "-c", KERNEL_AB_SNIPPET],
-    {},
-    timeout_s=3600,
-    out_path=os.path.join(_REPO, "BENCH_TPU_KERNELS.json"),
-  )
-  return True
+  """Run every stage whose artifact is still missing. A quick-bench
+  failure aborts the pass (the window is dead); later-stage failures
+  keep earlier artifacts and stay eligible for the NEXT healthy window
+  (ADVICE r4: quick-only is a partial revival, not watch-complete)."""
+  log_event(stage="revival-detected", ok=True,
+            missing=[s[0] for s in missing_stages()])
+  for i, (name, cmd, env_extra, timeout_s, artifact) in enumerate(
+    missing_stages()
+  ):
+    if i > 0 and not probe():
+      # the window died mid-pass: abort rather than burning hours of
+      # serial subprocess timeouts against a dead tunnel (a 45s probe
+      # between stages keeps the watcher responsive to the NEXT window)
+      log_event(stage="mid-pass-probe", ok=False, before=name)
+      return False
+    ok = run_stage(
+      name, cmd, env_extra, timeout_s,
+      out_path=os.path.join(_REPO, artifact),
+    )
+    if not ok and name == "bench-quick":
+      return False  # window died before the cheapest stage: re-probe
+  return not missing_stages()
 
 
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument("--interval", type=float, default=600)
   ap.add_argument("--once", action="store_true",
-                  help="probe once and exit (0 = revival handled)")
+                  help="probe once and exit (0 = all artifacts captured)")
   args = ap.parse_args()
   while True:
+    if not missing_stages():
+      log_event(stage="watch-complete", ok=True)
+      return 0
     if probe():
-      handled = on_revival()
-      if handled:
+      if on_revival():
         log_event(stage="watch-complete", ok=True)
         return 0
       if args.once:
-        # probe succeeded but the quick bench did not land: the window
-        # is NOT handled — exit nonzero so supervisors keep watching
+        # probe succeeded but some artifact is still missing: partial
+        # revival — exit nonzero so supervisors keep watching
         return 2
-      # keep watching: the window may have been too short; try again
+      # keep watching: later healthy windows recover the missing stages
     elif args.once:
       log_event(stage="probe", ok=False)
       return 1
