@@ -124,6 +124,10 @@ def encode(
     return encode_jpeg(img, quality=jpeg_quality)
   if encoding == "png":
     return encode_png(img, compress_level=png_level)
+  if encoding == "compresso":
+    from .compresso import compress as compresso_compress
+
+    return compresso_compress(img)
   raise NotImplementedError(f"Encoding not supported: {encoding}")
 
 
@@ -140,4 +144,8 @@ def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8),
     return decode_jpeg(data, shape, dtype)
   if encoding == "png":
     return decode_png(data, shape, dtype)
+  if encoding == "compresso":
+    from .compresso import decompress as compresso_decompress
+
+    return compresso_decompress(data, shape, dtype)
   raise NotImplementedError(f"Encoding not supported: {encoding}")
