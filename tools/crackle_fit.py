@@ -1,0 +1,669 @@
+"""Crackle resume-rule search harness (round 5; see ROADMAP + probe).
+
+Round 4 pinned everything about the .ckl container and move alphabet
+except the '2'/resume micro-rule (tools/crackle_probe.py docstring). This
+harness sweeps parameterized decoder VMs over the open semantic choices
+and scores each candidate against oracles the fixture itself supplies:
+
+  * cc:        region components of the decoded crack field vs the truth
+               the FLAT labels section records per slice;
+  * dangling:  interior vertices with drawn-degree 1 — impossible in any
+               real label-boundary field (degrees are 0/2/3/4);
+  * redraws:   edges drawn twice;
+  * full-stream consumption: the real rule ends cleanly (no symbol count
+               is stored, so the decode must self-terminate).
+
+ROUND-5 RESULTS (1144 variants swept across three VM families):
+
+1. Family A (round 4's reading: '2' always pushes a junction mark; an
+   impossible move pops) — every variant either dies early (cc ~300-550
+   with thousands of unread symbols) or overshoots ~2x. REJECTED.
+2. Family B discovery: '2' push-vs-pop IS decoder-distinguishable by the
+   drawn degree of the current vertex (slice 0, si=162: that '2' lands
+   on a degree-3 loop-closure vertex; all five earlier '2's landed on
+   degree-1 fresh vertices). Best family-B/C variants consume the whole
+   stream with 1-6 dangling and ZERO redraws but plateau at cc ~2x truth
+   with ~truth-many single-pixel spurious regions — the signature of one
+   pinched corner per resume. Resume-without-draw narrows but does not
+   close the gap.
+3. CLOSEST YET — travel/pen-up reading: ONE continuous relative-turn
+   walk (chir=1: 3 = +90), where '2' flags the following move as
+   non-drawing travel ('22' = two moves), off-grid -> next seed. This
+   consumes EVERY symbol on z=0/z=511 and lands cc within 3% of truth
+   (z=0: 1189/1225, z=511: 1196/1237) — by far the closest full-stream
+   decode over four rounds of attempts. Open problems: (a) the decoded
+   field has ~one dangling end per hop (2457 for 2454 hops on z=0), so
+   the true rule must resolve hop geometry differently (613/2454 hop
+   edges do get drawn by other strokes; endpoint degrees are mixed);
+   (b) z=1 exhausts its 8 seeds at symbol 17915/29824 under every
+   family, pointing at un-modeled trail-start bookkeeping (the still
+   unexplained trailing u16 of every seed table: 242/203/228/83/267 for
+   z=0/1/2/3/511).
+
+Usage:
+  python tools/crackle_fit.py sweep [z]       # family A grid
+  python tools/crackle_fit.py sweep2 [z]      # family B grid
+  python tools/crackle_fit.py sweep3 [z...]   # family C grid
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import time
+
+import numpy as np
+from scipy import ndimage
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from crackle_probe import parse_container, parse_slice  # noqa: E402
+
+FIXTURE = "/root/reference/test/connectomics.npy.ckl.gz"
+
+RESUME_MODES = (
+  "auto_abs",      # scan absolute 0..3 for first undrawn (probe's rule)
+  "auto_cw",       # scan md, md+1, ... (from the stored mark direction)
+  "auto_ccw",      # scan md, md-1, ...
+  "auto_cw_rev",   # scan md+2, md+3, ...
+  "auto_ccw_rev",  # scan md+2, md+1, ...
+  "sym_abs",       # triggering symbol = absolute resume direction
+  "sym_rel",       # triggering symbol = turn relative to stored md
+  "sym_rel_rev",   # ... relative to reversed stored md
+  # branch edge drawn FREE (first undrawn by scan), then the triggering
+  # symbol replays as the relative turn AFTER stepping onto the branch —
+  # the economy where every non-'2' symbol draws exactly one edge and
+  # each resume adds one free edge (see round-5 notes in ROADMAP)
+  "autoreplay_abs",
+  "autoreplay_cw",
+  "autoreplay_ccw",
+  "autoreplay_cw_rev",
+  "autoreplay_ccw_rev",
+)
+SEED_MODES = ("abs", "fixed0", "fixed1", "fixed2", "fixed3")
+
+
+def decode_vm(
+  seeds, syms, sx, sy, *,
+  chir=False, trigger_redraw=False, resume_mode="auto_abs",
+  seed_mode="fixed0", pop_order="lifo",
+):
+  """Parameterized crack-walk VM. Returns (vcr, hcr, stats)."""
+  vcr = np.zeros((sx + 1, sy), bool)
+  hcr = np.zeros((sx, sy + 1), bool)
+  marks: list = []
+  stats = {"redraws": 0, "stuck": 0, "seeds_used": 0, "marks_left": 0,
+           "dead_marks": 0}
+
+  def drawn(x, y, d):
+    """True/False = edge drawn state; None = off-grid. Plain bools: the
+    VM compares with ``is``, and np.bool_(False) is not False."""
+    if d == 0:
+      return bool(vcr[x, y - 1]) if y - 1 >= 0 else None
+    if d == 2:
+      return bool(vcr[x, y]) if y <= sy - 1 else None
+    if d == 1:
+      return bool(hcr[x, y]) if x <= sx - 1 else None
+    return bool(hcr[x - 1, y]) if x - 1 >= 0 else None
+
+  def draw(x, y, d):
+    if d == 0:
+      vcr[x, y - 1] = True
+      return x, y - 1
+    if d == 2:
+      vcr[x, y] = True
+      return x, y + 1
+    if d == 1:
+      hcr[x, y] = True
+      return x + 1, y
+    hcr[x - 1, y] = True
+    return x - 1, y
+
+  n = len(syms)
+  si = 0
+  ci = 0
+
+  def next_seed(trigger_sym):
+    """-> (x, y, d) or None when seeds are exhausted."""
+    nonlocal ci, si
+    if ci >= len(seeds):
+      return None
+    x, y = seeds[ci]
+    ci += 1
+    stats["seeds_used"] += 1
+    if seed_mode == "abs":
+      if trigger_sym is not None:
+        d = int(trigger_sym)
+      else:
+        if si >= n:
+          return None
+        d = int(syms[si]); si += 1
+    else:
+      d = int(seed_mode[-1])
+    return x, y, d
+
+  start = next_seed(None)
+  if start is None:
+    return vcr, hcr, stats
+  x, y, d = start
+
+  while si < n:
+    s = int(syms[si]); si += 1
+    if s == 2:
+      marks.append((x, y, d))
+      continue
+    step = s if not chir or s == 0 else 4 - s
+    nd = (d + step) % 4
+    st = drawn(x, y, nd)
+    if st is False or (st is True and not trigger_redraw):
+      if st is True:
+        stats["redraws"] += 1
+      d = nd
+      x, y = draw(x, y, nd)
+      continue
+    # impossible move: control event — pop marks / advance seeds
+    resumed = False
+    while marks:
+      mx, my, md = marks.pop(-1 if pop_order == "lifo" else 0)
+      if resume_mode.startswith(("auto_", "autoreplay_")):
+        parts = resume_mode.split("_")
+        base, rev = parts[1], parts[-1] == "rev"
+        if base == "abs":
+          scan = (0, 1, 2, 3)
+        elif base == "cw":
+          scan = tuple((md + 2 * rev + k) % 4 for k in range(4))
+        else:  # ccw
+          scan = tuple((md + 2 * rev - k) % 4 for k in range(4))
+        rd = next((dd for dd in scan if drawn(mx, my, dd) is False), None)
+      else:
+        if resume_mode == "sym_abs":
+          rd = s
+        elif resume_mode == "sym_rel":
+          rd = (md + step) % 4
+        else:  # sym_rel_rev
+          rd = (md + 2 + step) % 4
+        if drawn(mx, my, rd) is not False:
+          rd = None
+      if rd is None:
+        stats["dead_marks"] += 1
+        continue
+      d = rd
+      x, y = draw(mx, my, rd)
+      resumed = True
+      if resume_mode.startswith("autoreplay_"):
+        # the branch edge was free; the triggering symbol now replays
+        # as the relative turn from the new position/direction
+        nd = (d + step) % 4
+        st = drawn(x, y, nd)
+        if st is False:
+          d = nd
+          x, y = draw(x, y, nd)
+        elif st is True and not trigger_redraw:
+          stats["redraws"] += 1
+          d = nd
+          x, y = draw(x, y, nd)
+        else:
+          # replay itself impossible: treat as a fresh control event
+          # on the next loop round by pushing the state back — simplest
+          # faithful behavior is to count it; rare under a correct rule
+          stats["replay_failed"] = stats.get("replay_failed", 0) + 1
+      break
+    if resumed:
+      continue
+    nxt = next_seed(s)
+    if nxt is None:
+      stats["stuck"] += 1
+      break
+    x, y, d = nxt
+  stats["marks_left"] = len(marks)
+  return vcr, hcr, stats
+
+
+def decode_vm2(
+  seeds, syms, sx, sy, *,
+  chir=False, d0=1, pop_style="peek", resume_dir="auto_cw",
+  impossible_resumes=True, pop_order="lifo",
+):
+  """Round-5 family B: '2' is push or pop depending on the DRAWN degree
+  of the current vertex — decoder-detectable (arrival edge only = fresh
+  junction, push; degree >=3 = loop closure, trail ends, resume).
+  Evidence: slice 0 si=162's '2' lands on a degree-3 closure vertex while
+  all prior '2's landed on degree-1 fresh vertices."""
+  vcr = np.zeros((sx + 1, sy), bool)
+  hcr = np.zeros((sx, sy + 1), bool)
+  deg = np.zeros((sx + 1, sy + 1), np.int16)
+  marks: list = []
+  stats = {"pushes": 0, "pops": 0, "impossible": 0, "dead_marks": 0,
+           "stuck": 0, "seeds_used": 0, "marks_left": 0, "redraws": 0}
+
+  def drawn(x, y, d):
+    if d == 0:
+      return bool(vcr[x, y - 1]) if y - 1 >= 0 else None
+    if d == 2:
+      return bool(vcr[x, y]) if y <= sy - 1 else None
+    if d == 1:
+      return bool(hcr[x, y]) if x <= sx - 1 else None
+    return bool(hcr[x - 1, y]) if x - 1 >= 0 else None
+
+  def draw(x, y, d):
+    # degree counts FIRST draws only, so redraw-permitting variants
+    # can't inflate (or overflow) the push-vs-pop classification
+    fresh = drawn(x, y, d) is False
+    if fresh:
+      deg[x, y] += 1
+    if d == 0:
+      vcr[x, y - 1] = True
+      nx, ny = x, y - 1
+    elif d == 2:
+      vcr[x, y] = True
+      nx, ny = x, y + 1
+    elif d == 1:
+      hcr[x, y] = True
+      nx, ny = x + 1, y
+    else:
+      hcr[x - 1, y] = True
+      nx, ny = x - 1, y
+    if fresh:
+      deg[nx, ny] += 1
+    return nx, ny
+
+  n = len(syms)
+  si = 0
+  ci = 0
+
+  def resume():
+    """-> (x, y, d) from the mark stack, or None."""
+    nonlocal si
+    parts = resume_dir.split("_")
+    s2 = None  # nextsym modes consume ONE symbol, reused across marks
+    while marks:
+      idx = len(marks) - 1 if pop_order == "lifo" else 0
+      mx, my, md = marks[idx]
+      if parts[0] == "auto":
+        base, rev = parts[1], parts[-1] == "rev"
+        if base == "abs":
+          scan = (0, 1, 2, 3)
+        elif base == "cw":
+          scan = tuple((md + 2 * rev + k) % 4 for k in range(4))
+        else:
+          scan = tuple((md + 2 * rev - k) % 4 for k in range(4))
+        rd = next((dd for dd in scan if drawn(mx, my, dd) is False), None)
+        if rd is None:
+          del marks[idx]
+          stats["dead_marks"] += 1
+          continue
+        if pop_style == "pop":
+          del marks[idx]
+        return mx, my, rd
+      if s2 is None:
+        if si >= n:
+          return None
+        s2 = int(syms[si]); si += 1
+      if parts[1] == "abs":
+        rd = s2
+      else:
+        st2 = s2 if not chir or s2 == 0 else 4 - s2
+        rd = (md + st2 + (2 if parts[-1] == "rev" else 0)) % 4
+      if drawn(mx, my, rd) is not False:
+        del marks[idx]
+        stats["dead_marks"] += 1
+        continue
+      if pop_style == "pop":
+        del marks[idx]
+      return mx, my, rd
+    return None
+
+  x, y = seeds[ci]
+  ci += 1
+  stats["seeds_used"] += 1
+  d = d0
+
+  while si < n:
+    s = int(syms[si]); si += 1
+    if s == 2:
+      # degree counts only drawn edges; at arrival a fresh vertex has 1
+      if deg[x, y] <= 1:
+        marks.append((x, y, d))
+        stats["pushes"] += 1
+        continue
+      stats["pops"] += 1
+      r = resume()
+      if r is None:
+        if ci < len(seeds):
+          x, y = seeds[ci]
+          ci += 1
+          stats["seeds_used"] += 1
+          d = d0
+        else:
+          stats["stuck"] += 1
+          break
+      else:
+        x, y, d = r
+        x, y = draw(x, y, d)
+      continue
+    step = s if not chir or s == 0 else 4 - s
+    nd = (d + step) % 4
+    st = drawn(x, y, nd)
+    if st is False:
+      d = nd
+      x, y = draw(x, y, nd)
+      continue
+    if not impossible_resumes:
+      if st is True:
+        stats["redraws"] += 1
+        d = nd
+        x, y = draw(x, y, nd)
+        continue
+    stats["impossible"] += 1
+    r = resume()
+    if r is None:
+      if ci < len(seeds):
+        x, y = seeds[ci]
+        ci += 1
+        stats["seeds_used"] += 1
+        d = d0
+      else:
+        stats["stuck"] += 1
+        break
+    else:
+      x, y, d = r
+      x, y = draw(x, y, d)
+  stats["marks_left"] = len(marks)
+  return vcr, hcr, stats
+
+
+def decode_vm3(
+  seeds, syms, sx, sy, *,
+  chir=True, d0=0, resume_dir="auto_ccw", impossible_resumes=True,
+  require_mark=True, draw_on_resume=True,
+):
+  """Round-5 family C: path-backtracking (round 4's 65% family) refined.
+  '2' at a fresh vertex pushes a junction mark; a control event ('2' at a
+  closure vertex, or an impossible move) BACKTRACKS along the walked path
+  to the most recent vertex that (require_mark) is marked and has an
+  undrawn in-grid direction, resuming there."""
+  vcr = np.zeros((sx + 1, sy), bool)
+  hcr = np.zeros((sx, sy + 1), bool)
+  deg = np.zeros((sx + 1, sy + 1), np.int16)
+  marked = set()
+  path: list = []
+  stats = {"pushes": 0, "pops": 0, "impossible": 0, "stuck": 0,
+           "seeds_used": 0, "redraws": 0, "syms_left": 0}
+
+  def drawn(x, y, d):
+    if d == 0:
+      return bool(vcr[x, y - 1]) if y - 1 >= 0 else None
+    if d == 2:
+      return bool(vcr[x, y]) if y <= sy - 1 else None
+    if d == 1:
+      return bool(hcr[x, y]) if x <= sx - 1 else None
+    return bool(hcr[x - 1, y]) if x - 1 >= 0 else None
+
+  def draw(x, y, d):
+    # degree counts FIRST draws only, so redraw-permitting variants
+    # can't inflate (or overflow) the push-vs-pop classification
+    fresh = drawn(x, y, d) is False
+    if fresh:
+      deg[x, y] += 1
+    if d == 0:
+      vcr[x, y - 1] = True
+      nx, ny = x, y - 1
+    elif d == 2:
+      vcr[x, y] = True
+      nx, ny = x, y + 1
+    elif d == 1:
+      hcr[x, y] = True
+      nx, ny = x + 1, y
+    else:
+      hcr[x - 1, y] = True
+      nx, ny = x - 1, y
+    if fresh:
+      deg[nx, ny] += 1
+    return nx, ny
+
+  def scan_dir(mx, my, md):
+    parts = resume_dir.split("_")
+    base, rev = parts[1], parts[-1] == "rev"
+    if base == "abs":
+      scan = (0, 1, 2, 3)
+    elif base == "cw":
+      scan = tuple((md + 2 * rev + k) % 4 for k in range(4))
+    else:
+      scan = tuple((md + 2 * rev - k) % 4 for k in range(4))
+    return next((dd for dd in scan if drawn(mx, my, dd) is False), None)
+
+  def backtrack():
+    """-> (x, y, rd) or None; walks path backwards."""
+    while path:
+      px, py, pd = path[-1]
+      eligible = (not require_mark) or ((px, py) in marked)
+      if eligible:
+        rd = scan_dir(px, py, pd)
+        if rd is not None:
+          return px, py, rd
+      path.pop()
+    return None
+
+  n = len(syms)
+  si = 0
+  ci = 0
+  x, y = seeds[ci]
+  ci += 1
+  stats["seeds_used"] += 1
+  d = d0
+  path.append((x, y, d))
+
+  while si < n:
+    s = int(syms[si]); si += 1
+    if s == 2:
+      if deg[x, y] <= 1:
+        marked.add((x, y))
+        stats["pushes"] += 1
+        continue
+      stats["pops"] += 1
+      r = backtrack()
+      if r is None:
+        if ci < len(seeds):
+          x, y = seeds[ci]; ci += 1
+          stats["seeds_used"] += 1
+          d = d0
+          path.append((x, y, d))
+        else:
+          stats["stuck"] += 1
+          break
+      else:
+        mx, my, rd = r
+        d = rd
+        if draw_on_resume:
+          x, y = draw(mx, my, rd)
+        else:
+          x, y = mx, my
+        path.append((x, y, d))
+      continue
+    step = s if not chir or s == 0 else 4 - s
+    nd = (d + step) % 4
+    st = drawn(x, y, nd)
+    if st is False:
+      d = nd
+      x, y = draw(x, y, nd)
+      path.append((x, y, d))
+      continue
+    if not impossible_resumes and st is True:
+      stats["redraws"] += 1
+      d = nd
+      x, y = draw(x, y, nd)
+      path.append((x, y, d))
+      continue
+    stats["impossible"] += 1
+    r = backtrack()
+    if r is None:
+      if ci < len(seeds):
+        x, y = seeds[ci]; ci += 1
+        stats["seeds_used"] += 1
+        d = d0
+        path.append((x, y, d))
+      else:
+        stats["stuck"] += 1
+        break
+    else:
+      mx, my, rd = r
+      d = rd
+      if draw_on_resume:
+        x, y = draw(mx, my, rd)
+      else:
+        x, y = mx, my
+      path.append((x, y, d))
+  stats["syms_left"] = n - si
+  return vcr, hcr, stats
+
+
+# -- oracles -----------------------------------------------------------------
+
+
+def region_components(vcr, hcr, sx, sy):
+  """Pixel components of the crack field + the label array (scan-order
+  component ids, scipy numbering) — expanded-grid trick, one C pass."""
+  grid = np.zeros((2 * sx + 1, 2 * sy + 1), bool)
+  grid[1::2, 1::2] = True
+  grid[2:-1:2, 1::2] = ~vcr[1:sx, :]
+  grid[1::2, 2:-1:2] = ~hcr[:, 1:sy]
+  st = np.array([[0, 1, 0], [1, 1, 1], [0, 1, 0]], bool)
+  lab, n = ndimage.label(grid, structure=st)
+  return lab[1::2, 1::2], n
+
+
+def dangling_interior(vcr, hcr, sx, sy):
+  """Interior vertices with exactly one drawn crack — impossible in a
+  real boundary field."""
+  deg = np.zeros((sx + 1, sy + 1), np.int16)
+  deg[:, 1:] += vcr          # up edge of vertex (x,y) is vcr[x, y-1]
+  deg[:, :-1] += vcr         # down edge
+  deg[1:, :] += hcr          # left edge
+  deg[:-1, :] += hcr         # right edge
+  inner = deg[1:sx, 1:sy]
+  return int((inner == 1).sum())
+
+
+def score_slice(c, z, params):
+  sx, sy, _ = c["shape"]
+  seeds, _trail, syms = parse_slice(c, z)
+  vcr, hcr, stats = decode_vm(seeds, syms, sx, sy, **params)
+  _lab, n = region_components(vcr, hcr, sx, sy)
+  truth = int(c["cc_per_slice"][z])
+  dang = dangling_interior(vcr, hcr, sx, sy)
+  return {
+    "cc": n, "truth": truth, "dcc": abs(n - truth), "dangling": dang,
+    **stats,
+  }
+
+
+def sweep(c, z=0):
+  rows = []
+  t0 = time.time()
+  for chir, trig, rmode, smode, porder in itertools.product(
+    (False, True), (False, True), RESUME_MODES, SEED_MODES,
+    ("lifo", "fifo"),
+  ):
+    params = dict(chir=chir, trigger_redraw=trig, resume_mode=rmode,
+                  seed_mode=smode, pop_order=porder)
+    r = score_slice(c, z, params)
+    rows.append((r["dcc"], r["dangling"], r["redraws"], params, r))
+  rows.sort(key=lambda t: (t[0], t[1], t[2]))
+  print(f"sweep z={z}: {len(rows)} combos in {time.time()-t0:.1f}s")
+  for dcc, dang, redraws, params, r in rows[:15]:
+    pp = (f"chir={int(params['chir'])} trig_redraw="
+          f"{int(params['trigger_redraw'])} {params['resume_mode']}/"
+          f"{params['seed_mode']}/{params['pop_order']}")
+    print(f"  dcc={dcc:5d} dang={dang:5d} redraw={redraws:6d} "
+          f"cc={r['cc']:5d}/{r['truth']} stuck={r['stuck']} "
+          f"marks_left={r['marks_left']} dead={r['dead_marks']} {pp}")
+  return rows
+
+
+def score_slice2(c, z, params):
+  sx, sy, _ = c["shape"]
+  seeds, _trail, syms = parse_slice(c, z)
+  vcr, hcr, stats = decode_vm2(seeds, syms, sx, sy, **params)
+  _lab, n = region_components(vcr, hcr, sx, sy)
+  truth = int(c["cc_per_slice"][z])
+  dang = dangling_interior(vcr, hcr, sx, sy)
+  return {"cc": n, "truth": truth, "dcc": abs(n - truth),
+          "dangling": dang, **stats}
+
+
+def sweep2(c, z=0):
+  rows = []
+  t0 = time.time()
+  combos = itertools.product(
+    ((0, 1), (0, 2), (0, 3), (1, 0), (1, 1), (1, 3)),  # viable (chir, d0)
+    ("peek", "pop"),
+    ("auto_abs", "auto_cw", "auto_ccw", "auto_cw_rev", "auto_ccw_rev",
+     "nextsym_abs", "nextsym_rel", "nextsym_rel_rev"),
+    (True, False),
+    ("lifo", "fifo"),
+  )
+  for (chir, d0), pstyle, rdir, impres, porder in combos:
+    params = dict(chir=bool(chir), d0=d0, pop_style=pstyle,
+                  resume_dir=rdir, impossible_resumes=impres,
+                  pop_order=porder)
+    r = score_slice2(c, z, params)
+    rows.append((r["dcc"], r["dangling"], r["redraws"], params, r))
+  rows.sort(key=lambda t: (t[0], t[1], t[2]))
+  print(f"sweep2 z={z}: {len(rows)} combos in {time.time()-t0:.1f}s")
+  for dcc, dang, redraws, params, r in rows[:15]:
+    pp = (f"chir={int(params['chir'])} d0={params['d0']} "
+          f"{params['pop_style']}/{params['resume_dir']}/"
+          f"imp={int(params['impossible_resumes'])}/{params['pop_order']}")
+    print(f"  dcc={dcc:5d} dang={dang:5d} redraw={redraws:6d} "
+          f"cc={r['cc']:5d}/{r['truth']} push={r['pushes']} "
+          f"pop={r['pops']} imp={r['impossible']} dead={r['dead_marks']} "
+          f"left={r['marks_left']} stuck={r['stuck']} {pp}")
+  return rows
+
+
+if __name__ == "__main__":
+  with open(FIXTURE, "rb") as f:
+    c = parse_container(f.read())
+  mode = sys.argv[1] if len(sys.argv) > 1 else "sweep"
+  if mode == "sweep":
+    z = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    sweep(c, z)
+  elif mode == "sweep2":
+    z = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    sweep2(c, z)
+  elif mode == "sweep3":
+    zs = [int(v) for v in sys.argv[2:]] or [0, 1]
+    rows = []
+    t0 = time.time()
+    for (chir, d0), rdir, impres, reqm, dor in itertools.product(
+      ((0, 1), (0, 2), (0, 3), (1, 0), (1, 1), (1, 3)),
+      ("auto_abs", "auto_cw", "auto_ccw", "auto_cw_rev", "auto_ccw_rev"),
+      (True, False), (True, False), (True, False),
+    ):
+      params = dict(chir=bool(chir), d0=d0, resume_dir=rdir,
+                    impossible_resumes=impres, require_mark=reqm,
+                    draw_on_resume=dor)
+      tot_dcc = tot_dang = tot_red = tot_left = 0
+      per = []
+      for z in zs:
+        sx, sy, _ = c["shape"]
+        seeds, _t, syms = parse_slice(c, z)
+        vcr, hcr, st = decode_vm3(seeds, syms, sx, sy, **params)
+        _l, n = region_components(vcr, hcr, sx, sy)
+        truth = int(c["cc_per_slice"][z])
+        dang = dangling_interior(vcr, hcr, sx, sy)
+        tot_dcc += abs(n - truth)
+        tot_dang += dang
+        tot_red += st["redraws"]
+        tot_left += st["syms_left"]
+        per.append(f"{n}/{truth}")
+      rows.append((tot_dcc, tot_dang, tot_left, params, per))
+    rows.sort(key=lambda t: (t[0] + 10 * t[1] + t[2],))
+    print(f"sweep3 zs={zs}: {len(rows)} combos in {time.time()-t0:.1f}s")
+    for dcc, dang, left, params, per in rows[:12]:
+      pp = (f"chir={int(params['chir'])} d0={params['d0']} "
+            f"{params['resume_dir']}/imp={int(params['impossible_resumes'])}"
+            f"/mark={int(params['require_mark'])}"
+            f"/dor={int(params['draw_on_resume'])}")
+      print(f"  dcc={dcc:5d} dang={dang:4d} syms_left={left:6d} "
+            f"cc={per} {pp}")
