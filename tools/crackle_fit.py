@@ -40,6 +40,23 @@ ROUND-5 RESULTS (1144 variants swept across three VM families):
    unexplained trailing u16 of every seed table: 242/203/228/83/267 for
    z=0/1/2/3/511).
 
+4. SECOND-TIMEBOX ADDENDUM (border-slide): every off-grid attempt in the
+   pen-up decode happens exactly AT a border wanting to continue OUT.
+   Adding border-slide (an off-grid move turns +-90 to continue along
+   the border) makes EVERY tested slice consume its whole stream with
+   cc within 1-4% of truth (z=1: 1251/1240, z=256: 1399/1405, z=511:
+   1213/1237) while using almost NO seeds (z=1: 1 of 8) — so the
+   reference decoder's trail bookkeeping is essentially "one continuous
+   walk + border sliding", and the seed table's role remains open
+   (trailing u16 is uniform in [0,512] — a coordinate, uncorrelated
+   with every per-slice count tested; appending it as an extra seed
+   changes nothing). Still open and now sharply posed: (a) the
+   ~one-dangling-end-per-hop geometry (true fields have none, so '2'
+   cannot be literal pen-up; hop edges drawn by other strokes: only
+   613/2454), and (b) best (chir, d0, slide-handedness) still varies
+   per slice, so the orientation convention is per-seed/per-situation,
+   not global.
+
 Usage:
   python tools/crackle_fit.py sweep [z]       # family A grid
   python tools/crackle_fit.py sweep2 [z]      # family B grid
