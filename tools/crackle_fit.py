@@ -57,6 +57,18 @@ ROUND-5 RESULTS (1144 variants swept across three VM families):
    per slice, so the orientation convention is per-seed/per-situation,
    not global.
 
+5. FINAL round-5 experiments pinned the contradiction precisely. Hop
+   windows show '2'-flagged edges bridging ordinary staircase steps —
+   visibly REAL boundary edges. Three decode variants triangulate:
+   pen-up (skip hop edges): cc within 3% of truth, one dangling end per
+   hop; draw-everything: dangling ~10 (geometry closes!) but cc +40%;
+   pen-up + draw-only-dangling-adjacent-hops: dangling -> 0-1 but cc
+   ~ +60%. No subset of hop edges can satisfy closure AND counts
+   simultaneously => the NON-flagged move geometry must also be wrong
+   in a compensating way (e.g. '2' shifts which side of the walk the
+   crack is drawn on, or moves carry a sub-voxel offset). That is the
+   round-6 entry point.
+
 Usage:
   python tools/crackle_fit.py sweep [z]       # family A grid
   python tools/crackle_fit.py sweep2 [z]      # family B grid
