@@ -144,6 +144,24 @@ def dijkstra_lib() -> Optional[ctypes.CDLL]:
   return lib
 
 
+def fggraph_lib() -> Optional[ctypes.CDLL]:
+  lib = load("fggraph")
+  if lib is None:
+    return None
+  if not getattr(lib, "_configured", False):
+    lib.ig_fggraph.restype = ctypes.c_int64
+    lib.ig_fggraph.argtypes = [
+      ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+      ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_int64,
+      ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+      ctypes.c_int32,
+    ]
+    lib._configured = True
+  return lib
+
+
 def cseg_lib() -> Optional[ctypes.CDLL]:
   lib = load("cseg")
   if lib is None:

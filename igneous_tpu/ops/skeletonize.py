@@ -81,6 +81,85 @@ class TeasarParams:
     return cls(**{k: v for k, v in d.items() if k in cls.KNOWN})
 
 
+def _positive_deltas():
+  """The 13 positive-lex neighbor deltas with their voxel_graph bits:
+  [((dx, dy, dz), bit), ...]."""
+  from .ccl import graph_bit  # local import: ccl pulls in jax
+
+  out = []
+  for dx in (-1, 0, 1):
+    for dy in (-1, 0, 1):
+      for dz in (-1, 0, 1):
+        if (dx, dy, dz) <= (0, 0, 0):
+          continue
+        out.append(((dx, dy, dz), graph_bit((dx, dy, dz))))
+  return out
+
+
+def _foreground_graph_native(mask, pdrf, anisotropy, voxel_graph):
+  """Direct symmetric-CSR build in C++ (native/csrc/fggraph.cpp); None
+  when the toolchain is unavailable (caller falls back to numpy)."""
+  import ctypes
+
+  from ..native import fggraph_lib
+
+  lib = fggraph_lib()
+  if lib is None:
+    return None
+  idx = np.full(mask.size, -1, dtype=np.int64)
+  fg = np.flatnonzero(mask.reshape(-1))
+  idx[fg] = np.arange(len(fg))
+  n = len(fg)
+  w = np.asarray(anisotropy, dtype=np.float64)
+  pairs = _positive_deltas()
+  deltas = np.ascontiguousarray(
+    [d for d, _b in pairs], dtype=np.int8
+  ).reshape(-1)
+  lens = np.ascontiguousarray(
+    [float(np.linalg.norm(w * np.asarray(d))) for d, _b in pairs],
+    dtype=np.float64,
+  )
+  bits = np.ascontiguousarray([b for _d, b in pairs], dtype=np.int32)
+  pdrf_c = np.ascontiguousarray(pdrf, dtype=np.float32)
+  vg = (
+    None if voxel_graph is None
+    else np.ascontiguousarray(voxel_graph, dtype=np.uint32)
+  )
+  indptr = np.zeros(n + 1, dtype=np.int64)
+
+  def call(indices, weights, fill):
+    return lib.ig_fggraph(
+      mask.shape[0], mask.shape[1], mask.shape[2],
+      idx.ctypes.data_as(ctypes.c_void_p),
+      pdrf_c.ctypes.data_as(ctypes.c_void_p),
+      None if vg is None else vg.ctypes.data_as(ctypes.c_void_p),
+      deltas.ctypes.data_as(ctypes.c_void_p),
+      lens.ctypes.data_as(ctypes.c_void_p),
+      bits.ctypes.data_as(ctypes.c_void_p),
+      n,
+      indptr.ctypes.data_as(ctypes.c_void_p),
+      None if indices is None else indices.ctypes.data_as(ctypes.c_void_p),
+      None if weights is None else weights.ctypes.data_as(ctypes.c_void_p),
+      fill,
+    )
+
+  nnz = call(None, None, 0)
+  if nnz == 0:
+    return None, fg
+  indices = np.empty(nnz, dtype=np.int32)
+  weights = np.empty(nnz, dtype=np.float64)
+  call(indices, weights, 1)
+  from scipy.sparse import csr_matrix
+
+  g = csr_matrix((weights, indices, indptr), shape=(n, n))
+  # canonical sorted rows: the numpy builder's `csr + csr.T` emits
+  # sorted columns, and dijkstra's equal-distance tie-breaking follows
+  # storage order — unsorted rows would change which (equally valid)
+  # predecessor tree wins and break batched-vs-solo byte identity
+  g.sort_indices()
+  return g, fg
+
+
 def _foreground_graph(
   mask: np.ndarray, pdrf: np.ndarray, anisotropy, voxel_graph=None
 ):
@@ -89,11 +168,14 @@ def _foreground_graph(
   bitfields from ops.ccl.voxel_connectivity_graph) removes edges whose
   direction bit is unset at the source voxel — the movement constraint
   kimimaro applies for the graphene autapse fix (reference
-  tasks/skeleton.py:368-377)."""
+  tasks/skeleton.py:368-377). Built natively when the toolchain exists
+  (identical output; ~20% of forge wall in the numpy form)."""
+  native = _foreground_graph_native(mask, pdrf, anisotropy, voxel_graph)
+  if native is not None:
+    return native
   idx = np.full(mask.shape, -1, dtype=np.int64)
   fg = np.flatnonzero(mask.reshape(-1))
   idx.reshape(-1)[fg] = np.arange(len(fg))
-  w = np.asarray(anisotropy, dtype=np.float32)
   if voxel_graph is not None:
     from .ccl import graph_bit  # local import: ccl pulls in jax
 
@@ -119,8 +201,14 @@ def _foreground_graph(
           continue
         a_idx = idx[src][both]
         b_idx = idx[dst][both]
-        step = float(np.linalg.norm(w * np.asarray((dx, dy, dz))))
-        cost = (pdrf[src][both] + pdrf[dst][both]) * 0.5 * step
+        step = float(np.linalg.norm(
+          np.asarray(anisotropy, np.float64) * np.asarray((dx, dy, dz))
+        ))
+        # float64 like the native builder: both paths must agree bitwise
+        cost = (
+          (pdrf[src][both] + pdrf[dst][both]).astype(np.float64)
+          * 0.5 * step
+        )
         rows.append(a_idx)
         cols.append(b_idx)
         vals.append(cost)

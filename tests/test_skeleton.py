@@ -804,3 +804,43 @@ def test_unsharded_merge_crop(tmp_path):
   x = sk.vertices[:, 0]
   assert not ((x > 31.01) & (x < 32.99)).any()
   assert (x == 31.0).any() and (x == 33.0).any()  # crop keeps the edges
+
+
+def test_native_foreground_graph_matches_numpy(rng):
+  """The C++ CSR builder (native/csrc/fggraph.cpp) must be bit-identical
+  to the numpy builder — indptr, indices, and float64 weights — with and
+  without a voxel_graph movement constraint."""
+  import igneous_tpu.ops.skeletonize as sk
+  from igneous_tpu.ops.ccl import graph_bit
+
+  mask = np.zeros((40, 36, 28), bool)
+  g = np.indices(mask.shape).astype(np.float32)
+  mask[((g[0] - 20) ** 2 + (g[1] - 18) ** 2 + (g[2] - 14) ** 2) < 144] = True
+  mask[5:9, 5:9, 5:25] = True  # a tube touching the blob
+  dt = np.where(mask, rng.random(mask.shape).astype(np.float32) * 100 + 1, 0)
+  pdrf = (1e5 * (1.0 - dt / (1.05 * dt.max())) ** 16).astype(np.float32)
+  pdrf += np.float32(1e-5)
+  pdrf[~mask] = np.inf
+  anis = (16.0, 16.0, 40.0)
+
+  vg = np.full(mask.shape, 0xFFFFFFFF, np.uint32)
+  vg[10:20, 10:20, 10:20] &= ~np.uint32(1 << graph_bit((1, 0, 0)))
+
+  native = sk._foreground_graph_native
+  if native(np.ascontiguousarray(mask), pdrf, anis, None) is None:
+    pytest.skip("native toolchain unavailable")
+  for voxel_graph in (None, vg):
+    gn, fgn = native(np.ascontiguousarray(mask), pdrf, anis, voxel_graph)
+    sk._foreground_graph_native = lambda *a, **k: None
+    try:
+      gp, fgp = sk._foreground_graph(mask, pdrf, anis, voxel_graph)
+    finally:
+      sk._foreground_graph_native = native
+    assert np.array_equal(fgn, fgp)
+    a = gn.copy()
+    a.sort_indices()
+    b = gp.tocsr()
+    b.sort_indices()
+    assert np.array_equal(a.indptr, b.indptr)
+    assert np.array_equal(a.indices, b.indices)
+    assert np.array_equal(a.data, b.data)
