@@ -1,0 +1,101 @@
+"""tpu_watch revival protocol (VERDICT r4 #3 + ADVICE r4): artifact-
+presence drives per-stage completion; partial revivals keep watching."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import tpu_watch  # noqa: E402
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+  monkeypatch.setattr(tpu_watch, "_REPO", str(tmp_path))
+  monkeypatch.setattr(tpu_watch, "LOG", str(tmp_path / "log.jsonl"))
+  return tmp_path
+
+
+def test_missing_stages_tracks_artifacts(repo):
+  names = [s[0] for s in tpu_watch.missing_stages()]
+  assert names == ["bench-quick", "bench-full", "bench-kernels",
+                   "bench-batch"]
+  (repo / "BENCH_TPU_QUICK.json").write_text("{}")
+  (repo / "BENCH_TPU_KERNELS.json").write_text("{}")
+  names = [s[0] for s in tpu_watch.missing_stages()]
+  assert names == ["bench-full", "bench-batch"]
+
+
+def test_on_revival_partial_keeps_missing_stages(repo, monkeypatch):
+  """Quick bench lands, full bench fails: on_revival reports incomplete
+  and the next window retries ONLY the missing stages."""
+  ran = []
+
+  def fake_run_stage(name, cmd, env, timeout_s, out_path=None):
+    ran.append(name)
+    ok = name in ("bench-quick", "bench-kernels")
+    if ok and out_path:
+      with open(out_path, "w") as f:
+        json.dump({"value": 1}, f)
+    return ok
+
+  monkeypatch.setattr(tpu_watch, "run_stage", fake_run_stage)
+  monkeypatch.setattr(tpu_watch, "probe", lambda *a, **k: True)
+  assert tpu_watch.on_revival() is False  # full+batch still missing
+  assert ran == ["bench-quick", "bench-full", "bench-kernels",
+                 "bench-batch"]
+  ran.clear()
+  # second window: only the missing stages run; all land -> complete
+  def all_ok(name, cmd, env, timeout_s, out_path=None):
+    ran.append(name)
+    if out_path:
+      with open(out_path, "w") as f:
+        json.dump({"value": 1}, f)
+    return True
+
+  monkeypatch.setattr(tpu_watch, "run_stage", all_ok)
+  assert tpu_watch.on_revival() is True
+  assert ran == ["bench-full", "bench-batch"]
+  assert not tpu_watch.missing_stages()
+
+
+def test_on_revival_aborts_pass_when_window_dies(repo, monkeypatch):
+  (repo / "BENCH_TPU_QUICK.json").write_text("{}")
+  ran = []
+  monkeypatch.setattr(
+    tpu_watch, "run_stage",
+    lambda name, *a, **k: ran.append(name) or True,
+  )
+  monkeypatch.setattr(tpu_watch, "probe", lambda *a, **k: False)
+  assert tpu_watch.on_revival() is False
+  assert ran == ["bench-full"]  # mid-pass probe stopped the rest
+
+
+def test_quick_bench_failure_aborts_immediately(repo, monkeypatch):
+  ran = []
+  monkeypatch.setattr(
+    tpu_watch, "run_stage",
+    lambda name, *a, **k: ran.append(name) and False,
+  )
+  assert tpu_watch.on_revival() is False
+  assert ran == ["bench-quick"]
+
+
+def test_run_stage_requires_json_artifact(repo, monkeypatch):
+  """rc-0 child with no JSON line = failure (no artifact, stage retries
+  next window instead of wedging the completion contract)."""
+  class P:
+    returncode = 0
+    stdout = "no json here\n"
+    stderr = ""
+
+  monkeypatch.setattr(tpu_watch.subprocess, "run", lambda *a, **k: P())
+  out = repo / "X.json"
+  ok = tpu_watch.run_stage("s", ["true"], {}, 5, out_path=str(out))
+  assert ok is False and not out.exists()
+
+  P.stdout = 'ignored\n{"value": 7, "detail": {"platform": "tpu"}}\n'
+  ok = tpu_watch.run_stage("s", ["true"], {}, 5, out_path=str(out))
+  assert ok is True and json.loads(out.read_text())["value"] == 7
