@@ -1,0 +1,213 @@
+"""Deterministic fault injection for storage and queues (ISSUE 1 §3).
+
+Every containment behavior in this codebase — lease recycling, delivery
+counting, DLQ promotion, retry/backoff, idempotent re-execution — exists
+because real object stores and queues fail. This module makes those
+failures *reproducible*: seeded, deterministic wrappers that inject
+
+  * failed puts (transient 503s, or a hard mid-upload crash that leaves
+    partial output behind — the "worker died between compute and upload"
+    scenario),
+  * corrupted gets (bit-flipped payloads; gzip CRCs turn these into loud
+    task failures rather than silent bad voxels),
+  * 503 storms on any operation,
+  * lease-delete delays/drops (a completed task whose ack never landed
+    redelivers — at-least-once's canonical duplicate),
+  * permanent faults on selected keys (poison tasks that must end in the
+    DLQ, not in an infinite retry loop).
+
+Determinism: each decision hashes ``(seed, op, key, occurrence)`` — not
+wall clock, not shared RNG state — so a fault schedule replays exactly
+per key regardless of thread interleaving, and ``--seed N`` in
+tools/chaos_soak.py names a reproducible storm.
+
+Usage:
+
+  cfg = ChaosConfig(seed=7, put_fail=0.2, get_corrupt=0.1)
+  with chaos_storage(cfg):        # wraps every backend CloudFiles builds
+    ... run pipeline ...
+
+  q = ChaosQueue(FileQueue(...), cfg)   # queue-side faults
+
+Transient faults stop after ``max_faults_per_key`` occurrences per
+(op, key), so a pipeline under chaos always converges; ``permanent``
+marks key substrings that fail forever (DLQ fodder).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import telemetry
+from .storage_http import HttpError
+
+
+class ChaosWorkerCrash(Exception):
+  """Simulated process death mid-operation (no retry layer may absorb
+  this — the queue's at-least-once redelivery is the only recovery)."""
+
+
+@dataclass
+class ChaosConfig:
+  """Fault rates are probabilities in [0, 1] evaluated per operation.
+
+  seed: names the deterministic schedule; same seed → same faults.
+  put_fail: transient 503 on put (the storage retry story's bread/butter).
+  get_corrupt: bit-flip a get()'s payload (transient).
+  storm: transient 503 on ANY operation (get/put/list/exists/size/delete).
+  crash_put: hard ChaosWorkerCrash on put — compute done, upload partial,
+    worker gone. Not retryable in place; only redelivery recovers.
+  drop_delete: queue.delete silently dropped (ack lost; task redelivers
+    after its lease expires even though its work completed).
+  max_faults_per_key: transient faults per (op, key) before that seam
+    heals — guarantees convergence.
+  permanent: substring; keys containing it fail every time (poison).
+  """
+
+  seed: int = 0
+  put_fail: float = 0.0
+  get_corrupt: float = 0.0
+  storm: float = 0.0
+  crash_put: float = 0.0
+  drop_delete: float = 0.0
+  max_faults_per_key: int = 2
+  permanent: str = ""
+  # occurrence counters, keyed (op, key) — instance state so two configs
+  # never share schedules
+  _counts: dict = field(default_factory=dict, repr=False)
+  _faults: dict = field(default_factory=dict, repr=False)
+
+  def roll(self, op: str, key: str) -> float:
+    """Deterministic uniform [0,1) draw for this (op, key) occurrence."""
+    n = self._counts[(op, key)] = self._counts.get((op, key), 0) + 1
+    h = hashlib.sha256(f"{self.seed}:{op}:{key}:{n}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2.0**64
+
+  def should_fault(self, op: str, key: str, rate: float) -> bool:
+    """One decision: permanent keys always fault; transient faults fire
+    per the seeded roll until the per-(op,key) budget is spent."""
+    if self.permanent and self.permanent in key:
+      telemetry.incr(f"chaos.{op}.permanent")
+      return True
+    if rate <= 0.0:
+      return False
+    spent = self._faults.get((op, key), 0)
+    if spent >= self.max_faults_per_key:
+      return False
+    if self.roll(op, key) < rate:
+      self._faults[(op, key)] = spent + 1
+      telemetry.incr(f"chaos.{op}")
+      return True
+    return False
+
+
+class ChaosStorage:
+  """Backend wrapper injecting storage faults (same _FileBackend
+  interface as what it wraps, so it stacks under CloudFiles unnoticed)."""
+
+  def __init__(self, inner, config: ChaosConfig, path: str = ""):
+    self.inner = inner
+    self.config = config
+    self.path = path
+
+  def _storm(self, op: str, key: str):
+    if self.config.should_fault(f"storm.{op}", key, self.config.storm):
+      raise HttpError(503, f"chaos://{self.path}/{key}", b"injected storm")
+
+  def put(self, key: str, data: bytes):
+    if self.config.should_fault("crash_put", key, self.config.crash_put):
+      raise ChaosWorkerCrash(
+        f"worker crashed between compute and upload of {key!r}"
+      )
+    if self.config.should_fault("put", key, self.config.put_fail):
+      raise HttpError(503, f"chaos://{self.path}/{key}", b"injected put fail")
+    self._storm("put", key)
+    return self.inner.put(key, data)
+
+  def get(self, key: str):
+    self._storm("get", key)
+    data = self.inner.get(key)
+    if data is not None and self.config.should_fault(
+      "corrupt", key, self.config.get_corrupt
+    ):
+      # flip a byte mid-payload: gzip/zstd CRCs and codec headers turn
+      # this into a loud decode failure, never silent bad voxels
+      i = len(data) // 2
+      data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+    return data
+
+  def get_range(self, key: str, start: int, length: int):
+    self._storm("get", key)
+    return self.inner.get_range(key, start, length)
+
+  def exists(self, key: str) -> bool:
+    self._storm("exists", key)
+    return self.inner.exists(key)
+
+  def delete(self, key: str):
+    self._storm("delete", key)
+    return self.inner.delete(key)
+
+  def size(self, key: str):
+    self._storm("size", key)
+    return self.inner.size(key)
+
+  def list(self, prefix: str = ""):
+    self._storm("list", prefix)
+    return self.inner.list(prefix)
+
+
+class ChaosQueue:
+  """Queue wrapper injecting control-plane faults. Delegates everything;
+  ``delete`` may be dropped (lost ack → duplicate delivery), which the
+  idempotent-task contract must absorb byte-identically."""
+
+  def __init__(self, inner, config: ChaosConfig):
+    self.inner = inner
+    self.config = config
+
+  def delete(self, lease_id: str):
+    # key by the task's stable name (after the lease prefix) so repeated
+    # deliveries of one task share an occurrence counter
+    name = str(lease_id).split("--", 1)[-1]
+    if self.config.should_fault(
+      "drop_delete", name, self.config.drop_delete
+    ):
+      return  # ack lost: lease expires, task redelivers
+    return self.inner.delete(lease_id)
+
+  def poll(self, *args, **kw):
+    """Route the shared loop through THIS wrapper (inner.poll would hand
+    poll_loop the unwrapped queue and bypass the injected faults)."""
+    from .queues.filequeue import poll_loop
+
+    kw.pop("tally", None)
+    return poll_loop(self, *args, **kw)
+
+  def __getattr__(self, attr):
+    return getattr(self.inner, attr)
+
+
+class chaos_storage:
+  """Context manager: every backend CloudFiles constructs while active is
+  wrapped in ChaosStorage(config). Reentrancy is not supported — one
+  storm at a time."""
+
+  def __init__(self, config: ChaosConfig):
+    self.config = config
+
+  def __enter__(self):
+    from . import storage
+
+    storage.set_backend_wrapper(
+      lambda backend, pth: ChaosStorage(backend, self.config, str(pth))
+    )
+    return self.config
+
+  def __exit__(self, *exc):
+    from . import storage
+
+    storage.set_backend_wrapper(None)
+    return False

@@ -10,7 +10,8 @@ every reference command and --option has a counterpart here):
         spatial-index {create,db}}
   skeleton {forge, merge, merge-sharded, xfer, rm, clean, convert,
             spatial-index {create,db}}
-  execute | queue {status,wait,release,rezero,purge,cp,mv,fsck}
+  execute | queue {status,wait,release,rezero,purge,cp,mv,fsck,
+                   dlq {ls,retry,purge}}
   design {ds-memory, ds-shape, bounds} | view | license
 
 Heavy imports (jax, task modules) happen inside commands so --help and
@@ -1344,9 +1345,18 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
               help="Lease up to K compatible tasks per round and run their "
                    "device stage as ONE mesh dispatch (SURVEY §5.8). Each "
                    "lease still completes/recycles independently.")
+@click.option("--max-deliveries", default=None, type=int,
+              help="Quarantine a task in the queue's dlq/ after this many "
+                   "deliveries instead of recycling it forever "
+                   "[default: infinite retry].")
+@click.option("--task-deadline", "task_deadline", default=None, type=float,
+              help="Per-task wall-clock deadline in seconds; an overrun "
+                   "counts as a failed delivery (recorded, then DLQ once "
+                   "--max-deliveries is exhausted).")
 @click.pass_context
 def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
-            exit_on_empty, min_sec, quiet, timing, batch_size):
+            exit_on_empty, min_sec, quiet, timing, batch_size,
+            max_deliveries, task_deadline):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
@@ -1374,7 +1384,8 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
       ctx_mp.Process(
         target=_execute_worker,
         args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-              timing, quiet, tally, batch_size),
+              timing, quiet, tally, batch_size, max_deliveries,
+              task_deadline),
       )
       for _ in range(parallel)
     ]
@@ -1384,18 +1395,32 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
       p.join()
     return
   _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                  timing, quiet, tally, batch_size)
+                  timing, quiet, tally, batch_size, max_deliveries,
+                  task_deadline)
 
 
 def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
-                    timing=False, quiet=False, tally=True, batch_size=1):
+                    timing=False, quiet=False, tally=True, batch_size=1,
+                    max_deliveries=None, task_deadline=None):
   import time
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
   from .queues import TaskQueue
 
-  tq = TaskQueue(queue_spec)
+  tq = TaskQueue(queue_spec, max_deliveries=max_deliveries)
   start = time.time()
+
+  def drained() -> bool:
+    # "empty" only means nothing is leasable right now; with a delivery
+    # budget the worker must outlive failed leases so every task ends
+    # COMPLETED or DEAD-LETTERED, not stranded mid-recycle (the poison
+    # task would otherwise need a second worker run to reach the DLQ)
+    if max_deliveries is None:
+      return True
+    try:
+      return tq.enqueued == 0
+    except (NotImplementedError, AttributeError):
+      return True
 
   def stop_fn(executed: int, empty: bool) -> bool:
     if num_tasks is not None and 0 <= num_tasks <= executed:
@@ -1403,9 +1428,9 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     if min_sec == 0 and (executed >= 1 or empty):
       # reference special value: run at most a single task (cli.py:892)
       return True
-    if empty and exit_on_empty:
+    if empty and exit_on_empty and drained():
       return True
-    if empty and 0 <= min_sec <= (time.time() - start):
+    if empty and 0 <= min_sec <= (time.time() - start) and drained():
       return True
     return False
 
@@ -1423,6 +1448,7 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
       tq, batch_size=batch_size, lease_seconds=lease_sec,
       verbose=not quiet, stop_fn=stop_fn, task_budget=task_budget,
       timing=timing,  # per-ROUND JSON lines (tasks share dispatches)
+      task_deadline_seconds=task_deadline,
     )
     if not quiet:
       click.echo(
@@ -1442,6 +1468,7 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   executed = tq.poll(
     lease_seconds=lease_sec, verbose=not quiet, stop_fn=stop_fn,
     before_fn=before_fn, after_fn=after_fn, tally=tally,
+    task_deadline_seconds=task_deadline,
   )
   if not quiet:
     click.echo(f"executed {executed} tasks")
@@ -1464,6 +1491,8 @@ def queue_status(queue_spec, eta, sample_sec):
   click.echo(f"enqueued: {tq.enqueued}")
   click.echo(f"leased: {tq.leased}")
   click.echo(f"completed: {tq.completed}")
+  if hasattr(tq, "dlq_count"):
+    click.echo(f"dead-lettered: {tq.dlq_count}")
   if hasattr(tq, "lease_ages"):
     ages = tq.lease_ages()
     if ages:
@@ -1547,6 +1576,53 @@ def queue_fsck(queue_spec, repair):
   if not hasattr(tq, "fsck"):
     raise click.UsageError("fsck supports fq:// queues only")
   click.echo(json_mod.dumps(tq.fsck(repair=repair), indent=2))
+
+
+@queue_group.group("dlq")
+def dlq_group():
+  """Dead-letter queue: inspect, requeue, or drop quarantined tasks.
+
+  Tasks land here when a worker runs with --max-deliveries N and a task
+  fails (raises, overruns its deadline, or loses its worker) on every
+  delivery. fq:// queues only — SQS deployments use a RedrivePolicy."""
+
+
+def _require_dlq(queue_spec):
+  from .queues import TaskQueue
+
+  tq = TaskQueue(queue_spec)
+  if not hasattr(tq, "dlq_ls"):
+    raise click.UsageError("queue dlq supports fq:// queues only")
+  return tq
+
+
+@dlq_group.command("ls")
+@click.argument("queue_spec")
+def dlq_ls(queue_spec):
+  """One JSON line per quarantined task: payload, delivery count, and
+  the recorded failure reasons (newest last)."""
+  import json as json_mod
+
+  for rec in _require_dlq(queue_spec).dlq_ls():
+    click.echo(json_mod.dumps(rec))
+
+
+@dlq_group.command("retry")
+@click.argument("queue_spec")
+@click.option("--name", "names", multiple=True,
+              help="Specific task file(s); default: all.")
+def dlq_retry(queue_spec, names):
+  """Return quarantined tasks to rotation with a fresh delivery budget."""
+  n = _require_dlq(queue_spec).dlq_retry(list(names) or None)
+  click.echo(f"requeued {n} tasks")
+
+
+@dlq_group.command("purge")
+@click.argument("queue_spec")
+def dlq_purge(queue_spec):
+  """Drop all quarantined tasks. Irreversible."""
+  n = _require_dlq(queue_spec).dlq_purge()
+  click.echo(f"purged {n} tasks")
 
 
 @queue_group.command("cp")

@@ -124,7 +124,9 @@ def encode(
     return encode_jpeg(img, quality=jpeg_quality)
   if encoding == "png":
     return encode_png(img, compress_level=png_level)
-  if encoding == "compresso":
+  if encoding in ("compresso", "compresso-cpsx"):
+    # "compresso-cpsx" is how info files advertise our experimental
+    # container (meta.advertised_encoding); both names hit one codec
     from .compresso import compress as compresso_compress
 
     return compresso_compress(img)
@@ -144,7 +146,7 @@ def decode(data: bytes, encoding: str, shape, dtype, block_size=(8, 8, 8),
     return decode_jpeg(data, shape, dtype)
   if encoding == "png":
     return decode_png(data, shape, dtype)
-  if encoding == "compresso":
+  if encoding in ("compresso", "compresso-cpsx"):
     from .compresso import decompress as compresso_decompress
 
     return compresso_decompress(data, shape, dtype)

@@ -38,6 +38,9 @@ round-trips exactly under this package and is property-tested against
 adversarial volumes; swap-in byte parity with seung-lab/compresso is
 gated until a reference-encoded artifact is available to validate
 against (same policy that keeps fpzip/zfpc/jpegxl gated — ROADMAP.md).
+For the same reason, Precomputed info files advertise this container as
+``compresso-cpsx`` (meta.advertised_encoding) so external readers fail
+loudly on the unknown encoding instead of mis-decoding it as v3.
 """
 
 from __future__ import annotations
@@ -51,7 +54,7 @@ MAGIC = b"cpsx"
 VERSION = 1
 STEPS = (8, 8, 1)  # 8x8 windows pack to one u64 per block
 
-_HEADER = struct.Struct("<4sBBIIIBBBQQIQB")  # 44 bytes
+_HEADER = struct.Struct("<4sBBIIIBBBQQIQB")  # 50 bytes
 
 
 def _boundary_map(labels: np.ndarray) -> np.ndarray:

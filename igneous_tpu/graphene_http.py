@@ -39,6 +39,7 @@ from typing import Optional
 
 import numpy as np
 
+from .retry import default_policy
 from .storage_http import HttpError, request
 
 
@@ -83,9 +84,12 @@ def _auth_request(method: str, url: str, data=None, extra_headers=None):
   on 401/403 — so a worker whose secret was rotated (or provisioned late)
   recovers without a restart."""
   headers = dict(extra_headers or {})
+  # unified retry schedule (retry.RetryPolicy): transient 5xx/connection
+  # faults back off the same way the storage backends do
+  policy = default_policy()
   try:
     return request(method, url, data=data,
-                   headers={**headers, **_auth_header()})
+                   headers={**headers, **_auth_header()}, policy=policy)
   except HttpError as e:
     # an env-var token can't be refreshed by re-reading secret files —
     # retrying would resend the identical request
@@ -93,7 +97,7 @@ def _auth_request(method: str, url: str, data=None, extra_headers=None):
       raise
     _invalidate_auth()
     return request(method, url, data=data,
-                   headers={**headers, **_auth_header()})
+                   headers={**headers, **_auth_header()}, policy=policy)
 
 
 class PCGClient:

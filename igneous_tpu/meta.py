@@ -26,6 +26,16 @@ def chunk_key(bbox: Bbox) -> str:
   return bbox.to_filename()
 
 
+def advertised_encoding(encoding: str) -> str:
+  """Precomputed-info name for an encoding. The compresso codec here
+  writes its own container (magic ``cpsx`` — compresso.py CONTAINER
+  CAVEAT), not the published compresso v3 bitstream, so info files
+  advertise it as ``compresso-cpsx``: external readers fail loudly on
+  the unknown encoding instead of silently mis-decoding. Our read path
+  (codecs.py) accepts both names."""
+  return "compresso-cpsx" if encoding == "compresso" else encoding
+
+
 class PrecomputedMetadata:
   """Parsed ``info`` file + derived per-mip geometry."""
 
@@ -62,7 +72,7 @@ class PrecomputedMetadata:
       "resolution": [int(r) for r in resolution],
       "voxel_offset": [int(v) for v in voxel_offset],
       "chunk_sizes": [[int(c) for c in chunk_size]],
-      "encoding": encoding,
+      "encoding": advertised_encoding(encoding),
     }
     if encoding == "compressed_segmentation":
       scale["compressed_segmentation_block_size"] = [
@@ -180,7 +190,7 @@ class PrecomputedMetadata:
     does so uploads pick it up)."""
     scale = self.scale(mip)
     if encoding is not None:
-      scale["encoding"] = encoding
+      scale["encoding"] = advertised_encoding(encoding)
       if encoding == "compressed_segmentation":
         scale.setdefault("compressed_segmentation_block_size", [8, 8, 8])
     if encoding_level is None:
@@ -245,7 +255,8 @@ class PrecomputedMetadata:
         // factor
       ],
       "chunk_sizes": [[int(c) for c in chunk_size]],
-      "encoding": encoding or base["encoding"],
+      "encoding": advertised_encoding(encoding) if encoding
+                  else base["encoding"],
     }
     if new_scale["encoding"] == "compressed_segmentation":
       new_scale["compressed_segmentation_block_size"] = list(

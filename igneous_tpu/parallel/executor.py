@@ -19,6 +19,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pooling import _pyramid_impl
 
+# jax.shard_map went public in newer jax; this image ships 0.4.x where it
+# still lives under jax.experimental (same semantics, check_rep kwarg) —
+# resolve once so every executor builds on whichever the runtime has
+if hasattr(jax, "shard_map"):
+  _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax<0.6 images
+  from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "chunks") -> Mesh:
   devices = jax.devices()
@@ -89,12 +97,12 @@ class BatchKernelExecutor:
     # literals, which the varying-manual-axes checker rejects under
     # shard_map (carry input unvarying vs output varying)
     try:
-      fn = jax.shard_map(
+      fn = _shard_map(
         jax.vmap(self.kernel), mesh=self.mesh,
         in_specs=P(self.axis), out_specs=out_specs, check_vma=False,
       )
     except TypeError:  # older jax: the parameter was named check_rep
-      fn = jax.shard_map(
+      fn = _shard_map(
         jax.vmap(self.kernel), mesh=self.mesh,
         in_specs=P(self.axis), out_specs=out_specs, check_rep=False,
       )
@@ -182,7 +190,7 @@ class ChunkExecutor:
     else:
       mip_spec = tuple(P(self.axis) for _ in factors)
     out_spec = (mip_spec, P())
-    fn = jax.shard_map(
+    fn = _shard_map(
       per_shard, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec
     )
     return jax.jit(fn)

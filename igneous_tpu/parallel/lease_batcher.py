@@ -38,6 +38,7 @@ from typing import Optional
 import numpy as np
 
 from ..lib import Bbox
+from ..queues.filequeue import failure_reason, run_with_deadline
 
 
 def _group_key(task, volmeta_cache):
@@ -139,12 +140,16 @@ class LeaseBatcher:
     mesh=None,
     verbose: bool = False,
     timing: bool = False,
+    task_deadline_seconds: Optional[float] = None,
   ):
     self.queue = queue
     self.batch_size = int(batch_size)
     self.lease_seconds = lease_seconds
     self.mesh = mesh
     self.verbose = verbose
+    # per-member wall-clock deadline for the solo/completion stages —
+    # shares queues.filequeue.run_with_deadline with the solo poll loop
+    self.task_deadline_seconds = task_deadline_seconds
     # --time equivalent for batched rounds: per-task stage timing makes
     # no sense when K tasks share one dispatch, so emit one JSON line
     # per lease ROUND instead (wall, members, dispatches delta)
@@ -264,19 +269,31 @@ class LeaseBatcher:
       if self.verbose:
         print(f"Executing (solo) {task!r}")
       try:
-        task.execute()
-      except Exception:
-        if self.verbose:
-          import traceback
-
-          traceback.print_exc()
-        self.stats["failed"] += 1
+        run_with_deadline(task.execute, self.task_deadline_seconds)
+      except Exception as e:
+        self._record_failure(lease_id, e)
         continue
       self.queue.delete(lease_id)
       self.stats["executed"] += 1
       self.stats["solo"] += 1
 
   # -- completion plumbing --------------------------------------------------
+
+  def _record_failure(self, lease_id, exc):
+    """One bookkeeping path for every failed member — solo execution,
+    group completion, deadline overrun: the reason is recorded with the
+    task (queue.nack), so the batcher's group→solo degradation and the
+    DLQ promotion share the same persisted evidence."""
+    if self.verbose:
+      import traceback
+
+      traceback.print_exc()
+    from .. import telemetry
+
+    telemetry.incr("tasks.failed")
+    self.stats["failed"] += 1
+    if hasattr(self.queue, "nack"):
+      self.queue.nack(lease_id, failure_reason(exc))
 
   def _complete(self, lease_id):
     self.queue.delete(lease_id)
@@ -289,13 +306,11 @@ class LeaseBatcher:
     lease only."""
     for idx, (task, lease_id) in enumerate(group):
       try:
-        finish_one(idx, task)
-      except Exception:
-        if self.verbose:
-          import traceback
-
-          traceback.print_exc()
-        self.stats["failed"] += 1
+        run_with_deadline(
+          lambda: finish_one(idx, task), self.task_deadline_seconds
+        )
+      except Exception as e:
+        self._record_failure(lease_id, e)
         continue
       self._complete(lease_id)
 
@@ -460,11 +475,13 @@ def poll_batched(
   mesh=None,
   task_budget: Optional[int] = None,
   timing: bool = False,
+  task_deadline_seconds: Optional[float] = None,
 ):
   """Functional entry point mirroring queues.filequeue.poll_loop."""
   batcher = LeaseBatcher(
     queue, batch_size=batch_size, lease_seconds=lease_seconds,
     mesh=mesh, verbose=verbose, timing=timing,
+    task_deadline_seconds=task_deadline_seconds,
   )
   executed = batcher.poll(
     stop_fn=stop_fn, max_backoff_window=max_backoff_window,

@@ -8,10 +8,20 @@ avoid lease contention; completions are tallied 1 byte per task.
 
 All state is plain files, so any shared POSIX filesystem (NFS, /mnt
 volumes) works as the control plane across machines.
+
+Failure containment (ISSUE 1): each task carries persisted attempt
+metadata (``meta/<name>``: delivery count + recent failure reasons).
+With ``max_deliveries`` configured, a task that keeps failing — by
+raising, overrunning its deadline, or losing its worker — moves to the
+``dlq/`` sidecar instead of re-entering rotation, where ``igneous queue
+dlq ls|retry|purge`` can inspect, requeue, or drop it. The default
+(``max_deliveries=None``) preserves the historical infinite-retry
+at-least-once semantics.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import time
@@ -22,6 +32,11 @@ from .registry import RegisteredTask, deserialize, serialize
 
 LEASE_SEP = "--"
 CONTENTION_WINDOW = 100
+MAX_RECORDED_FAILURES = 5  # per-task failure-reason ring (meta file bound)
+
+
+class TaskDeadlineError(Exception):
+  """A task overran its per-delivery wall-clock deadline (poll_loop)."""
 
 
 def iter_tasks(tasks):
@@ -33,6 +48,43 @@ def iter_tasks(tasks):
   return iter([tasks])
 
 
+def failure_reason(exc: BaseException) -> str:
+  """One-line failure record shared by every containment path (poll_loop,
+  the lease batcher, LocalTaskQueue) so DLQ entries read uniformly."""
+  msg = str(exc)
+  return f"{type(exc).__name__}: {msg}" if msg else type(exc).__name__
+
+
+def run_with_deadline(fn, deadline_seconds: Optional[float]):
+  """Run ``fn()`` with a wall-clock deadline. On overrun, raises
+  TaskDeadlineError so the caller's failure bookkeeping (nack → DLQ)
+  takes over. The overrunning call keeps executing on an abandoned
+  daemon thread — it cannot be killed safely — which is sound here
+  because tasks are idempotent and the lease it held stays failed."""
+  if not deadline_seconds or deadline_seconds <= 0:
+    return fn()
+  import threading
+
+  result = {}
+
+  def body():
+    try:
+      result["value"] = fn()
+    except BaseException as e:  # noqa: BLE001 - relayed to the caller
+      result["error"] = e
+
+  t = threading.Thread(target=body, daemon=True)
+  t.start()
+  t.join(deadline_seconds)
+  if t.is_alive():
+    raise TaskDeadlineError(
+      f"task exceeded its {deadline_seconds:.1f}s deadline"
+    )
+  if "error" in result:
+    raise result["error"]
+  return result.get("value")
+
+
 def poll_loop(
   queue,
   lease_seconds: float = 600,
@@ -41,11 +93,20 @@ def poll_loop(
   max_backoff_window: float = 30.0,
   before_fn=None,
   after_fn=None,
+  task_deadline_seconds: Optional[float] = None,
 ):
   """Shared worker loop: lease→execute→delete until stop_fn says stop or
   the queue drains (stop_fn=None polls forever, sleeping with bounded
   backoff when empty). Used by every queue backend (fq://, sqs://) so
-  execution semantics — at-least-once, recycle-on-failure — are uniform."""
+  execution semantics — at-least-once, recycle-on-failure — are uniform.
+
+  Failure containment: an exception (or ``task_deadline_seconds``
+  overrun) records its reason with the task via ``queue.nack`` when the
+  backend supports it — feeding the same bookkeeping that promotes
+  repeat offenders to the DLQ — and otherwise leaves the lease to
+  recycle on its visibility timeout, exactly as before."""
+  from .. import telemetry
+
   backoff = 1.0
   executed = 0
   while True:
@@ -65,30 +126,159 @@ def poll_loop(
     try:
       if before_fn:
         before_fn(task)
-      task.execute()
+      run_with_deadline(task.execute, task_deadline_seconds)
       if after_fn:
         after_fn(task)
-    except Exception:
+    except Exception as e:
       # leave the lease in place: the task recycles after the timeout
-      # (at-least-once semantics; matches reference behavior on failure)
+      # (at-least-once semantics; matches reference behavior on failure).
+      # nack records the reason and quarantines exhausted tasks.
       if verbose:
         import traceback
 
         traceback.print_exc()
+      telemetry.incr("tasks.failed")
+      if hasattr(queue, "nack"):
+        queue.nack(lease_id, failure_reason(e))
       continue
     queue.delete(lease_id)
     executed += 1
 
 
 class FileQueue:
-  def __init__(self, path: str):
+  def __init__(self, path: str, max_deliveries: Optional[int] = None):
+    """``max_deliveries``: after this many deliveries (leases), a task
+    that fails again is quarantined in ``dlq/`` instead of recycling.
+    None (default) keeps the historical infinite-retry behavior."""
     if path.startswith("fq://"):
       path = path[len("fq://"):]
     self.path = os.path.abspath(os.path.expanduser(path))
     self.queue_dir = os.path.join(self.path, "queue")
     self.lease_dir = os.path.join(self.path, "leased")
+    self.dlq_dir = os.path.join(self.path, "dlq")
+    self.meta_dir = os.path.join(self.path, "meta")
+    self.max_deliveries = (
+      None if not max_deliveries or int(max_deliveries) <= 0
+      else int(max_deliveries)
+    )
     os.makedirs(self.queue_dir, exist_ok=True)
     os.makedirs(self.lease_dir, exist_ok=True)
+    os.makedirs(self.dlq_dir, exist_ok=True)
+    os.makedirs(self.meta_dir, exist_ok=True)
+
+  # -- per-task attempt metadata --------------------------------------------
+
+  def _meta_path(self, name: str) -> str:
+    return os.path.join(self.meta_dir, name)
+
+  def _read_meta(self, name: str) -> dict:
+    try:
+      with open(self._meta_path(name)) as f:
+        return json.load(f)
+    except (FileNotFoundError, ValueError):
+      return {"deliveries": 0, "failures": []}
+
+  def _write_meta(self, name: str, meta: dict):
+    tmp = os.path.join(self.path, f".tmp-meta-{uuid.uuid4().hex}")
+    with open(tmp, "w") as f:
+      json.dump(meta, f)
+    os.replace(tmp, self._meta_path(name))
+
+  def _drop_meta(self, name: str):
+    try:
+      os.remove(self._meta_path(name))
+    except FileNotFoundError:
+      pass
+
+  def _record_failure(self, name: str, reason: str) -> dict:
+    meta = self._read_meta(name)
+    meta.setdefault("failures", []).append({
+      "time": time.time(), "error": str(reason)[:2000],
+    })
+    meta["failures"] = meta["failures"][-MAX_RECORDED_FAILURES:]
+    self._write_meta(name, meta)
+    return meta
+
+  def delivery_count(self, name_or_lease: str) -> int:
+    """Deliveries so far for a task (by queue filename or lease id) —
+    the fq:// analogue of SQS's ApproximateReceiveCount."""
+    name = name_or_lease.split(LEASE_SEP, 1)[-1]
+    return int(self._read_meta(name).get("deliveries", 0))
+
+  def _exhausted(self, name: str) -> bool:
+    return (
+      self.max_deliveries is not None
+      and self.delivery_count(name) >= self.max_deliveries
+    )
+
+  # -- dead-letter queue ----------------------------------------------------
+
+  def _quarantine_to_dlq(self, src_path: str, name: str, reason: str):
+    """Move a task file into dlq/ (terminal until an operator intervenes).
+    The meta file stays: it holds the delivery count + failure reasons
+    that `dlq ls` reports."""
+    self._record_failure(name, reason)
+    try:
+      os.rename(src_path, os.path.join(self.dlq_dir, name))
+    except FileNotFoundError:
+      return  # another worker moved it first
+    from .. import telemetry
+
+    telemetry.incr("dlq.promoted")
+
+  @property
+  def dlq_count(self) -> int:
+    return len(os.listdir(self.dlq_dir))
+
+  def dlq_ls(self) -> List[dict]:
+    """One record per quarantined task: name, payload (JSON string),
+    delivery count, and the recorded failure reasons (newest last)."""
+    out = []
+    for name in sorted(os.listdir(self.dlq_dir)):
+      try:
+        with open(os.path.join(self.dlq_dir, name)) as f:
+          payload = f.read()
+      except FileNotFoundError:
+        continue
+      meta = self._read_meta(name)
+      out.append({
+        "name": name,
+        "payload": payload,
+        "deliveries": int(meta.get("deliveries", 0)),
+        "failures": meta.get("failures", []),
+      })
+    return out
+
+  def dlq_retry(self, names: Optional[Iterable[str]] = None) -> int:
+    """Return quarantined tasks to rotation (all, or just ``names``),
+    resetting their delivery counts so they get a fresh budget."""
+    if names is None:
+      names = sorted(os.listdir(self.dlq_dir))
+    n = 0
+    for name in names:
+      src = os.path.join(self.dlq_dir, name)
+      try:
+        os.rename(src, os.path.join(self.queue_dir, name))
+      except FileNotFoundError:
+        continue
+      meta = self._read_meta(name)
+      meta["deliveries"] = 0
+      self._write_meta(name, meta)
+      n += 1
+    return n
+
+  def dlq_purge(self) -> int:
+    """Drop all quarantined tasks (and their metadata). Irreversible."""
+    n = 0
+    for name in list(os.listdir(self.dlq_dir)):
+      try:
+        os.remove(os.path.join(self.dlq_dir, name))
+        n += 1
+      except FileNotFoundError:
+        continue
+      finally:
+        self._drop_meta(name)
+    return n
 
   # -- counters -------------------------------------------------------------
 
@@ -137,7 +327,8 @@ class FileQueue:
     bad-name leases with VALID payloads recycle into the queue (corrupt
     ones are quarantined too)."""
     problems = {"malformed_tasks": [], "bad_lease_names": [],
-                "counter_drift": self.inserted - self.completed - self.enqueued}
+                "counter_drift": (self.inserted - self.completed
+                                  - self.enqueued - self.dlq_count)}
     quarantine_dir = os.path.join(self.path, "quarantine")
 
     def payload_ok(path: str):
@@ -229,11 +420,18 @@ class FileQueue:
         continue
       if deadline < now:
         orig = name.split(LEASE_SEP, 1)[1]
-        try:
-          os.rename(
-            os.path.join(self.lease_dir, name),
-            os.path.join(self.queue_dir, orig),
+        src = os.path.join(self.lease_dir, name)
+        if self._exhausted(orig):
+          # the worker that held this lease died (or never acked): the
+          # lease expiring IS the failure signal for its final delivery
+          self._quarantine_to_dlq(
+            src, orig,
+            f"lease expired after delivery {self.delivery_count(orig)} "
+            f"(worker lost or task hung)",
           )
+          continue
+        try:
+          os.rename(src, os.path.join(self.queue_dir, orig))
         except FileNotFoundError:
           pass  # another worker recycled it first
 
@@ -253,6 +451,9 @@ class FileQueue:
         os.rename(src, dst)
       except FileNotFoundError:
         continue  # lost the race; try another
+      meta = self._read_meta(name)
+      meta["deliveries"] = int(meta.get("deliveries", 0)) + 1
+      self._write_meta(name, meta)
       with open(dst) as f:
         return deserialize(f.read()), lease_name
     return None
@@ -262,7 +463,23 @@ class FileQueue:
       os.remove(os.path.join(self.lease_dir, lease_id))
     except FileNotFoundError:
       pass
+    self._drop_meta(lease_id.split(LEASE_SEP, 1)[-1])
     self._tally("completions")
+
+  def nack(self, lease_id: str, reason: str = "", requeue: bool = False):
+    """Record a failed delivery. The failure reason persists with the
+    task's metadata; once ``max_deliveries`` is exhausted the task moves
+    to ``dlq/``. Otherwise the lease is left to recycle on its visibility
+    timeout (at-least-once semantics unchanged) unless ``requeue=True``
+    returns it to rotation immediately."""
+    orig = lease_id.split(LEASE_SEP, 1)[-1]
+    src = os.path.join(self.lease_dir, lease_id)
+    if self._exhausted(orig):
+      self._quarantine_to_dlq(src, orig, reason)  # records the reason
+    else:
+      self._record_failure(orig, reason)
+      if requeue:
+        self.release(lease_id)
 
   def release(self, lease_id: str):
     orig = lease_id.split(LEASE_SEP, 1)[1]
@@ -280,7 +497,7 @@ class FileQueue:
         self.release(name)
 
   def purge(self):
-    for d in (self.queue_dir, self.lease_dir):
+    for d in (self.queue_dir, self.lease_dir, self.dlq_dir, self.meta_dir):
       for name in list(os.listdir(d)):
         try:
           os.remove(os.path.join(d, name))
@@ -299,13 +516,14 @@ class FileQueue:
     max_backoff_window: float = 30.0,
     before_fn=None,
     after_fn=None,
+    task_deadline_seconds: Optional[float] = None,
   ):
     """Lease→execute→delete until stop_fn says stop or the queue drains
     (stop_fn=None polls forever, sleeping with bounded backoff when empty)."""
     del tally  # completions are always tallied; kept for API familiarity
     return poll_loop(
       self, lease_seconds, verbose, stop_fn, max_backoff_window,
-      before_fn, after_fn,
+      before_fn, after_fn, task_deadline_seconds,
     )
 
   def __len__(self):

@@ -29,6 +29,22 @@ def _execute_payload(payload: str):
   return True
 
 
+def _execute_payload_contained(payload: str, max_deliveries: int):
+  """Spawned-worker body with the containment contract: retry up to
+  ``max_deliveries`` attempts, then report the failure instead of
+  killing the whole pool. Returns (payload, error_or_None)."""
+  from .filequeue import failure_reason
+
+  last = None
+  for _ in range(max(int(max_deliveries), 1)):
+    try:
+      _execute_payload(payload)
+      return payload, None
+    except Exception as e:  # noqa: BLE001 - recorded as a dead letter
+      last = failure_reason(e)
+  return payload, last
+
+
 def _worker_init(pool_threads: int):
   """Spawned-worker setup: N process-parallel workers each get 1/N of the
   cores for their native kernel threading (same oversubscription hygiene as
@@ -38,13 +54,31 @@ def _worker_init(pool_threads: int):
 
 
 class LocalTaskQueue:
-  """Executes tasks on insert; parallel > 1 uses a spawn process pool."""
+  """Executes tasks on insert; parallel > 1 uses a spawn process pool.
 
-  def __init__(self, parallel: int = 1, progress: bool = True):
+  ``max_deliveries`` opts into the same failure containment the lease
+  queues have: each task gets that many attempts, and tasks that still
+  fail are collected in ``self.dead_letters`` (payload + failure reason)
+  instead of aborting the whole insert. The default (None) keeps the
+  historical fail-fast behavior — the first exception propagates."""
+
+  def __init__(self, parallel: int = 1, progress: bool = True,
+               max_deliveries: Optional[int] = None):
     self.parallel = max(int(parallel), 1)
     self.progress = progress
     self.inserted = 0
     self.completed = 0
+    self.max_deliveries = (
+      None if not max_deliveries or int(max_deliveries) <= 0
+      else int(max_deliveries)
+    )
+    self.dead_letters: list = []
+
+  def _record_dead_letter(self, payload: str, error: str):
+    from .. import telemetry
+
+    self.dead_letters.append({"payload": payload, "error": error})
+    telemetry.incr("dlq.promoted")
 
   def insert(self, tasks: Iterable, total: Optional[int] = None):
     payloads = (serialize(t) for t in self._iter(tasks))
@@ -54,7 +88,14 @@ class LocalTaskQueue:
     if self.parallel == 1:
       for payload in payloads:
         self.inserted += 1
-        _execute_payload(payload)
+        if self.max_deliveries is None:
+          _execute_payload(payload)
+        else:
+          _p, err = _execute_payload_contained(payload, self.max_deliveries)
+          if err is not None:
+            self._record_dead_letter(payload, err)
+            bar.update(1)
+            continue
         self.completed += 1
         bar.update(1)
     else:
@@ -63,10 +104,28 @@ class LocalTaskQueue:
       with ctx.Pool(
         self.parallel, initializer=_worker_init, initargs=(threads,)
       ) as pool:
-        for _ in pool.imap_unordered(_execute_payload, payloads, chunksize=1):
-          self.inserted += 1
-          self.completed += 1
-          bar.update(1)
+        if self.max_deliveries is None:
+          for _ in pool.imap_unordered(
+            _execute_payload, payloads, chunksize=1
+          ):
+            self.inserted += 1
+            self.completed += 1
+            bar.update(1)
+        else:
+          import functools
+
+          runner = functools.partial(
+            _execute_payload_contained, max_deliveries=self.max_deliveries
+          )
+          for payload, err in pool.imap_unordered(
+            runner, payloads, chunksize=1
+          ):
+            self.inserted += 1
+            if err is not None:
+              self._record_dead_letter(payload, err)
+            else:
+              self.completed += 1
+            bar.update(1)
     bar.close()
 
   insert_all = insert

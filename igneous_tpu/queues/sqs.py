@@ -42,16 +42,18 @@ class FakeSQSTransport:
     self._visible_at = {}   # id -> timestamp
     self._receipt = {}      # id -> current receipt handle
     self._by_receipt = {}   # receipt -> id
+    self._receive_count = {}  # id -> deliveries (ApproximateReceiveCount)
 
   def send_message(self, body: str) -> str:
     mid = uuid.uuid4().hex
     self._messages[mid] = body
     self._visible_at[mid] = self._now()
+    self._receive_count[mid] = 0
     return mid
 
   def receive_message(
     self, visibility_timeout: float
-  ) -> Optional[Tuple[str, str]]:
+  ) -> Optional[Tuple[str, str, dict]]:
     now = self._now()
     for mid, vis in self._visible_at.items():
       if vis <= now:
@@ -63,7 +65,11 @@ class FakeSQSTransport:
         self._receipt[mid] = receipt
         self._by_receipt[receipt] = mid
         self._visible_at[mid] = now + visibility_timeout
-        return self._messages[mid], receipt
+        self._receive_count[mid] = self._receive_count.get(mid, 0) + 1
+        attrs = {
+          "ApproximateReceiveCount": str(self._receive_count[mid])
+        }
+        return self._messages[mid], receipt, attrs
     return None
 
   def delete_message(self, receipt: str) -> bool:
@@ -73,6 +79,7 @@ class FakeSQSTransport:
     self._messages.pop(mid, None)
     self._visible_at.pop(mid, None)
     self._receipt.pop(mid, None)
+    self._receive_count.pop(mid, None)
     return True
 
   def change_visibility(self, receipt: str, timeout: float) -> bool:
@@ -92,6 +99,7 @@ class FakeSQSTransport:
     self._visible_at.clear()
     self._receipt.clear()
     self._by_receipt.clear()
+    self._receive_count.clear()
 
 
 def _boto3_transport(spec: str):
@@ -120,11 +128,15 @@ def _boto3_transport(spec: str):
       resp = sqs.receive_message(
         QueueUrl=url, MaxNumberOfMessages=1,
         VisibilityTimeout=int(visibility_timeout), WaitTimeSeconds=1,
+        AttributeNames=["ApproximateReceiveCount"],
       )
       msgs = resp.get("Messages", [])
       if not msgs:
         return None
-      return msgs[0]["Body"], msgs[0]["ReceiptHandle"]
+      return (
+        msgs[0]["Body"], msgs[0]["ReceiptHandle"],
+        msgs[0].get("Attributes", {}),
+      )
 
     def delete_message(self, receipt):
       # stale receipt (task outlived its visibility timeout and was
@@ -176,13 +188,34 @@ class SQSQueue:
     self, spec: str, transport=None,
     empty_confirmation_sec: float = EMPTY_CONFIRMATION_SEC,
     sleep_fn=time.sleep,
+    max_deliveries: Optional[int] = None,
+    dlq=None,
   ):
+    """``max_deliveries``/``dlq``: client-side mirror of SQS redrive —
+    a message received more than ``max_deliveries`` times routes to
+    ``dlq`` (any queue-like with .insert(), e.g. another SQSQueue or a
+    FileQueue) instead of being delivered. With ``dlq=None`` quarantined
+    bodies accumulate in ``self.dead_letters`` (per-process). Production
+    deployments should prefer a server-side RedrivePolicy; this mirror
+    gives the shared poll loop identical semantics on the fake."""
     self.spec = spec
     self.transport = transport or _boto3_transport(spec)
     self.empty_confirmation_sec = float(empty_confirmation_sec)
     self._sleep = sleep_fn
     self._inserted = 0
     self._completed = 0
+    self.max_deliveries = (
+      None if not max_deliveries or int(max_deliveries) <= 0
+      else int(max_deliveries)
+    )
+    self.dlq = dlq
+    self.dead_letters: list = []
+    self.last_receive_count: int = 0
+    # reasons key on the message BODY (stable across redeliveries —
+    # receipts rotate every receive, so they cannot carry attribution
+    # from the failing delivery to the promoting one)
+    self._failure_reasons: dict = {}  # body -> last recorded reason
+    self._receipt_body: dict = {}     # live receipt -> body
 
   # -- counters -------------------------------------------------------------
 
@@ -219,15 +252,54 @@ class SQSQueue:
     return n
 
   def lease(self, seconds: float = 600):
-    got = self.transport.receive_message(seconds)
-    if got is None:
-      return None
-    body, receipt = got
-    return deserialize(body), receipt
+    while True:
+      got = self.transport.receive_message(seconds)
+      if got is None:
+        return None
+      body, receipt = got[0], got[1]
+      attrs = got[2] if len(got) > 2 else {}
+      count = int(attrs.get("ApproximateReceiveCount", 0) or 0)
+      self.last_receive_count = count
+      if self.max_deliveries is not None and count > self.max_deliveries:
+        # redelivery budget exhausted BEFORE this delivery: quarantine
+        # instead of handing a poison task to yet another worker
+        self._promote_to_dlq(body, receipt, count)
+        continue
+      self._receipt_body[receipt] = body
+      return deserialize(body), receipt
+
+  def _promote_to_dlq(self, body: str, receipt: str, count: int):
+    from .. import telemetry
+
+    if self.dlq is not None:
+      self.dlq.insert(body)
+    else:
+      self.dead_letters.append({
+        "payload": body,
+        "deliveries": count,
+        "error": self._failure_reasons.pop(body, ""),
+      })
+    self.transport.delete_message(receipt)
+    telemetry.incr("dlq.promoted")
 
   def delete(self, lease_id: str):
+    body = self._receipt_body.pop(lease_id, None)
+    if body is not None:
+      self._failure_reasons.pop(body, None)
     if self.transport.delete_message(lease_id):
       self._completed += 1
+
+  def nack(self, lease_id: str, reason: str = "", requeue: bool = False):
+    """Record a failed delivery. SQS keeps no per-message metadata, so
+    the reason lives client-side (telemetry + last-reason map, keyed by
+    message body); the visibility timeout (or ``requeue=True``) drives
+    redelivery, and the receive-count check in lease() drives DLQ
+    promotion."""
+    body = self._receipt_body.pop(lease_id, None)
+    if body is not None:
+      self._failure_reasons[body] = str(reason)[:2000]
+    if requeue:
+      self.release(lease_id)
 
   def release(self, lease_id: str):
     self.transport.change_visibility(lease_id, 0)
@@ -269,9 +341,10 @@ class SQSQueue:
     max_backoff_window: float = 30.0,
     before_fn=None,
     after_fn=None,
+    task_deadline_seconds: Optional[float] = None,
   ):
     del tally
     return poll_loop(
       self, lease_seconds, verbose, stop_fn, max_backoff_window,
-      before_fn, after_fn,
+      before_fn, after_fn, task_deadline_seconds,
     )

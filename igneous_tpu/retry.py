@@ -1,0 +1,124 @@
+"""Unified retry policy for every network seam (ISSUE 1 tentpole §4).
+
+storage_http.py, storage_gcs.py, storage_s3.py, and graphene_http.py all
+talk to eventually-available services and previously each hard-coded its
+own backoff constants. This module is the single source of truth:
+``RetryPolicy`` carries base delay, cap, jitter mode, and an attempt
+budget; callers ask it "should attempt N retry, and after how long?" and
+report outcomes through telemetry counters so operators can see retry
+pressure (``igneous_tpu.telemetry.counters_snapshot()``).
+
+Env overrides (read at policy construction so workers can be tuned
+without code changes):
+
+  IGNEOUS_RETRY_ATTEMPTS   total attempts incl. the first (default 6)
+  IGNEOUS_RETRY_BASE_S     first backoff delay (default 0.25)
+  IGNEOUS_RETRY_CAP_S      max single delay (default 30)
+  IGNEOUS_RETRY_BUDGET_S   total sleep budget per operation (default 120)
+
+The ``sleep_fn``/``rng`` seams exist so the chaos harness and unit tests
+run retry schedules deterministically without wall-clock sleeps.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+RETRYABLE_STATUS = (408, 429, 500, 502, 503, 504)
+
+
+def _env_float(name: str, default: float) -> float:
+  try:
+    return float(os.environ.get(name, default))
+  except ValueError:
+    return default
+
+
+@dataclass
+class RetryPolicy:
+  """Exponential backoff with full jitter and a total-sleep budget.
+
+  attempts: total tries including the first (1 = no retries).
+  base_s/cap_s: delay = min(cap, base * 2**retry_index), jittered.
+  budget_s: once cumulative planned sleep exceeds this, stop retrying
+    even if attempts remain (bounds worst-case task latency under a 503
+    storm — the queue's at-least-once delivery is the outer retry loop).
+  jitter: "full" (uniform [0, delay], the AWS-recommended default) or
+    "none" (deterministic, used by tests and the chaos soak).
+  """
+
+  attempts: int = 6
+  base_s: float = 0.25
+  cap_s: float = 30.0
+  budget_s: float = 120.0
+  jitter: str = "full"
+  sleep_fn: Callable[[float], None] = field(default=None, repr=False)
+  rng: random.Random = field(default=None, repr=False)
+
+  def __post_init__(self):
+    if self.sleep_fn is None:
+      import time
+
+      self.sleep_fn = time.sleep
+    if self.rng is None:
+      self.rng = random
+
+  @classmethod
+  def from_env(cls, **overrides) -> "RetryPolicy":
+    kw = dict(
+      attempts=int(_env_float("IGNEOUS_RETRY_ATTEMPTS", 6)),
+      base_s=_env_float("IGNEOUS_RETRY_BASE_S", 0.25),
+      cap_s=_env_float("IGNEOUS_RETRY_CAP_S", 30.0),
+      budget_s=_env_float("IGNEOUS_RETRY_BUDGET_S", 120.0),
+    )
+    kw.update(overrides)
+    return cls(**kw)
+
+  def delay(self, retry_index: int) -> float:
+    """Planned delay before retry number ``retry_index`` (0-based)."""
+    d = min(self.cap_s, self.base_s * (2.0 ** retry_index))
+    if self.jitter == "full":
+      d = self.rng.random() * d
+    return d
+
+  def retries(self, counter: Optional[str] = None):
+    """Yield retry indices, sleeping between them, until attempts or the
+    sleep budget is exhausted. The FIRST attempt is the caller's — this
+    iterator yields once per RETRY and sleeps before yielding.
+
+      for _ in policy.retries("storage_http"):
+        # re-issue the request
+    """
+    from . import telemetry
+
+    slept = 0.0
+    for i in range(max(self.attempts - 1, 0)):
+      d = self.delay(i)
+      if slept + d > self.budget_s:
+        return
+      self.sleep_fn(d)
+      slept += d
+      if counter:
+        telemetry.incr(f"retries.{counter}")
+      yield i
+
+
+_DEFAULT: Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+  """Process-wide policy (env-configured, constructed once)."""
+  global _DEFAULT
+  if _DEFAULT is None:
+    _DEFAULT = RetryPolicy.from_env()
+  return _DEFAULT
+
+
+def set_default_policy(policy: Optional[RetryPolicy]):
+  """Override the process-wide policy (None resets to env config).
+  Used by tests and the chaos soak to run deterministic schedules."""
+  global _DEFAULT
+  _DEFAULT = policy

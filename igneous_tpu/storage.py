@@ -34,7 +34,10 @@ import shutil
 import threading
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
-import zstandard
+try:
+  import zstandard
+except ImportError:  # zstd stays readable/writable only where the codec ships
+  zstandard = None
 
 from .lib import jsonify
 
@@ -61,6 +64,11 @@ def compress_bytes(data: bytes, method) -> bytes:
     # stays literally true for compressed chunks
     return gzip_mod.compress(data, compresslevel=6, mtime=0)
   if method == "zstd":
+    if zstandard is None:
+      raise ImportError(
+        "zstd compression needs the 'zstandard' package, which this "
+        "environment does not ship; use gzip or no compression"
+      )
     return zstandard.ZstdCompressor().compress(data)
   raise ValueError(f"Unsupported compression: {method}")
 
@@ -71,6 +79,11 @@ def decompress_bytes(data: bytes, method) -> bytes:
   if method == "gzip":
     return gzip_mod.decompress(data)
   if method == "zstd":
+    if zstandard is None:
+      raise ImportError(
+        "reading a .zstd object needs the 'zstandard' package, which this "
+        "environment does not ship"
+      )
     return zstandard.ZstdDecompressor().decompress(data)
   raise ValueError(f"Unsupported compression: {method}")
 
@@ -135,6 +148,18 @@ def clear_memory_storage():
 # ---------------------------------------------------------------------------
 
 _PROTOCOL_HOOKS = {}
+
+# every constructed backend flows through this (chaos fault injection,
+# instrumentation): wrapper(backend, extracted_path) -> backend-like
+_BACKEND_WRAPPER = None
+
+
+def set_backend_wrapper(wrapper):
+  """Install (or clear, with None) a global backend wrapper. Applied to
+  every backend ANY protocol constructs — the seam igneous_tpu.chaos uses
+  to inject storage faults without monkey-patching per-protocol clients."""
+  global _BACKEND_WRAPPER
+  _BACKEND_WRAPPER = wrapper
 
 
 def register_protocol(name: str, factory):
@@ -262,23 +287,27 @@ def attach_memory_protocol(protocol: str):
 
 def _make_backend(pth: ExtractedPath):
   if pth.protocol == "file":
-    return _FileBackend(pth.path)
-  if pth.protocol == "mem":
-    return _MemBackend(pth.path)
-  if pth.protocol in _PROTOCOL_HOOKS:
-    return _PROTOCOL_HOOKS[pth.protocol](pth.path)
-  if pth.protocol == "gs":
+    backend = _FileBackend(pth.path)
+  elif pth.protocol == "mem":
+    backend = _MemBackend(pth.path)
+  elif pth.protocol in _PROTOCOL_HOOKS:
+    backend = _PROTOCOL_HOOKS[pth.protocol](pth.path)
+  elif pth.protocol == "gs":
     from .storage_gcs import GCSBackend
 
-    return GCSBackend(pth.path)
-  if pth.protocol == "s3":
+    backend = GCSBackend(pth.path)
+  elif pth.protocol == "s3":
     from .storage_s3 import S3Backend
 
-    return S3Backend(pth.path)
-  raise ValueError(
-    f"Protocol {pth.protocol}:// not available in this environment. "
-    f"Use register_protocol() to attach a backend."
-  )
+    backend = S3Backend(pth.path)
+  else:
+    raise ValueError(
+      f"Protocol {pth.protocol}:// not available in this environment. "
+      f"Use register_protocol() to attach a backend."
+    )
+  if _BACKEND_WRAPPER is not None:
+    backend = _BACKEND_WRAPPER(backend, pth)
+  return backend
 
 
 class CloudFiles:

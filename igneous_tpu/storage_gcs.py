@@ -31,6 +31,7 @@ import time
 from typing import Iterator, Optional
 
 from . import secrets
+from .retry import default_policy
 from .storage_http import HttpError, quote_path, request
 
 # objects >= this use a resumable upload session (env-tunable, read per
@@ -149,6 +150,12 @@ class GCSBackend:
     if "://" not in self.endpoint:
       self.endpoint = "http://" + self.endpoint
     self.auth = _GoogleAuth()
+    # unified retry schedule (retry.RetryPolicy): shared with every other
+    # network seam so backoff behavior can't drift per backend
+    self.retry = default_policy()
+
+  def _req(self, method, url, **kw):
+    return request(method, url, policy=self.retry, **kw)
 
   # -- helpers --------------------------------------------------------------
 
@@ -171,7 +178,7 @@ class GCSBackend:
       f"{self.endpoint}/upload/storage/v1/b/{quote_path(self.bucket)}/o"
       f"?uploadType=media&name={quote_path(self._name(key))}"
     )
-    status, _h, body = request(
+    status, _h, body = self._req(
       "POST", url, data=data,
       headers={
         "Content-Type": "application/octet-stream", **self.auth.header(),
@@ -186,7 +193,7 @@ class GCSBackend:
       f"{self.endpoint}/upload/storage/v1/b/{quote_path(self.bucket)}/o"
       f"?uploadType=resumable&name={quote_path(self._name(key))}"
     )
-    status, hdrs, body = request(
+    status, hdrs, body = self._req(
       "POST", url, data=b"",
       headers={"X-Upload-Content-Length": str(len(data)),
                **self.auth.header()},
@@ -201,7 +208,7 @@ class GCSBackend:
     for start in range(0, total, step):
       chunk = data[start : start + step]
       end = start + len(chunk) - 1
-      status, _h, body = request(
+      status, _h, body = self._req(
         "PUT", session, data=chunk,
         headers={"Content-Range": f"bytes {start}-{end}/{total}",
                  **self.auth.header()},
@@ -212,13 +219,13 @@ class GCSBackend:
         raise HttpError(status, session, body)
 
   def get(self, key: str) -> Optional[bytes]:
-    status, _h, body = request(
+    status, _h, body = self._req(
       "GET", self._obj_url(key, media=True), headers=self.auth.header()
     )
     return None if status == 404 else body
 
   def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
-    status, _h, body = request(
+    status, _h, body = self._req(
       "GET", self._obj_url(key, media=True),
       headers={
         "Range": f"bytes={start}-{start + length - 1}",
@@ -232,16 +239,16 @@ class GCSBackend:
     return body
 
   def exists(self, key: str) -> bool:
-    status, _h, _b = request(
+    status, _h, _b = self._req(
       "GET", self._obj_url(key), headers=self.auth.header()
     )
     return status == 200
 
   def delete(self, key: str):
-    request("DELETE", self._obj_url(key), headers=self.auth.header())
+    self._req("DELETE", self._obj_url(key), headers=self.auth.header())
 
   def size(self, key: str) -> Optional[int]:
-    status, _h, body = request(
+    status, _h, body = self._req(
       "GET", self._obj_url(key), headers=self.auth.header()
     )
     if status != 200:
@@ -259,7 +266,7 @@ class GCSBackend:
       )
       if token:
         url += f"&pageToken={quote_path(token)}"
-      status, _h, body = request("GET", url, headers=self.auth.header())
+      status, _h, body = self._req("GET", url, headers=self.auth.header())
       if status != 200:
         raise HttpError(status, url, body)
       payload = json.loads(body)

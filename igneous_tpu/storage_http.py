@@ -3,22 +3,23 @@
 stdlib-only (urllib) with the retry/backoff discipline both real object
 stores require: exponential backoff + jitter on connection errors, 429,
 and 5xx — the same policy cloud-files applies for the reference stack
-(SURVEY.md §2.2). gs:// (storage_gcs.py) and s3:// (storage_s3.py) ride
-this one transport so the policy can't drift between them.
+(SURVEY.md §2.2). gs:// (storage_gcs.py), s3:// (storage_s3.py), and
+the PCG client (graphene_http.py) ride this one transport so the policy
+can't drift between them; the schedule itself lives in retry.RetryPolicy
+(base/cap/jitter/budget, env-tunable) and every retry bumps the
+``retries.storage_http`` telemetry counter.
 """
 
 from __future__ import annotations
 
-import random
-import time
+import dataclasses
 import urllib.error
 import urllib.request
 from typing import Dict, Optional, Tuple
 
-RETRYABLE_STATUS = (408, 429, 500, 502, 503, 504)
-MAX_RETRIES = 6
-BACKOFF_BASE_S = 0.25
-BACKOFF_CAP_S = 30.0
+from .retry import RETRYABLE_STATUS, RetryPolicy, default_policy
+
+MAX_RETRIES = 6  # legacy alias; the live value is RetryPolicy.attempts
 
 
 class HttpError(Exception):
@@ -35,8 +36,9 @@ def request(
   headers: Optional[Dict[str, str]] = None,
   data: Optional[bytes] = None,
   timeout: float = 60.0,
-  retries: int = MAX_RETRIES,
+  retries: Optional[int] = None,
   allow_status: Tuple[int, ...] = (),
+  policy: Optional[RetryPolicy] = None,
 ) -> Tuple[int, Dict[str, str], bytes]:
   """One HTTP exchange with retry/backoff. Returns (status, headers, body).
 
@@ -45,9 +47,15 @@ def request(
   308 "resume incomplete" — but only that caller: a get() must never
   hand a redirect body back as object content); other non-retryable
   statuses raise HttpError; retryable statuses and connection errors
-  retry with exponential backoff + full jitter, then raise."""
+  retry per ``policy`` (default: retry.default_policy(), env-tunable
+  exponential backoff + full jitter + total-sleep budget), then raise.
+  ``retries`` overrides the policy's attempt count (legacy knob)."""
+  pol = policy or default_policy()
+  if retries is not None and retries != pol.attempts:
+    pol = dataclasses.replace(pol, attempts=retries)
+  retry_iter = pol.retries("storage_http")
   last_exc: Optional[Exception] = None
-  for attempt in range(retries):
+  while True:
     req = urllib.request.Request(
       url, data=data, method=method, headers=dict(headers or {})
     )
@@ -59,17 +67,13 @@ def request(
       # 404/416: caller maps to None/empty (urllib raises on non-2xx)
       if e.code in (404, 416) or e.code in allow_status:
         return e.code, dict(e.headers or {}), body
-      if e.code in RETRYABLE_STATUS and attempt + 1 < retries:
-        last_exc = HttpError(e.code, url, body)
-      else:
+      if e.code not in RETRYABLE_STATUS:
         raise HttpError(e.code, url, body) from None
+      last_exc = HttpError(e.code, url, body)
     except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-      if attempt + 1 >= retries:
-        raise
       last_exc = e
-    delay = min(BACKOFF_CAP_S, BACKOFF_BASE_S * (2**attempt))
-    time.sleep(random.random() * delay)
-  raise last_exc  # pragma: no cover - loop always returns or raises
+    if next(retry_iter, None) is None:  # attempts or sleep budget spent
+      raise last_exc
 
 
 def quote_path(segment: str) -> str:

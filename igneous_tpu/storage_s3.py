@@ -32,6 +32,7 @@ import urllib.parse
 from typing import Iterator, List, Optional, Tuple
 
 from . import secrets
+from .retry import default_policy
 from .storage_http import HttpError, request
 
 # env-tunable, read per call so tests exercise multipart with small payloads
@@ -194,6 +195,9 @@ class S3Backend:
     self.signer = (
       SigV4(akey, skey, self.region) if akey and skey else None
     )
+    # unified retry schedule (retry.RetryPolicy): shared with every other
+    # network seam so backoff behavior can't drift per backend
+    self.retry = default_policy()
 
   # -- helpers --------------------------------------------------------------
 
@@ -208,7 +212,7 @@ class S3Backend:
     headers = dict(headers or {})
     if self.signer is not None:
       headers = self.signer.sign(method, url, headers, data or b"")
-    return request(method, url, headers=headers, data=data)
+    return request(method, url, headers=headers, data=data, policy=self.retry)
 
   # -- interface ------------------------------------------------------------
 

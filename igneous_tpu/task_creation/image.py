@@ -40,9 +40,29 @@ def _resolve_auto_compress(compress, encoding, vol, mip):
   if compress != "auto":
     return compress
   enc = (encoding or vol.meta.encoding(mip)).lower()
-  if enc in ("raw", "compressed_segmentation", "compresso", "crackle"):
+  if enc in ("raw", "compressed_segmentation", "compresso",
+             "compresso-cpsx", "crackle"):
     return "gzip"
   return False
+
+
+def _warn_truncated_mips(factors, num_mips: int, shape, chunk_size):
+  """chunk_writable_factors quietly truncates the pyramid at the first
+  mip a task couldn't legally upload — which is correct, but operators
+  asking for num_mips deserve to learn their memory target (or explicit
+  shape) clamped the plan, not discover missing scales later."""
+  if len(factors) >= num_mips:
+    return
+  import warnings
+
+  warnings.warn(
+    f"requested num_mips={num_mips} but task shape "
+    f"{[int(v) for v in shape]} only supports {len(factors)} "
+    f"chunk-writable mip(s) (chunk {[int(v) for v in chunk_size]}); "
+    f"raise memory_target or pass a larger shape to plan the full "
+    f"pyramid, or re-run downsampling from the deepest produced mip",
+    stacklevel=3,
+  )
 
 
 def _provenance(vol: Volume, method: dict):
@@ -155,6 +175,10 @@ def create_downsampling_tasks(
       f"{list(chunk_size) if chunk_size is not None else vol.meta.chunk_size(mip).tolist()}); "
       f"raise memory_target or pass a larger/even shape"
     )
+  _warn_truncated_mips(
+    factors, num_mips, shape,
+    chunk_size if chunk_size is not None else vol.meta.chunk_size(mip),
+  )
   create_downsample_scales(
     vol.meta, mip, shape, factor,
     num_mips=len(factors),
@@ -363,6 +387,7 @@ def create_transfer_tasks(
         f"by {list(factor)} (chunk {list(dest_chunk)}); raise "
         f"memory_target, pass a larger/even shape, or num_mips=0"
       )
+    _warn_truncated_mips(factors, num_mips, shape, dest_chunk)
     create_downsample_scales(
       dest.meta, mip, shape, factor, num_mips=len(factors),
       chunk_size=dest_chunk, encoding=encoding,

@@ -18,6 +18,29 @@ from typing import Dict, Iterator, Optional
 
 _local = threading.local()
 
+# -- failure-containment counters (ISSUE 1) ----------------------------------
+# process-wide monotonic counters for retry/fault/DLQ events: cheap enough
+# to always collect, surfaced by `igneous queue status` and the chaos soak.
+
+_COUNTERS: Dict[str, int] = defaultdict(int)
+_COUNTERS_LOCK = threading.Lock()
+
+
+def incr(name: str, n: int = 1) -> None:
+  """Bump a named counter (e.g. "retries.storage_http", "dlq.promoted")."""
+  with _COUNTERS_LOCK:
+    _COUNTERS[name] += n
+
+
+def counters_snapshot() -> Dict[str, int]:
+  with _COUNTERS_LOCK:
+    return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+  with _COUNTERS_LOCK:
+    _COUNTERS.clear()
+
 
 def _stack():
   if not hasattr(_local, "stack"):

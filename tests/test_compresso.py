@@ -105,7 +105,9 @@ def test_volume_e2e_with_downsample(tmp_path, rng):
     data, path, chunk_size=(64, 64, 64), layer_type="segmentation",
     encoding="compresso",
   )
-  assert vol.meta.encoding(0) == "compresso"
+  # info advertises the experimental container name so external readers
+  # fail loudly instead of mis-decoding it as published compresso v3
+  assert vol.meta.encoding(0) == "compresso-cpsx"
   got = vol.download(vol.meta.bounds(0))
   assert np.array_equal(got[..., 0], data)
 
@@ -114,7 +116,7 @@ def test_volume_e2e_with_downsample(tmp_path, rng):
   )
   LocalTaskQueue(parallel=1, progress=False).insert(tasks)
   v1 = Volume(path, mip=1)
-  assert v1.meta.encoding(1) == "compresso"
+  assert v1.meta.encoding(1) == "compresso-cpsx"
   from igneous_tpu.ops import oracle
 
   exp = oracle.np_downsample_segmentation(data, (2, 2, 1), 1)[0]

