@@ -61,6 +61,12 @@ class ChaosConfig:
     worker gone. Not retryable in place; only redelivery recovers.
   drop_delete: queue.delete silently dropped (ack lost; task redelivers
     after its lease expires even though its work completed).
+  clock_skew: a lease is granted already-expired from the queue's point
+    of view (the worker's clock ran behind / NFS timestamps skewed) —
+    renewals and the final delete must be fenced as zombie actions.
+  stalled_worker: the worker stalls after finishing the work and wakes
+    only after its lease expired and the task was re-issued; its late
+    ack must be rejected (fenced) rather than double-completing.
   max_faults_per_key: transient faults per (op, key) before that seam
     heals — guarantees convergence.
   permanent: substring; keys containing it fail every time (poison).
@@ -72,6 +78,8 @@ class ChaosConfig:
   storm: float = 0.0
   crash_put: float = 0.0
   drop_delete: float = 0.0
+  clock_skew: float = 0.0
+  stalled_worker: float = 0.0
   max_faults_per_key: int = 2
   permanent: str = ""
   # occurrence counters, keyed (op, key) — instance state so two configs
@@ -168,6 +176,40 @@ class ChaosQueue:
     self.inner = inner
     self.config = config
 
+  def _backdate_lease(self, lease_id: str):
+    """Rename an fq:// lease so its deadline is already past — the
+    deterministic stand-in for 'this worker's view of the lease clock is
+    wrong' (skewed clock, or a stall that outlived the lease). Returns
+    the back-dated token, or None when the backend has no lease files
+    or another worker already recycled it."""
+    import os
+    import time
+
+    lease_dir = getattr(self.inner, "lease_dir", None)
+    if lease_dir is None or "--" not in str(lease_id):
+      return None
+    name = str(lease_id).split("--", 1)[1]
+    stale = f"{time.time() - 0.001:.3f}--{name}"
+    try:
+      os.rename(
+        os.path.join(lease_dir, lease_id), os.path.join(lease_dir, stale)
+      )
+    except FileNotFoundError:
+      return None
+    return stale
+
+  def lease(self, seconds: float = 600):
+    got = self.inner.lease(seconds)
+    if got is None:
+      return None
+    task, lease_id = got
+    name = str(lease_id).split("--", 1)[-1]
+    if self.config.should_fault("clock_skew", name, self.config.clock_skew):
+      stale = self._backdate_lease(lease_id)
+      if stale is not None:
+        lease_id = stale  # every later renew/delete on it must be fenced
+    return task, lease_id
+
   def delete(self, lease_id: str):
     # key by the task's stable name (after the lease prefix) so repeated
     # deliveries of one task share an occurrence counter
@@ -176,6 +218,14 @@ class ChaosQueue:
       "drop_delete", name, self.config.drop_delete
     ):
       return  # ack lost: lease expires, task redelivers
+    if self.config.should_fault(
+      "stalled_worker", name, self.config.stalled_worker
+    ):
+      # worker woke up after its lease aged out: the fenced delete must
+      # reject the late ack and the task redelivers to a live worker
+      stale = self._backdate_lease(lease_id)
+      if stale is not None:
+        return self.inner.delete(stale)
     return self.inner.delete(lease_id)
 
   def poll(self, *args, **kw):

@@ -1353,15 +1353,32 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
               help="Per-task wall-clock deadline in seconds; an overrun "
                    "counts as a failed delivery (recorded, then DLQ once "
                    "--max-deliveries is exhausted).")
+@click.option("--heartbeat-sec", "heartbeat_sec", default=None, type=float,
+              help="Renew held leases at this interval so long tasks "
+                   "outlive a short --lease-sec without double execution "
+                   "[default: $IGNEOUS_HEARTBEAT_SEC or lease/3; 0 "
+                   "disables].")
+@click.option("--drain-sentinel", default=None,
+              help="Preemption watcher: drain gracefully (finish the "
+                   "in-flight task, release the rest, exit 83) when this "
+                   "file appears [default: $IGNEOUS_PREEMPT_SENTINEL; "
+                   "SIGTERM/SIGINT and $IGNEOUS_PREEMPT_URL drain too].")
 @click.pass_context
 def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
             exit_on_empty, min_sec, quiet, timing, batch_size,
-            max_deliveries, task_deadline):
+            max_deliveries, task_deadline, heartbeat_sec, drain_sentinel):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
-  stay declarative (secrets.py)."""
-  from . import secrets
+  stay declarative (secrets.py).
+
+  Lifecycle: SIGTERM/SIGINT (or the preemption watcher) request a
+  graceful drain — the in-flight task finishes, still-leased batch
+  members are released, a final counters JSON line flushes, and the
+  worker exits 83 so schedulers can tell "preempted" from "failed"."""
+  import sys as sys_mod
+
+  from . import lifecycle, secrets
 
   queue_spec = queue_spec or secrets.queue_url()
   if not queue_spec:
@@ -1373,6 +1390,7 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp
+    import time as time_mod
 
     # divide cores among workers for native kernel threading (same
     # oversubscription hygiene as the reference's cv2.setNumThreads(0))
@@ -1385,27 +1403,53 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
         target=_execute_worker,
         args=(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
               timing, quiet, tally, batch_size, max_deliveries,
-              task_deadline),
+              task_deadline, heartbeat_sec, drain_sentinel),
       )
       for _ in range(parallel)
     ]
     for p in procs:
       p.start()
-    for p in procs:
-      p.join()
+    # forward a drain request to every child (k8s signals pid 1 only);
+    # each child runs its own graceful drain and exits 83
+    flag = lifecycle.StopFlag()
+    restore = lifecycle.install_signal_handlers(flag)
+    try:
+      while any(p.is_alive() for p in procs):
+        if flag.is_set():
+          for p in procs:
+            if p.is_alive():
+              p.terminate()  # SIGTERM → the child's own drain path
+          break
+        time_mod.sleep(0.2)
+      for p in procs:
+        p.join()
+    finally:
+      restore()
+    if flag.is_set() or any(
+      p.exitcode == lifecycle.EXIT_PREEMPTED for p in procs
+    ):
+      sys_mod.exit(lifecycle.EXIT_PREEMPTED)
     return
   _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
                   timing, quiet, tally, batch_size, max_deliveries,
-                  task_deadline)
+                  task_deadline, heartbeat_sec, drain_sentinel)
 
 
 def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
                     timing=False, quiet=False, tally=True, batch_size=1,
-                    max_deliveries=None, task_deadline=None):
+                    max_deliveries=None, task_deadline=None,
+                    heartbeat_sec=None, drain_sentinel=None):
+  import sys as sys_mod
   import time
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
+  from . import lifecycle, telemetry
   from .queues import TaskQueue
+
+  flag = lifecycle.StopFlag()
+  restore = lifecycle.install_signal_handlers(flag)
+  watcher = lifecycle.PreemptionWatcher(flag, sentinel=drain_sentinel)
+  watcher.start()
 
   tq = TaskQueue(queue_spec, max_deliveries=max_deliveries)
   start = time.time()
@@ -1434,44 +1478,55 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
       return True
     return False
 
-  if batch_size > 1:
-    from .parallel.lease_batcher import poll_batched
+  try:
+    if batch_size > 1:
+      from .parallel.lease_batcher import poll_batched
 
-    # honor --num-tasks / the min_sec==0 single-task special exactly: the
-    # lease loop must not lease past the remaining budget
-    task_budget = None
-    if num_tasks is not None and num_tasks >= 0:
-      task_budget = num_tasks
-    if min_sec == 0:
-      task_budget = 1 if task_budget is None else min(task_budget, 1)
-    executed, stats = poll_batched(
-      tq, batch_size=batch_size, lease_seconds=lease_sec,
-      verbose=not quiet, stop_fn=stop_fn, task_budget=task_budget,
-      timing=timing,  # per-ROUND JSON lines (tasks share dispatches)
-      task_deadline_seconds=task_deadline,
-    )
-    if not quiet:
-      click.echo(
-        f"executed {executed} tasks "
-        f"({stats['batched']} batched in "
-        f"{sum(stats['dispatches'].values())} dispatches, "
-        f"{stats['solo']} solo, {stats['failed']} failed)"
+      # honor --num-tasks / the min_sec==0 single-task special exactly:
+      # the lease loop must not lease past the remaining budget
+      task_budget = None
+      if num_tasks is not None and num_tasks >= 0:
+        task_budget = num_tasks
+      if min_sec == 0:
+        task_budget = 1 if task_budget is None else min(task_budget, 1)
+      executed, stats = poll_batched(
+        tq, batch_size=batch_size, lease_seconds=lease_sec,
+        verbose=not quiet, stop_fn=stop_fn, task_budget=task_budget,
+        timing=timing,  # per-ROUND JSON lines (tasks share dispatches)
+        task_deadline_seconds=task_deadline,
+        heartbeat_seconds=heartbeat_sec, drain_flag=flag,
       )
-    return
+      if not quiet:
+        click.echo(
+          f"executed {executed} tasks "
+          f"({stats['batched']} batched in "
+          f"{sum(stats['dispatches'].values())} dispatches, "
+          f"{stats['solo']} solo, {stats['failed']} failed, "
+          f"{stats['released']} released)"
+        )
+    else:
+      before_fn = after_fn = None
+      if timing:
+        from .telemetry import timed_poll_hooks
 
-  before_fn = after_fn = None
-  if timing:
-    from .telemetry import timed_poll_hooks
+        before_fn, after_fn = timed_poll_hooks()
 
-    before_fn, after_fn = timed_poll_hooks()
-
-  executed = tq.poll(
-    lease_seconds=lease_sec, verbose=not quiet, stop_fn=stop_fn,
-    before_fn=before_fn, after_fn=after_fn, tally=tally,
-    task_deadline_seconds=task_deadline,
-  )
-  if not quiet:
-    click.echo(f"executed {executed} tasks")
+      executed = tq.poll(
+        lease_seconds=lease_sec, verbose=not quiet, stop_fn=stop_fn,
+        before_fn=before_fn, after_fn=after_fn, tally=tally,
+        task_deadline_seconds=task_deadline,
+        heartbeat_seconds=heartbeat_sec, drain_flag=flag,
+      )
+      if not quiet:
+        click.echo(f"executed {executed} tasks")
+  finally:
+    watcher.stop()
+    restore()
+  if flag.is_set():
+    # last will: the counters line survives the pod for kubectl logs
+    telemetry.emit_counters(event="drain", reason=flag.reason,
+                            executed=executed)
+    sys_mod.exit(lifecycle.EXIT_PREEMPTED)
 
 
 @main.group("queue")
@@ -1493,6 +1548,9 @@ def queue_status(queue_spec, eta, sample_sec):
   click.echo(f"completed: {tq.completed}")
   if hasattr(tq, "dlq_count"):
     click.echo(f"dead-lettered: {tq.dlq_count}")
+  if hasattr(tq, "stale_leases"):
+    # zombie pressure: leases past expiry that no worker has recycled yet
+    click.echo(f"stale leases: {tq.stale_leases}")
   if hasattr(tq, "lease_ages"):
     ages = tq.lease_ages()
     if ages:
@@ -1539,11 +1597,22 @@ def queue_wait(queue_spec, interval, timeout, aws_region):
 
 @queue_group.command("release")
 @click.argument("queue_spec")
-def queue_release(queue_spec):
+@click.option("--reset-deliveries", is_flag=True,
+              help="Also zero delivery counts for tasks still in rotation "
+                   "so a --max-deliveries budget starts fresh (re-arm "
+                   "after a bad deploy burned deliveries on healthy "
+                   "tasks). fq:// only; DLQ'd tasks keep their counts.")
+def queue_release(queue_spec, reset_deliveries):
   """Drop all leases (crashed workers' tasks return immediately)."""
   from .queues import TaskQueue
 
-  TaskQueue(queue_spec).release_all()
+  tq = TaskQueue(queue_spec)
+  tq.release_all()
+  if reset_deliveries:
+    if not hasattr(tq, "reset_deliveries"):
+      raise click.UsageError("--reset-deliveries supports fq:// queues only")
+    n = tq.reset_deliveries()
+    click.echo(f"reset delivery counts for {n} tasks")
 
 
 @queue_group.command("purge")

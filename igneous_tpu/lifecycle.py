@@ -1,0 +1,173 @@
+"""Worker lifecycle: graceful preemption drain and zombie-safe exits.
+
+Preemptible TPU VMs — the deployment the ROADMAP north-star targets —
+kill workers with ~30s notice (GCE sends ACPI shutdown → SIGTERM via
+the node agent). PR 1 contained tasks that crash; this module contains
+workers that die: a drain request (signal, sentinel file, or the GCE
+preemption metadata endpoint) flips a StopFlag that the poll loops
+check between tasks, so the in-flight task finishes, still-leased batch
+members return to the queue immediately, a final telemetry-counters
+line is flushed, and the process exits EXIT_PREEMPTED — which the k8s
+deployment treats as "preempted, not failed" (no CrashLoopBackOff).
+
+The counterpart fencing — a *presumed-dead* worker that wakes up and
+tries to complete a task the queue re-issued — lives in the queue
+backends (FileQueue/SQSQueue delete/renew/nack reject stale lease
+tokens with ``zombie.*`` counters).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+# distinct from any Python/click failure code so the pod spec can map it:
+# preempted workers restart quietly, real failures alarm
+EXIT_PREEMPTED = 83
+
+
+class StopFlag:
+  """Thread-safe drain request; records the FIRST reason it was set."""
+
+  def __init__(self):
+    self._event = threading.Event()
+    self._lock = threading.Lock()
+    self.reason: Optional[str] = None
+
+  def set(self, reason: str = "stop"):
+    with self._lock:
+      if self.reason is None:
+        self.reason = reason
+    self._event.set()
+
+  def is_set(self) -> bool:
+    return self._event.is_set()
+
+  def wait(self, timeout: Optional[float] = None) -> bool:
+    return self._event.wait(timeout)
+
+
+def install_signal_handlers(flag: StopFlag, signals=None):
+  """Route SIGTERM/SIGINT into ``flag`` (graceful drain instead of an
+  abrupt death mid-lease). Returns a restore() callable that reinstates
+  the previous handlers — callers embedded in larger processes (tests,
+  notebooks) must not leak handlers. Safe to call off the main thread
+  (it becomes a no-op there; only processes own signal dispositions)."""
+  import signal as signal_mod
+
+  if signals is None:
+    signals = (signal_mod.SIGTERM, signal_mod.SIGINT)
+  previous = {}
+
+  def handler(signum, frame):
+    del frame
+    try:
+      name = signal_mod.Signals(signum).name
+    except ValueError:
+      name = f"signal-{signum}"
+    flag.set(name)
+
+  for sig in signals:
+    try:
+      previous[sig] = signal_mod.signal(sig, handler)
+    except (ValueError, OSError):  # not the main thread / unsupported sig
+      continue
+
+  def restore():
+    for sig, prev in previous.items():
+      try:
+        signal_mod.signal(sig, prev)
+      except (ValueError, OSError):
+        pass
+
+  return restore
+
+
+class PreemptionWatcher:
+  """Daemon thread that flips ``flag`` when preemption is announced.
+
+  Two pluggable sources, both optional (the watcher is inert without
+  either — signals still work):
+
+  * sentinel file (``IGNEOUS_PREEMPT_SENTINEL`` or ``sentinel=``): drain
+    when the path exists. This is how tests — and operators without a
+    metadata service — trigger a drain without signal delivery.
+  * metadata endpoint (``IGNEOUS_PREEMPT_URL`` or ``metadata_url=``):
+    polled with the ``Metadata-Flavor: Google`` header; a body of TRUE
+    means the VM is being preempted (GCE:
+    ``http://metadata.google.internal/computeMetadata/v1/instance/preempted``).
+    Never enabled by default — this build is zero-egress unless the
+    operator opts in.
+
+  Poll cadence: ``IGNEOUS_PREEMPT_POLL_SEC`` (default 1s); the first
+  check runs immediately on start.
+  """
+
+  def __init__(self, flag: StopFlag, sentinel: Optional[str] = None,
+               metadata_url: Optional[str] = None,
+               interval: Optional[float] = None):
+    self.flag = flag
+    self.sentinel = (
+      sentinel if sentinel is not None
+      else os.environ.get("IGNEOUS_PREEMPT_SENTINEL")
+    )
+    self.metadata_url = (
+      metadata_url if metadata_url is not None
+      else os.environ.get("IGNEOUS_PREEMPT_URL")
+    )
+    if interval is None:
+      interval = float(os.environ.get("IGNEOUS_PREEMPT_POLL_SEC", 1.0))
+    self.interval = float(interval)
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  def check(self) -> Optional[str]:
+    """One poll; returns the drain reason or None."""
+    if self.sentinel and os.path.exists(self.sentinel):
+      return "sentinel"
+    if self.metadata_url and self._metadata_preempted():
+      return "preempted"
+    return None
+
+  def _metadata_preempted(self) -> bool:
+    import urllib.request
+
+    try:
+      req = urllib.request.Request(
+        self.metadata_url, headers={"Metadata-Flavor": "Google"}
+      )
+      with urllib.request.urlopen(req, timeout=2) as resp:
+        return resp.read().strip().upper() == b"TRUE"
+    except Exception:
+      return False  # metadata hiccups must never kill a healthy worker
+
+  def _run(self):
+    while True:
+      reason = self.check()
+      if reason is not None:
+        self.flag.set(reason)
+        return
+      if self._stop.wait(self.interval):
+        return
+
+  def start(self):
+    if self._thread is not None or not (self.sentinel or self.metadata_url):
+      return self
+    self._thread = threading.Thread(
+      target=self._run, daemon=True, name="preemption-watcher"
+    )
+    self._thread.start()
+    return self
+
+  def stop(self):
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=5.0)
+      self._thread = None
+
+  __enter__ = start
+
+  def __exit__(self, *exc):
+    self.stop()
+    return False

@@ -69,12 +69,17 @@ def batched_downsample(
   mesh=None,
   method: str = "auto",
   bounds: Optional[Bbox] = None,
+  drain_flag=None,
 ) -> dict:
   """Downsample a whole layer with batched device dispatches.
 
   Creates destination scales (like create_downsampling_tasks), then
   processes the grid in K-cutout batches. Returns run statistics.
   ``bounds`` (at ``mip``) restricts the processed region.
+  ``drain_flag`` (anything with ``is_set()``, e.g. lifecycle.StopFlag):
+  graceful preemption — the in-flight batch's uploads finish, remaining
+  grid cells are skipped and reported via ``stats["drained"]`` so the
+  caller can resume with a bounds restriction or a task-queue pass.
   """
   from ..downsample_scales import create_downsample_scales
   from ..ops import pooling
@@ -101,10 +106,13 @@ def batched_downsample(
     # (same policy as batched_ccl_faces) — an XLA-CPU batch dispatch is
     # a ~9x pessimization on the most common task type
     stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
-             "native_cutouts": 0}
+             "native_cutouts": 0, "drained": False}
     from ..lib import chunk_bboxes
 
     for gbox in chunk_bboxes(bounds, shape, offset=bounds.minpt, clamp=False):
+      if drain_flag is not None and drain_flag.is_set():
+        stats["drained"] = True
+        break
       if Bbox.intersection(gbox, bounds).empty():
         continue
       DownsampleTask(
@@ -136,7 +144,13 @@ def batched_downsample(
     planes=2 if is_u64_mode else 1,
   )
 
-  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0}
+  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
+           "drained": False}
+
+  def draining() -> bool:
+    if drain_flag is not None and drain_flag.is_set():
+      stats["drained"] = True
+    return stats["drained"]
 
   def upload_batch(io_pool, boxes, mips_out):
     """Submit the uploads and return their futures — callers overlap them
@@ -176,6 +190,8 @@ def batched_downsample(
     )
     prev_uploads = []
     for i, batch in enumerate(batches):
+      if draining():
+        break
       imgs = [f.result() for f in pending]
       pending = (
         [io_pool.submit(vol.download, b) for b in batches[i + 1]]
@@ -192,6 +208,8 @@ def batched_downsample(
     # ragged edge cells: the standard per-task path (nominal grid shape —
     # the task clamps to bounds itself, keeping even pooling extents)
     for offset in edge_offsets:
+      if draining():
+        break
       DownsampleTask(
         layer_path=layer_path,
         mip=mip,

@@ -141,6 +141,8 @@ class LeaseBatcher:
     verbose: bool = False,
     timing: bool = False,
     task_deadline_seconds: Optional[float] = None,
+    heartbeat_seconds: Optional[float] = None,
+    drain_flag=None,
   ):
     self.queue = queue
     self.batch_size = int(batch_size)
@@ -150,16 +152,45 @@ class LeaseBatcher:
     # per-member wall-clock deadline for the solo/completion stages —
     # shares queues.filequeue.run_with_deadline with the solo poll loop
     self.task_deadline_seconds = task_deadline_seconds
+    # lease renewal while a round executes (a K-member round holds K
+    # leases across ONE long device dispatch — without renewal, short
+    # --lease-sec would re-issue the whole round mid-flight)
+    self.heartbeat_seconds = heartbeat_seconds
+    # graceful preemption: finish the member in flight, release the rest
+    self.drain_flag = drain_flag
     # --time equivalent for batched rounds: per-task stage timing makes
     # no sense when K tasks share one dispatch, so emit one JSON line
     # per lease ROUND instead (wall, members, dispatches delta)
     self.timing = timing
     self.stats = {
       "executed": 0, "batched": 0, "solo": 0, "failed": 0,
-      "group_fallbacks": 0,
+      "group_fallbacks": 0, "released": 0,
       "dispatches": defaultdict(int),
     }
     self._completed_in_group = set()
+    self._hb = None
+
+  def _draining(self) -> bool:
+    return self.drain_flag is not None and self.drain_flag.is_set()
+
+  def _current_id(self, lease_id):
+    """The member's CURRENT lease token (heartbeat renewals re-timestamp
+    fq:// tokens) — and stop renewing it: every caller is about to
+    delete, nack, or release the lease."""
+    return self._hb.untrack(lease_id) if self._hb is not None else lease_id
+
+  def _release_members(self, members):
+    """Drain path: hand still-leased members straight back to the queue
+    instead of letting their leases age out on a dead pod."""
+    from .. import telemetry
+
+    for _task, lease_id in members:
+      try:
+        self.queue.release(self._current_id(lease_id))
+      except Exception:
+        continue  # worst case the lease ages out, as before
+      self.stats["released"] += 1
+      telemetry.incr("drain.released")
 
   # -- poll loop ------------------------------------------------------------
 
@@ -177,6 +208,8 @@ class LeaseBatcher:
     rounds and would overshoot by up to batch_size-1)."""
     backoff = 1.0
     while True:
+      if self._draining():
+        return self.stats["executed"]
       if stop_fn is not None and stop_fn(
         executed=self.stats["executed"], empty=False
       ):
@@ -187,11 +220,16 @@ class LeaseBatcher:
         if cap <= 0:
           return self.stats["executed"]
       members = []
-      while len(members) < cap:
+      while len(members) < cap and not self._draining():
         leased = self.queue.lease(self.lease_seconds)
         if leased is None:
           break
         members.append(leased)
+      if self._draining():
+        # preempted between lease and dispatch: nothing ran, so every
+        # member goes straight back (no heartbeat is tracking them yet)
+        self._release_members(members)
+        return self.stats["executed"]
       if not members:
         if stop_fn is not None and stop_fn(
           executed=self.stats["executed"], empty=True
@@ -222,7 +260,26 @@ class LeaseBatcher:
         self.run_round(members)
 
   def run_round(self, members):
-    """Execute one lease round: group, dispatch groups, solo the rest."""
+    """Execute one lease round: group, dispatch groups, solo the rest.
+
+    All K leases are heartbeat-renewed for the duration of the round; a
+    drain request releases every member not yet started (groups not yet
+    dispatched, solo members not yet executing) back to the queue."""
+    from ..queues.heartbeat import LeaseHeartbeat
+
+    self._hb = LeaseHeartbeat(
+      self.queue, self.lease_seconds, interval=self.heartbeat_seconds
+    )
+    for _task, lease_id in members:
+      self._hb.track(lease_id)
+    self._hb.start()
+    try:
+      self._run_round_inner(members)
+    finally:
+      self._hb.stop()
+      self._hb = None
+
+  def _run_round_inner(self, members):
     volmeta_cache = {}
     groups = defaultdict(list)
     solo = []
@@ -237,6 +294,9 @@ class LeaseBatcher:
         groups[key].append((task, lease_id))
 
     for key, group in groups.items():
+      if self._draining():
+        self._release_members(group)
+        continue
       if len(group) == 1:
         solo.extend(group)
         continue
@@ -265,7 +325,10 @@ class LeaseBatcher:
           m for m in group if m[1] not in self._completed_in_group
         )
 
-    for task, lease_id in solo:
+    for i, (task, lease_id) in enumerate(solo):
+      if self._draining():
+        self._release_members(solo[i:])
+        return
       if self.verbose:
         print(f"Executing (solo) {task!r}")
       try:
@@ -273,7 +336,7 @@ class LeaseBatcher:
       except Exception as e:
         self._record_failure(lease_id, e)
         continue
-      self.queue.delete(lease_id)
+      self.queue.delete(self._current_id(lease_id))
       self.stats["executed"] += 1
       self.stats["solo"] += 1
 
@@ -293,12 +356,13 @@ class LeaseBatcher:
     telemetry.incr("tasks.failed")
     self.stats["failed"] += 1
     if hasattr(self.queue, "nack"):
-      self.queue.nack(lease_id, failure_reason(exc))
+      self.queue.nack(self._current_id(lease_id), failure_reason(exc))
 
   def _complete(self, lease_id):
-    self.queue.delete(lease_id)
+    self.queue.delete(self._current_id(lease_id))
     self.stats["executed"] += 1
     self.stats["batched"] += 1
+    # group membership tracks the ORIGINAL token (what handlers hold)
     self._completed_in_group.add(lease_id)
 
   def _finish_members(self, group, finish_one):
@@ -476,12 +540,15 @@ def poll_batched(
   task_budget: Optional[int] = None,
   timing: bool = False,
   task_deadline_seconds: Optional[float] = None,
+  heartbeat_seconds: Optional[float] = None,
+  drain_flag=None,
 ):
   """Functional entry point mirroring queues.filequeue.poll_loop."""
   batcher = LeaseBatcher(
     queue, batch_size=batch_size, lease_seconds=lease_seconds,
     mesh=mesh, verbose=verbose, timing=timing,
     task_deadline_seconds=task_deadline_seconds,
+    heartbeat_seconds=heartbeat_seconds, drain_flag=drain_flag,
   )
   executed = batcher.poll(
     stop_fn=stop_fn, max_backoff_window=max_backoff_window,

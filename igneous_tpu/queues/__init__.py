@@ -21,7 +21,8 @@ from .registry import (
   totask,
 )
 from .local import LocalTaskQueue, MockTaskQueue
-from .filequeue import FileQueue
+from .filequeue import FileQueue, StaleLeaseError, TaskDeadlineError
+from .heartbeat import LeaseHeartbeat
 from .queue import TaskQueue, copy_queue, move_queue, register_queue_protocol
 from .sqs import FakeSQSTransport, SQSQueue
 

@@ -39,6 +39,14 @@ class TaskDeadlineError(Exception):
   """A task overran its per-delivery wall-clock deadline (poll_loop)."""
 
 
+class StaleLeaseError(Exception):
+  """The lease behind a renew/delete no longer belongs to this worker —
+  it expired, or the queue re-issued the task to someone else. A worker
+  seeing this is a *zombie* for that task: it must stop acting on it
+  (the work itself is safe to discard — tasks are idempotent and the
+  current owner will complete it)."""
+
+
 def iter_tasks(tasks):
   """Normalize an insert() argument to an iterator of single tasks.
   Strings/bytes/dicts are single payloads, not collections — shared by
@@ -94,6 +102,8 @@ def poll_loop(
   before_fn=None,
   after_fn=None,
   task_deadline_seconds: Optional[float] = None,
+  heartbeat_seconds: Optional[float] = None,
+  drain_flag=None,
 ):
   """Shared worker loop: lease→execute→delete until stop_fn says stop or
   the queue drains (stop_fn=None polls forever, sleeping with bounded
@@ -104,45 +114,74 @@ def poll_loop(
   overrun) records its reason with the task via ``queue.nack`` when the
   backend supports it — feeding the same bookkeeping that promotes
   repeat offenders to the DLQ — and otherwise leaves the lease to
-  recycle on its visibility timeout, exactly as before."""
+  recycle on its visibility timeout, exactly as before.
+
+  Lifecycle (ISSUE 2): a heartbeat thread renews the held lease every
+  ``heartbeat_seconds`` (default lease/3, env IGNEOUS_HEARTBEAT_SEC;
+  <= 0 disables) so long tasks outlive a short ``--lease-sec`` without
+  being double-executed. ``drain_flag`` (anything with ``is_set()``,
+  e.g. lifecycle.StopFlag) requests graceful shutdown: the in-flight
+  task finishes, no new lease is taken."""
   from .. import telemetry
+  from .heartbeat import LeaseHeartbeat
+
+  def draining() -> bool:
+    return drain_flag is not None and drain_flag.is_set()
+
+  def idle(seconds: float):
+    # wake early when a drain request lands mid-backoff
+    if drain_flag is not None and hasattr(drain_flag, "wait"):
+      drain_flag.wait(seconds)
+    else:
+      time.sleep(seconds)
 
   backoff = 1.0
   executed = 0
-  while True:
-    if stop_fn is not None and stop_fn(executed=executed, empty=False):
-      return executed
-    leased = queue.lease(lease_seconds)
-    if leased is None:
-      if stop_fn is not None and stop_fn(executed=executed, empty=True):
+  hb = LeaseHeartbeat(queue, lease_seconds, interval=heartbeat_seconds)
+  with hb:
+    while True:
+      if draining():
         return executed
-      time.sleep(backoff + random.random())
-      backoff = min(backoff * 2, max_backoff_window)
-      continue
-    backoff = 1.0
-    task, lease_id = leased
-    if verbose:
-      print(f"Executing {task!r}")
-    try:
-      if before_fn:
-        before_fn(task)
-      run_with_deadline(task.execute, task_deadline_seconds)
-      if after_fn:
-        after_fn(task)
-    except Exception as e:
-      # leave the lease in place: the task recycles after the timeout
-      # (at-least-once semantics; matches reference behavior on failure).
-      # nack records the reason and quarantines exhausted tasks.
+      if stop_fn is not None and stop_fn(executed=executed, empty=False):
+        return executed
+      leased = queue.lease(lease_seconds)
+      if leased is None:
+        if stop_fn is not None and stop_fn(executed=executed, empty=True):
+          return executed
+        if draining():
+          return executed
+        idle(backoff + random.random())
+        backoff = min(backoff * 2, max_backoff_window)
+        continue
+      backoff = 1.0
+      task, lease_id = leased
+      key = hb.track(lease_id)
       if verbose:
-        import traceback
+        print(f"Executing {task!r}")
+      try:
+        if before_fn:
+          before_fn(task)
+        run_with_deadline(task.execute, task_deadline_seconds)
+        if after_fn:
+          after_fn(task)
+      except Exception as e:
+        # leave the lease in place: the task recycles after the timeout
+        # (at-least-once semantics; matches reference behavior on failure).
+        # nack records the reason and quarantines exhausted tasks.
+        if verbose:
+          import traceback
 
-        traceback.print_exc()
-      telemetry.incr("tasks.failed")
-      if hasattr(queue, "nack"):
-        queue.nack(lease_id, failure_reason(e))
-      continue
-    queue.delete(lease_id)
-    executed += 1
+          traceback.print_exc()
+        telemetry.incr("tasks.failed")
+        current = hb.untrack(key)
+        if hasattr(queue, "nack"):
+          queue.nack(current, failure_reason(e))
+        continue
+      # untrack returns the CURRENT lease token (heartbeat renewals
+      # re-timestamp fq:// lease names); delete is fenced against stale
+      # tokens, so a zombie's late ack can never complete a re-issued task
+      queue.delete(hb.untrack(key))
+      executed += 1
 
 
 class FileQueue:
@@ -180,9 +219,18 @@ class FileQueue:
 
   def _write_meta(self, name: str, meta: dict):
     tmp = os.path.join(self.path, f".tmp-meta-{uuid.uuid4().hex}")
-    with open(tmp, "w") as f:
-      json.dump(meta, f)
-    os.replace(tmp, self._meta_path(name))
+    try:
+      with open(tmp, "w") as f:
+        json.dump(meta, f)
+      os.replace(tmp, self._meta_path(name))
+    except BaseException:
+      # same turd-free contract as storage put(): a failed write must not
+      # leave .tmp-* files accumulating next to the counters
+      try:
+        os.remove(tmp)
+      except FileNotFoundError:
+        pass
+      raise
 
   def _drop_meta(self, name: str):
     try:
@@ -320,6 +368,31 @@ class FileQueue:
         continue
     return sorted(out)
 
+  @property
+  def stale_leases(self) -> int:
+    """Leases past expiry that no poll has recycled yet — the queue's
+    zombie pressure: each one is a worker that died, hung, or stopped
+    heartbeating (`igneous queue status` surfaces this)."""
+    return sum(1 for age in self.lease_ages() if age < 0)
+
+  def reset_deliveries(self) -> int:
+    """Zero the delivery count of every task still in rotation (queued or
+    leased) so a ``max_deliveries`` budget starts fresh — the operator
+    re-arm after a bad deploy burned deliveries on healthy tasks. DLQ'd
+    tasks keep their counts (``dlq retry`` already grants fresh budgets)."""
+    n = 0
+    quarantined = set(os.listdir(self.dlq_dir))
+    for name in list(os.listdir(self.meta_dir)):
+      if name in quarantined:
+        continue
+      meta = self._read_meta(name)
+      if not meta.get("deliveries"):
+        continue
+      meta["deliveries"] = 0
+      self._write_meta(name, meta)
+      n += 1
+    return n
+
   def fsck(self, repair: bool = False) -> dict:
     """Consistency audit: undeserializable task files (the same check
     lease() applies), unparseable lease names, counter drift. With
@@ -398,9 +471,16 @@ class FileQueue:
       payload = serialize(task)
       name = f"{uuid.uuid4().hex}.json"
       tmp = os.path.join(self.path, f".tmp-{name}")
-      with open(tmp, "w") as f:
-        f.write(payload)
-      os.replace(tmp, os.path.join(self.queue_dir, name))
+      try:
+        with open(tmp, "w") as f:
+          f.write(payload)
+        os.replace(tmp, os.path.join(self.queue_dir, name))
+      except BaseException:
+        try:
+          os.remove(tmp)
+        except FileNotFoundError:
+          pass
+        raise
       n += 1
     self._tally("insertions", n)
     return n
@@ -458,22 +538,81 @@ class FileQueue:
         return deserialize(f.read()), lease_name
     return None
 
-  def delete(self, lease_id: str):
+  def _lease_deadline(self, lease_id: str) -> Optional[float]:
+    try:
+      return float(str(lease_id).split(LEASE_SEP, 1)[0])
+    except ValueError:
+      return None
+
+  def renew(self, lease_id: str, seconds: float = 600) -> str:
+    """Extend a held lease's visibility timeout (the fq:// analogue of
+    SQS ChangeMessageVisibility) by re-timestamping the lease name.
+    Returns the NEW lease token — the old one is dead; callers (normally
+    a LeaseHeartbeat) must use the returned token from here on.
+
+    Zombie fencing: renewal is refused (StaleLeaseError + ``zombie.renew``
+    counter) once the lease has expired or the task was re-issued — a
+    stalled worker that wakes up cannot re-acquire what it lost."""
+    from .. import telemetry
+
+    deadline = self._lease_deadline(lease_id)
+    orig = str(lease_id).split(LEASE_SEP, 1)[-1]
+    if deadline is None or deadline < time.time():
+      telemetry.incr("zombie.renew")
+      raise StaleLeaseError(
+        f"lease for {orig!r} already expired; the task is due for re-issue"
+      )
+    new_id = f"{time.time() + seconds:.3f}{LEASE_SEP}{orig}"
+    try:
+      os.rename(
+        os.path.join(self.lease_dir, lease_id),
+        os.path.join(self.lease_dir, new_id),
+      )
+    except FileNotFoundError:
+      telemetry.incr("zombie.renew")
+      raise StaleLeaseError(
+        f"lease for {orig!r} was re-issued (or completed) by another worker"
+      ) from None
+    return new_id
+
+  def delete(self, lease_id: str) -> bool:
+    """Complete a task. Zombie-fenced: the delete (and its completion
+    tally) only lands while the lease token is current — a worker that
+    stalled past its lease and woke after the task was re-issued gets
+    False + a ``zombie.delete`` counter instead of double-completing
+    (the acceptance invariant: completions tally == task count)."""
+    from .. import telemetry
+
+    deadline = self._lease_deadline(lease_id)
+    if deadline is not None and deadline < time.time():
+      telemetry.incr("zombie.delete")
+      return False
     try:
       os.remove(os.path.join(self.lease_dir, lease_id))
     except FileNotFoundError:
-      pass
-    self._drop_meta(lease_id.split(LEASE_SEP, 1)[-1])
+      telemetry.incr("zombie.delete")
+      return False
+    self._drop_meta(str(lease_id).split(LEASE_SEP, 1)[-1])
     self._tally("completions")
+    return True
 
   def nack(self, lease_id: str, reason: str = "", requeue: bool = False):
     """Record a failed delivery. The failure reason persists with the
     task's metadata; once ``max_deliveries`` is exhausted the task moves
     to ``dlq/``. Otherwise the lease is left to recycle on its visibility
     timeout (at-least-once semantics unchanged) unless ``requeue=True``
-    returns it to rotation immediately."""
+    returns it to rotation immediately.
+
+    A nack whose lease was already re-issued (or completed) is dropped
+    with a ``zombie.nack`` counter — recording it would resurrect meta
+    for a task this worker no longer owns."""
     orig = lease_id.split(LEASE_SEP, 1)[-1]
     src = os.path.join(self.lease_dir, lease_id)
+    if not os.path.exists(src):
+      from .. import telemetry
+
+      telemetry.incr("zombie.nack")
+      return
     if self._exhausted(orig):
       self._quarantine_to_dlq(src, orig, reason)  # records the reason
     else:
@@ -517,6 +656,8 @@ class FileQueue:
     before_fn=None,
     after_fn=None,
     task_deadline_seconds: Optional[float] = None,
+    heartbeat_seconds: Optional[float] = None,
+    drain_flag=None,
   ):
     """Lease→execute→delete until stop_fn says stop or the queue drains
     (stop_fn=None polls forever, sleeping with bounded backoff when empty)."""
@@ -524,6 +665,7 @@ class FileQueue:
     return poll_loop(
       self, lease_seconds, verbose, stop_fn, max_backoff_window,
       before_fn, after_fn, task_deadline_seconds,
+      heartbeat_seconds, drain_flag,
     )
 
   def __len__(self):

@@ -63,7 +63,10 @@ class LocalTaskQueue:
   historical fail-fast behavior — the first exception propagates."""
 
   def __init__(self, parallel: int = 1, progress: bool = True,
-               max_deliveries: Optional[int] = None):
+               max_deliveries: Optional[int] = None, drain_flag=None):
+    """``drain_flag`` (anything with ``is_set()``): graceful preemption —
+    the in-flight task finishes, remaining tasks are left unexecuted
+    (mirrors the lease queues' drain contract for local runs)."""
     self.parallel = max(int(parallel), 1)
     self.progress = progress
     self.inserted = 0
@@ -73,6 +76,18 @@ class LocalTaskQueue:
       else int(max_deliveries)
     )
     self.dead_letters: list = []
+    self.drain_flag = drain_flag
+    self.drained = False
+
+  def _draining(self) -> bool:
+    if self.drain_flag is not None and self.drain_flag.is_set():
+      self.drained = True
+    return self.drained
+
+  def renew(self, lease_id, seconds: float = 600):
+    """No-op: local tasks execute in-process with no visibility timeout;
+    exists so the shared heartbeat/lifecycle plumbing is backend-uniform."""
+    return lease_id
 
   def _record_dead_letter(self, payload: str, error: str):
     from .. import telemetry
@@ -87,6 +102,8 @@ class LocalTaskQueue:
     )
     if self.parallel == 1:
       for payload in payloads:
+        if self._draining():
+          break
         self.inserted += 1
         if self.max_deliveries is None:
           _execute_payload(payload)
@@ -111,6 +128,8 @@ class LocalTaskQueue:
             self.inserted += 1
             self.completed += 1
             bar.update(1)
+            if self._draining():
+              break  # pool __exit__ terminates; unconsumed payloads stay
         else:
           import functools
 
@@ -126,6 +145,8 @@ class LocalTaskQueue:
             else:
               self.completed += 1
             bar.update(1)
+            if self._draining():
+              break
     bar.close()
 
   insert_all = insert

@@ -23,7 +23,7 @@ import time
 import uuid
 from typing import Iterable, Optional, Tuple
 
-from .filequeue import iter_tasks, poll_loop
+from .filequeue import StaleLeaseError, iter_tasks, poll_loop
 from .registry import RegisteredTask, deserialize, serialize
 
 EMPTY_CONFIRMATION_SEC = 120.0  # reference cli.py:858-861
@@ -282,12 +282,35 @@ class SQSQueue:
     self.transport.delete_message(receipt)
     telemetry.incr("dlq.promoted")
 
-  def delete(self, lease_id: str):
+  def renew(self, lease_id: str, seconds: float = 600) -> str:
+    """Extend the visibility timeout (ChangeMessageVisibility). The
+    receipt handle stays valid across renewals, so the token is returned
+    unchanged. A stale receipt — the message was redelivered elsewhere
+    while this worker stalled — raises StaleLeaseError (``zombie.renew``),
+    matching the fq:// fencing contract."""
+    from .. import telemetry
+
+    if not self.transport.change_visibility(lease_id, seconds):
+      telemetry.incr("zombie.renew")
+      raise StaleLeaseError(
+        "receipt no longer owns its message (redelivered after the "
+        "visibility timeout)"
+      )
+    return lease_id
+
+  def delete(self, lease_id: str) -> bool:
     body = self._receipt_body.pop(lease_id, None)
     if body is not None:
       self._failure_reasons.pop(body, None)
     if self.transport.delete_message(lease_id):
       self._completed += 1
+      return True
+    # stale receipt: the task outlived its visibility and was re-issued;
+    # this worker's late ack must not count as a completion
+    from .. import telemetry
+
+    telemetry.incr("zombie.delete")
+    return False
 
   def nack(self, lease_id: str, reason: str = "", requeue: bool = False):
     """Record a failed delivery. SQS keeps no per-message metadata, so
@@ -342,9 +365,12 @@ class SQSQueue:
     before_fn=None,
     after_fn=None,
     task_deadline_seconds: Optional[float] = None,
+    heartbeat_seconds: Optional[float] = None,
+    drain_flag=None,
   ):
     del tally
     return poll_loop(
       self, lease_seconds, verbose, stop_fn, max_backoff_window,
       before_fn, after_fn, task_deadline_seconds,
+      heartbeat_seconds, drain_flag,
     )

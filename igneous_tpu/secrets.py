@@ -26,6 +26,13 @@ def lease_seconds() -> int:
   return int(os.environ.get("LEASE_SECONDS", 600))
 
 
+def heartbeat_seconds() -> "float | None":
+  """Lease-renewal interval for workers. None (unset) lets the heartbeat
+  default to lease/3; 0 disables renewal entirely."""
+  val = os.environ.get("IGNEOUS_HEARTBEAT_SEC")
+  return None if val is None or val == "" else float(val)
+
+
 def secrets_dir() -> str:
   return os.environ.get(
     "IGNEOUS_TPU_SECRETS", os.path.expanduser("~/.cloudfiles/secrets")

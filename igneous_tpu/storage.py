@@ -184,9 +184,19 @@ class _FileBackend:
     path = self._fullpath(key)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + f".tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "wb") as f:
-      f.write(data)
-    os.replace(tmp, path)  # atomic within a filesystem
+    try:
+      with open(tmp, "wb") as f:
+        f.write(data)
+      os.replace(tmp, path)  # atomic within a filesystem
+    except BaseException:
+      # a failed write (ENOSPC, crash-injected fault, interrupt) must not
+      # strand .tmp.* turds next to real chunks — readers never see them,
+      # but they accumulate across retries and pollute byte-level audits
+      try:
+        os.remove(tmp)
+      except FileNotFoundError:
+        pass
+      raise
 
   def get(self, key: str) -> Optional[bytes]:
     try:

@@ -42,6 +42,15 @@ def reset_counters() -> None:
     _COUNTERS.clear()
 
 
+def emit_counters(event: str = "counters", **extra) -> dict:
+  """Flush the counters as one JSON line (stdout). Workers call this on
+  graceful drain so retry/zombie/DLQ tallies survive the pod — the line
+  is the worker's last will, greppable from `kubectl logs --previous`."""
+  record = {"event": event, **extra, "counters": counters_snapshot()}
+  print(json.dumps(record), flush=True)
+  return record
+
+
 def _stack():
   if not hasattr(_local, "stack"):
     _local.stack = []

@@ -1,0 +1,442 @@
+"""Worker lifecycle (ISSUE 2): lease heartbeats, graceful preemption
+drain, and zombie fencing.
+
+The scenarios here are what preemptible TPU fleets actually see: a task
+slower than its lease (must run exactly once thanks to heartbeat
+renewal), a SIGTERM/sentinel mid-batch (must finish the in-flight task,
+release the rest, and exit EXIT_PREEMPTED), and a stalled worker that
+wakes after its task was re-issued (its renew/delete/nack must be
+rejected with ``zombie.*`` counters, never double-completing).
+"""
+
+import os
+import time
+
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.chaos import ChaosConfig, ChaosQueue
+from igneous_tpu.lifecycle import (
+  EXIT_PREEMPTED,
+  PreemptionWatcher,
+  StopFlag,
+  install_signal_handlers,
+)
+from igneous_tpu.queues import (
+  FileQueue,
+  LeaseHeartbeat,
+  LocalTaskQueue,
+  PrintTask,
+  RegisteredTask,
+  StaleLeaseError,
+)
+from igneous_tpu.tasks import TouchFileTask
+
+
+class AppendSleepTask(RegisteredTask):
+  """Sleeps, then appends one byte — the file size counts executions."""
+
+  def __init__(self, path="", seconds=0.0):
+    self.path = path
+    self.seconds = seconds
+
+  def execute(self):
+    time.sleep(self.seconds)
+    with open(self.path, "ab") as f:
+      f.write(b"\x01")
+
+
+class SetDrainFlagTask(RegisteredTask):
+  """Trips the process-local drain flag mid-run (a preemption notice
+  arriving while a round executes)."""
+
+  flag = None  # injected by the test; not part of the wire params
+
+  def __init__(self, marker=""):
+    self.marker = marker
+
+  def execute(self):
+    if SetDrainFlagTask.flag is not None:
+      SetDrainFlagTask.flag.set("task")
+
+
+# -- lease renewal (the heartbeat's primitive) -------------------------------
+
+
+def test_renew_returns_new_token_and_kills_the_old(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(PrintTask("a"))
+  _task, lid = q.lease(seconds=0.5)
+  new = q.renew(lid, 60)
+  assert new != lid and q.leased == 1
+  assert q.lease_ages()[0] > 1  # visibility genuinely extended
+  with pytest.raises(StaleLeaseError):
+    q.renew(lid, 60)  # the old token is dead
+  assert q.delete(lid) is False  # and fenced
+  assert q.delete(new) is True
+  assert q.completed == 1
+
+
+def test_renew_rejected_after_expiry(tmp_path):
+  telemetry.reset_counters()
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(PrintTask("a"))
+  _task, lid = q.lease(seconds=0.02)
+  time.sleep(0.05)
+  with pytest.raises(StaleLeaseError):
+    q.renew(lid, 60)
+  assert telemetry.counters_snapshot().get("zombie.renew", 0) >= 1
+
+
+def test_heartbeat_renews_and_remaps_tokens(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(PrintTask("x"))
+  _task, lid = q.lease(seconds=0.5)
+  hb = LeaseHeartbeat(q, lease_seconds=5.0, interval=10.0)  # manual beats
+  key = hb.track(lid)
+  hb.beat()
+  cur = hb.current(key)
+  assert cur != lid and hb.renewals == 1
+  assert float(cur.split("--")[0]) > float(lid.split("--")[0])
+  assert q.delete(hb.untrack(key)) is True
+
+
+def test_heartbeat_marks_lost_leases(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(PrintTask("y"))
+  _task, lid = q.lease(seconds=0.02)
+  time.sleep(0.05)
+  hb = LeaseHeartbeat(q, lease_seconds=5.0, interval=10.0)
+  key = hb.track(lid)
+  hb.beat()
+  assert key in hb.lost
+  assert hb.current(key) == key  # identity once dropped
+
+
+def test_heartbeat_long_task_runs_exactly_once(tmp_path):
+  """THE heartbeat acceptance: a task that outlives --lease-sec must not
+  be re-delivered — one execution, one completion, zero zombie fences."""
+  telemetry.reset_counters()
+  marker = tmp_path / "runs"
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(AppendSleepTask(path=str(marker), seconds=0.9))
+  executed = q.poll(
+    lease_seconds=0.3, stop_fn=lambda executed, empty: empty,
+  )
+  assert executed == 1
+  assert marker.stat().st_size == 1  # exactly one execution
+  assert q.completed == 1 and q.is_empty()
+  assert telemetry.counters_snapshot().get("zombie.delete", 0) == 0
+
+
+def test_without_heartbeat_short_lease_is_fenced_then_contained(tmp_path):
+  """The control: heartbeats off, lease < task duration. Every late ack
+  is fenced (no double-tally), and the delivery budget promotes the
+  hopeless task to the DLQ instead of looping forever."""
+  telemetry.reset_counters()
+  marker = tmp_path / "runs"
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=2)
+  q.insert(AppendSleepTask(path=str(marker), seconds=0.4))
+  q.poll(
+    lease_seconds=0.1, heartbeat_seconds=0,
+    stop_fn=lambda executed, empty: empty,
+  )
+  assert marker.stat().st_size == 2  # each delivery really ran
+  assert q.completed == 0  # ...but no late ack ever tallied
+  assert q.dlq_count == 1
+  assert telemetry.counters_snapshot().get("zombie.delete", 0) >= 2
+
+
+# -- zombie fencing ----------------------------------------------------------
+
+
+def test_delete_fenced_after_reissue(tmp_path):
+  """A stalled worker wakes after its task went to someone else: its
+  delete must not complete (or double-tally) the task."""
+  telemetry.reset_counters()
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert(TouchFileTask(path=str(tmp_path / "t")))
+  _t1, lid1 = q.lease(seconds=0.05)
+  time.sleep(0.1)
+  t2, lid2 = q.lease(seconds=600)  # expired lease recycled + re-issued
+  t2.execute()
+  assert q.delete(lid1) is False  # the zombie's late ack
+  assert q.delete(lid2) is True   # the live owner's ack
+  assert q.completed == 1
+  assert telemetry.counters_snapshot().get("zombie.delete", 0) == 1
+
+
+def test_nack_after_reissue_is_dropped(tmp_path):
+  """A zombie's late nack must not resurrect meta for a completed task."""
+  telemetry.reset_counters()
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=5)
+  q.insert(TouchFileTask(path=str(tmp_path / "t")))
+  _t1, lid1 = q.lease(seconds=0.05)
+  time.sleep(0.1)
+  t2, lid2 = q.lease(seconds=600)
+  t2.execute()
+  q.delete(lid2)
+  assert os.listdir(q.meta_dir) == []
+  q.nack(lid1, "late failure from a zombie")
+  assert os.listdir(q.meta_dir) == []  # no meta resurrection
+  assert telemetry.counters_snapshot().get("zombie.nack", 0) == 1
+
+
+def test_sqs_renew_extends_and_stale_receipt_is_fenced():
+  from igneous_tpu.queues.sqs import FakeSQSTransport, SQSQueue
+
+  telemetry.reset_counters()
+  clock = [0.0]
+  tr = FakeSQSTransport(time_fn=lambda: clock[0])
+  q = SQSQueue(
+    "sqs://test", transport=tr,
+    empty_confirmation_sec=0.0, sleep_fn=lambda s: None,
+  )
+  q.insert(PrintTask("a"))
+  _task, receipt = q.lease(seconds=10.0)
+  clock[0] += 8.0
+  assert q.renew(receipt, 10.0) == receipt  # token stable on SQS
+  clock[0] += 9.0  # t=17 < 18: renewal held the message invisible
+  assert tr.receive_message(10.0) is None
+  clock[0] += 2.0  # past the renewed visibility: redelivered
+  got = q.lease(seconds=10.0)
+  assert got is not None
+  _task2, receipt2 = got
+  with pytest.raises(StaleLeaseError):
+    q.renew(receipt, 10.0)  # zombie receipt
+  assert q.delete(receipt) is False
+  assert q.delete(receipt2) is True
+  assert q.completed == 1
+  counters = telemetry.counters_snapshot()
+  assert counters.get("zombie.renew", 0) == 1
+  assert counters.get("zombie.delete", 0) == 1
+
+
+def test_chaos_clock_skew_and_stalled_worker_converge(tmp_path):
+  """The new chaos modes end in a fenced ack + healthy redelivery, with
+  exactly one completion."""
+  cfg = ChaosConfig(seed=1, clock_skew=1.0, max_faults_per_key=1)
+  q = ChaosQueue(FileQueue(f"fq://{tmp_path}/skew"), cfg)
+  q.insert(TouchFileTask(path=str(tmp_path / "t1")))
+  task, lid = q.lease(30)
+  task.execute()
+  assert q.inner.delete(lid) is False  # lease was granted already-expired
+  task, lid = q.lease(30)  # fault budget spent: healthy redelivery
+  task.execute()
+  assert q.inner.delete(lid) is True
+  assert q.inner.completed == 1
+
+  cfg2 = ChaosConfig(seed=2, stalled_worker=1.0, max_faults_per_key=1)
+  q2 = ChaosQueue(FileQueue(f"fq://{tmp_path}/stall"), cfg2)
+  q2.insert(TouchFileTask(path=str(tmp_path / "t2")))
+  task, lid = q2.lease(30)
+  task.execute()
+  assert q2.delete(lid) is False  # stalled past the lease: ack fenced
+  task, lid = q2.lease(30)
+  task.execute()
+  assert q2.delete(lid) is True
+  assert q2.inner.completed == 1
+
+
+# -- graceful drain ----------------------------------------------------------
+
+
+def test_poll_loop_drain_finishes_inflight_only(tmp_path):
+  flag = StopFlag()
+  SetDrainFlagTask.flag = flag
+  try:
+    q = FileQueue(f"fq://{tmp_path}/q")
+    q.insert([SetDrainFlagTask()] + [
+      TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(4)
+    ])
+    executed = q.poll(
+      lease_seconds=30, stop_fn=lambda executed, empty: empty,
+      drain_flag=flag,
+    )
+    assert flag.is_set() and flag.reason == "task"
+    assert 1 <= executed <= 5
+    assert q.completed == executed
+    assert q.leased == 0  # the in-flight task completed, none stranded
+    assert q.enqueued == 5 - executed
+  finally:
+    SetDrainFlagTask.flag = None
+
+
+def test_batcher_drain_releases_unstarted_members(tmp_path):
+  """SIGTERM mid-batch: members not yet started go straight back to the
+  queue instead of aging out on a dead pod."""
+  from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+
+  telemetry.reset_counters()
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([PrintTask(str(i)) for i in range(4)])
+  members = [q.lease(30) for _ in range(4)]
+  assert q.leased == 4
+  flag = StopFlag()
+  flag.set("SIGTERM")
+  b = LeaseBatcher(q, batch_size=4, lease_seconds=30, drain_flag=flag)
+  b.run_round(members)
+  assert b.stats["released"] == 4 and b.stats["executed"] == 0
+  assert q.leased == 0 and len(os.listdir(q.queue_dir)) == 4
+  assert telemetry.counters_snapshot().get("drain.released", 0) == 4
+
+
+def test_batcher_drain_mid_round_then_rerun_completes(tmp_path):
+  """Preemption lands while a round executes: the member in flight
+  finishes, the rest are released, and a fresh worker completes them."""
+  from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+
+  flag = StopFlag()
+  SetDrainFlagTask.flag = flag
+  try:
+    q = FileQueue(f"fq://{tmp_path}/q")
+    q.insert([SetDrainFlagTask()] + [
+      TouchFileTask(path=str(tmp_path / f"m{i}")) for i in range(5)
+    ])
+    b = LeaseBatcher(q, batch_size=6, lease_seconds=30, drain_flag=flag)
+    b.poll(stop_fn=lambda executed, empty: empty)
+    assert flag.is_set()
+    assert b.stats["executed"] + b.stats["released"] == 6
+    assert b.stats["executed"] >= 1  # the flag-setter itself completed
+    assert q.leased == 0
+    assert q.enqueued == b.stats["released"]
+
+    b2 = LeaseBatcher(q, batch_size=6, lease_seconds=30)
+    b2.poll(stop_fn=lambda executed, empty: empty)
+    assert q.is_empty() and q.completed == 6
+    assert all(os.path.exists(tmp_path / f"m{i}") for i in range(5))
+  finally:
+    SetDrainFlagTask.flag = None
+
+
+def test_local_queue_drain_and_renew_noop(tmp_path):
+  flag = StopFlag()
+  SetDrainFlagTask.flag = flag
+  try:
+    tq = LocalTaskQueue(parallel=1, progress=False, drain_flag=flag)
+    assert tq.renew("anything") == "anything"
+    tq.insert([
+      TouchFileTask(path=str(tmp_path / "a")),
+      SetDrainFlagTask(),
+      TouchFileTask(path=str(tmp_path / "b")),
+    ])
+    assert tq.drained
+    assert tq.completed == 2  # a + the flag setter; b never started
+    assert os.path.exists(tmp_path / "a")
+    assert not os.path.exists(tmp_path / "b")
+  finally:
+    SetDrainFlagTask.flag = None
+
+
+def test_install_signal_handlers_sets_flag_and_restores():
+  import signal
+
+  flag = StopFlag()
+  restore = install_signal_handlers(flag)
+  try:
+    os.kill(os.getpid(), signal.SIGTERM)
+    deadline = time.time() + 2
+    while not flag.is_set() and time.time() < deadline:
+      time.sleep(0.01)
+    assert flag.is_set() and flag.reason == "SIGTERM"
+  finally:
+    restore()
+  assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_preemption_watcher_sentinel(tmp_path):
+  flag = StopFlag()
+  watcher = PreemptionWatcher(
+    flag, sentinel=str(tmp_path / "preempt"), interval=0.02
+  )
+  watcher.start()
+  try:
+    time.sleep(0.08)
+    assert not flag.is_set()
+    (tmp_path / "preempt").write_text("now")
+    deadline = time.time() + 2
+    while not flag.is_set() and time.time() < deadline:
+      time.sleep(0.01)
+    assert flag.is_set() and flag.reason == "sentinel"
+  finally:
+    watcher.stop()
+
+
+def test_execute_cli_drain_sentinel_exits_preempted(tmp_path, monkeypatch):
+  """End to end: the sentinel flips the watcher, the worker drains,
+  flushes a counters line, and exits the distinct preemption code."""
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  monkeypatch.setenv("IGNEOUS_PREEMPT_POLL_SEC", "0.02")
+  spec = f"fq://{tmp_path}/q"
+  FileQueue(spec).insert([PrintTask(str(i)) for i in range(20)])
+  sentinel = tmp_path / "preempt"
+  sentinel.write_text("now")
+  r = CliRunner().invoke(main, [
+    "execute", spec, "--exit-on-empty", "--quiet", "--lease-sec", "30",
+    "--drain-sentinel", str(sentinel),
+  ])
+  assert r.exit_code == EXIT_PREEMPTED, r.output
+  assert '"event": "drain"' in r.output  # the final counters flush
+  q = FileQueue(spec)
+  assert q.enqueued > 0  # drained long before finishing the queue
+  assert q.leased == 0   # nothing left stranded on a lease
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_queue_release_reset_deliveries_cli(tmp_path):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  spec = f"fq://{tmp_path}/q"
+  q = FileQueue(spec, max_deliveries=3)
+  q.insert([PrintTask("a"), PrintTask("b")])
+  q.lease(600)
+  q.lease(600)  # both delivery counts now 1
+  r = CliRunner().invoke(main, [
+    "queue", "release", spec, "--reset-deliveries",
+  ])
+  assert r.exit_code == 0, r.output
+  assert "reset delivery counts for 2 tasks" in r.output
+  assert q.leased == 0 and q.enqueued == 2
+  for name in os.listdir(q.queue_dir):
+    assert q.delivery_count(name) == 0
+
+
+def test_queue_status_reports_stale_leases(tmp_path):
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main
+
+  spec = f"fq://{tmp_path}/q"
+  q = FileQueue(spec)
+  q.insert([PrintTask("a"), PrintTask("b")])
+  q.lease(seconds=0.01)
+  q.lease(seconds=600)
+  time.sleep(0.05)
+  assert q.stale_leases == 1
+  r = CliRunner().invoke(main, ["queue", "status", spec])
+  assert r.exit_code == 0, r.output
+  assert "stale leases: 1" in r.output
+
+
+def test_filebackend_put_failure_leaves_no_tmp(tmp_path):
+  from igneous_tpu.storage import _FileBackend
+
+  backend = _FileBackend(str(tmp_path))
+  with pytest.raises(TypeError):
+    backend.put("chunk", None)  # write(None) raises mid-put
+  assert os.listdir(tmp_path) == []  # no .tmp.* turd left behind
+
+
+def test_meta_write_failure_leaves_no_tmp(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  with pytest.raises(TypeError):
+    q._write_meta("x.json", {"bad": {1, 2}})  # sets aren't JSON
+  assert not [f for f in os.listdir(q.path) if f.startswith(".tmp")]
+  assert os.listdir(q.meta_dir) == []

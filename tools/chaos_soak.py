@@ -1,25 +1,35 @@
 #!/usr/bin/env python
 """Chaos soak: a downsample pipeline under injected faults must produce
-byte-identical output to a fault-free run (ISSUE 1 acceptance).
+byte-identical output to a fault-free run (ISSUE 1 + ISSUE 2 acceptance).
 
-Two runs over the same synthetic volume:
+``--scenario faults`` (default) — two runs over the same synthetic volume:
 
   1. CLEAN  — ingest, create downsample tasks, drain an fq:// queue.
   2. CHAOS  — identical pipeline, but every storage backend is wrapped in
      igneous_tpu.chaos.ChaosStorage (transient failed puts, corrupted
      gets, 503 storms, a hard crash-between-compute-and-upload) and the
-     queue in ChaosQueue (dropped lease deletes). Failed deliveries
-     recycle on a short lease; transient faults heal after a bounded
-     number of occurrences, so the queue drains.
+     queue in ChaosQueue (dropped lease deletes, skewed lease clocks,
+     stalled-then-resumed workers whose late acks must be fenced).
+     Failed deliveries recycle on a short lease; transient faults heal
+     after a bounded number of occurrences, so the queue drains.
+
+``--scenario preemption`` — a worker-lifecycle storm (ISSUE 2): real
+worker subprocesses drain the queue while the parent SIGTERMs one at a
+seeded random point (it must drain gracefully: finish the in-flight
+task, exit EXIT_PREEMPTED) and SIGKILLs another (its leases must recycle
+to the survivors), plus one stalled-then-resumed zombie whose lease is
+re-issued mid-stall and whose late delete must be fenced. The output
+must be byte-identical to a clean run with ZERO duplicate completions in
+the tally (completions == tasks exactly).
 
 The idempotency contract (tasks write deterministic bytes to disjoint
-keys; gzip with mtime=0) makes the comparison exact: every chunk of the
-chaos run must equal the clean run byte for byte. A third phase drops a
-poison task into a --max-deliveries queue and asserts it lands in the
-DLQ with its failure reason recoverable.
+keys; gzip with mtime=0) makes the comparison exact. The faults scenario
+ends with a poison phase: a task that raises on every delivery must land
+in the DLQ with its failure reason recoverable.
 
 Usage:
   python tools/chaos_soak.py --seed 7 [--size 96] [--keep]
+                             [--scenario faults|preemption|all]
 
 Exit code 0 = all assertions held. The seed names a deterministic fault
 schedule — a failing seed reproduces exactly.
@@ -33,7 +43,8 @@ import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
 import numpy as np  # noqa: E402
 
@@ -55,10 +66,14 @@ def make_tasks(path):
 
 def layer_bytes(root):
   """Every chunk/info object under a layer dir (provenance excluded: it
-  embeds wall-clock dates by design)."""
+  embeds wall-clock dates by design; in-flight ``.tmp.*`` atomic-write
+  files excluded too — a SIGKILLed worker can leave one behind, and
+  readers never see them)."""
   out = {}
   for dirpath, _dirs, files in os.walk(root):
     for fname in files:
+      if ".tmp." in fname:
+        continue
       full = os.path.join(dirpath, fname)
       rel = os.path.relpath(full, root)
       if rel.startswith("provenance"):
@@ -89,10 +104,10 @@ def drain(queue, lease_seconds=0.5, deadline=120.0):
   )
 
 
-def run_pipeline(workdir, img, chaos_cfg=None, tag=""):
+def run_pipeline(workdir, img, chaos_cfg=None, tag="", task_fn=None):
   layer = f"file://{workdir}/layer"
   Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
-  tasks = make_tasks(layer)
+  tasks = (task_fn or make_tasks)(layer)
   q = FileQueue(f"fq://{workdir}/q", max_deliveries=25)
   q.insert(tasks)
   if chaos_cfg is None:
@@ -121,6 +136,202 @@ def poison_phase(workdir):
   return rec
 
 
+def run_faults_scenario(scratch, img, seed):
+  """ISSUE 1 acceptance: fault storm vs clean run, byte-identical; then
+  the poison task must end in the DLQ."""
+  n_clean, clean = run_pipeline(
+    os.path.join(scratch, "clean"), img, tag="clean"
+  )
+
+  cfg = ChaosConfig(
+    seed=seed,
+    put_fail=0.15,        # transient 503 on upload
+    get_corrupt=0.10,     # bit-flipped download (gzip CRC catches it)
+    storm=0.05,           # 503 on any op
+    crash_put=0.10,       # worker dies between compute and upload
+    drop_delete=0.20,     # completed task's ack lost -> duplicate run
+    clock_skew=0.10,      # lease granted already-expired (skewed clock)
+    stalled_worker=0.10,  # late ack after re-issue -> must be fenced
+    max_faults_per_key=2,
+  )
+  n_chaos, chaos = run_pipeline(
+    os.path.join(scratch, "chaos"), img, chaos_cfg=cfg, tag="chaos"
+  )
+
+  missing = sorted(set(clean) - set(chaos))
+  extra = sorted(set(chaos) - set(clean))
+  assert not missing and not extra, (
+    f"key sets differ: missing={missing[:5]} extra={extra[:5]}"
+  )
+  diff = [k for k in clean if clean[k] != chaos[k]]
+  assert not diff, f"{len(diff)} objects differ byte-wise: {diff[:5]}"
+
+  poison = poison_phase(scratch)
+
+  counters = telemetry.counters_snapshot()
+  injected = sum(v for k, v in counters.items() if k.startswith("chaos."))
+  assert injected > 0, "chaos layer injected no faults — soak proved nothing"
+
+  return {
+    "objects_compared": len(clean),
+    "clean_executed": n_clean,
+    "chaos_executed": n_chaos,
+    "faults_injected": injected,
+    "dlq_poison_deliveries": poison["deliveries"],
+    "byte_identical": True,
+  }
+
+
+# one real worker process: graceful-drain wiring identical to `igneous
+# execute` (StopFlag + signal handlers + heartbeats), plus a per-task
+# delay so the storm reliably catches workers mid-run, and a ready-file
+# touched once handlers are live (signals before that would just kill the
+# interpreter mid-import, which is the SIGKILL case, not the drain case)
+_STORM_WORKER_SRC = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import igneous_tpu.tasks  # register task classes
+from igneous_tpu import lifecycle
+from igneous_tpu.queues import FileQueue
+
+spec, lease_sec, task_delay, hb_sec, ready_path = (
+  sys.argv[1], float(sys.argv[2]), float(sys.argv[3]), float(sys.argv[4]),
+  sys.argv[5],
+)
+flag = lifecycle.StopFlag()
+lifecycle.install_signal_handlers(flag)
+q = FileQueue(spec)
+with open(ready_path, "w") as f:
+  f.write(str(os.getpid()))
+q.poll(
+  lease_seconds=lease_sec,
+  verbose=False,
+  stop_fn=lambda executed, empty: empty and q.enqueued == 0,
+  max_backoff_window=0.2,
+  before_fn=lambda task: time.sleep(task_delay),
+  drain_flag=flag,
+  heartbeat_seconds=hb_sec,
+)
+sys.exit(lifecycle.EXIT_PREEMPTED if flag.is_set() else 0)
+"""
+
+
+def run_preemption_storm(scratch, img, seed):
+  """ISSUE 2 acceptance: SIGTERM/SIGKILL workers at seeded random points
+  plus one stalled-then-resumed zombie; output byte-identical to a clean
+  run, zero duplicate task completions in the tally."""
+  import random
+  import signal
+  import subprocess
+
+  from igneous_tpu import lifecycle
+
+  del img  # the storm needs a real task GRID, not --size's single cell:
+  # a one-task queue makes kill timing meaningless. 160x160x64 fans out
+  # to an 18-task grid at this memory target regardless of --size.
+  rng_img = np.random.default_rng(seed)
+  img = rng_img.integers(0, 255, (160, 160, 64)).astype(np.uint8)
+
+  def storm_tasks(path):
+    return list(tc.create_downsampling_tasks(
+      path, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
+    ))
+
+  n_clean, clean = run_pipeline(
+    os.path.join(scratch, "storm-clean"), img, tag="storm-clean",
+    task_fn=storm_tasks,
+  )
+
+  workdir = os.path.join(scratch, "storm")
+  layer = f"file://{workdir}/layer"
+  Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
+  tasks = storm_tasks(layer)
+  spec = f"fq://{workdir}/q"
+  q = FileQueue(spec)
+  n_tasks = q.insert(tasks)
+  assert n_tasks >= 8, f"storm needs a task grid, got {n_tasks}"
+
+  # the stalled zombie: lease a task, DO the work, then stall past the
+  # lease while the storm re-issues and completes it; the late ack at the
+  # end must be fenced (this is what keeps the completions tally exact)
+  zombie = q.lease(1.0)
+  assert zombie is not None
+  ztask, zlease = zombie
+  ztask.execute()
+
+  rng = random.Random(seed)
+  env = dict(os.environ, JAX_PLATFORMS="cpu")
+  env["PYTHONPATH"] = (
+    REPO_ROOT + os.pathsep + env["PYTHONPATH"]
+    if env.get("PYTHONPATH") else REPO_ROOT
+  )
+  ready = [os.path.join(workdir, f"ready-{i}") for i in range(3)]
+  workers = [
+    subprocess.Popen(
+      [sys.executable, "-c", _STORM_WORKER_SRC,
+       spec, "1.5", "0.25", "0.3", ready[i]],
+      env=env,
+    )
+    for i in range(3)
+  ]
+  deadline = time.monotonic() + 180
+  while time.monotonic() < deadline and not all(
+    os.path.exists(r) for r in ready
+  ):
+    time.sleep(0.05)
+  assert all(os.path.exists(r) for r in ready), "storm workers never started"
+
+  # seeded random kill points, once the fleet is actually processing
+  time.sleep(rng.uniform(0.2, 0.8))
+  workers[0].send_signal(signal.SIGTERM)  # graceful drain expected
+  time.sleep(rng.uniform(0.2, 0.8))
+  if workers[1].poll() is None:
+    workers[1].send_signal(signal.SIGKILL)  # hard death: leases recycle
+  exit_codes = [w.wait(timeout=300) for w in workers]
+
+  # a SIGTERM delivered to a live worker must drain, not fail (0 covers
+  # the rare case it finished the queue before the signal landed)
+  assert exit_codes[0] in (lifecycle.EXIT_PREEMPTED, 0), exit_codes
+  assert exit_codes[1] in (-signal.SIGKILL, 0), exit_codes
+
+  # backstop: recycle anything the SIGKILLed worker stranded and finish
+  drain(q, lease_seconds=1.5, deadline=180.0)
+  assert q.is_empty(), "storm queue not drained"
+
+  # the zombie wakes: its lease expired and the task was re-issued and
+  # completed by a live worker — the late delete must be rejected
+  completed_before = q.completed
+  assert q.delete(zlease) is False, "zombie delete was not fenced"
+  assert q.completed == completed_before
+  zombie_fences = telemetry.counters_snapshot().get("zombie.delete", 0)
+  assert zombie_fences >= 1
+
+  # ZERO duplicate completions: the tally counts each task exactly once,
+  # despite kills, redeliveries, and the zombie
+  assert q.completed == n_tasks, (
+    f"duplicate/lost completions: tally={q.completed} tasks={n_tasks}"
+  )
+
+  storm = layer_bytes(os.path.join(workdir, "layer"))
+  missing = sorted(set(clean) - set(storm))
+  extra = sorted(set(storm) - set(clean))
+  assert not missing and not extra, (
+    f"key sets differ: missing={missing[:5]} extra={extra[:5]}"
+  )
+  diff = [k for k in clean if clean[k] != storm[k]]
+  assert not diff, f"{len(diff)} objects differ byte-wise: {diff[:5]}"
+
+  return {
+    "tasks": n_tasks,
+    "clean_executed": n_clean,
+    "worker_exit_codes": exit_codes,
+    "completions_tally": q.completed,
+    "zombie_delete_fenced": zombie_fences,
+    "objects_compared": len(clean),
+    "byte_identical": True,
+  }
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--seed", type=int, default=0,
@@ -129,6 +340,10 @@ def main():
                   help="cube edge of the synthetic volume")
   ap.add_argument("--keep", action="store_true",
                   help="keep the scratch dir for inspection")
+  ap.add_argument("--scenario", choices=("faults", "preemption", "all"),
+                  default="faults",
+                  help="faults: ISSUE 1 storage/queue fault storm; "
+                       "preemption: ISSUE 2 worker kill storm + zombie")
   args = ap.parse_args()
 
   os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -140,48 +355,14 @@ def main():
     img = rng.integers(0, 255, (args.size, args.size, args.size // 2))
     img = img.astype(np.uint8)
 
-    n_clean, clean = run_pipeline(
-      os.path.join(scratch, "clean"), img, tag="clean"
-    )
-
-    cfg = ChaosConfig(
-      seed=args.seed,
-      put_fail=0.15,       # transient 503 on upload
-      get_corrupt=0.10,    # bit-flipped download (gzip CRC catches it)
-      storm=0.05,          # 503 on any op
-      crash_put=0.10,      # worker dies between compute and upload
-      drop_delete=0.20,    # completed task's ack lost -> duplicate run
-      max_faults_per_key=2,
-    )
-    n_chaos, chaos = run_pipeline(
-      os.path.join(scratch, "chaos"), img, chaos_cfg=cfg, tag="chaos"
-    )
-
-    missing = sorted(set(clean) - set(chaos))
-    extra = sorted(set(chaos) - set(clean))
-    assert not missing and not extra, (
-      f"key sets differ: missing={missing[:5]} extra={extra[:5]}"
-    )
-    diff = [k for k in clean if clean[k] != chaos[k]]
-    assert not diff, f"{len(diff)} objects differ byte-wise: {diff[:5]}"
-
-    poison = poison_phase(scratch)
-
-    counters = telemetry.counters_snapshot()
-    injected = sum(v for k, v in counters.items() if k.startswith("chaos."))
-    assert injected > 0, "chaos layer injected no faults — soak proved nothing"
-
-    print(json.dumps({
-      "seed": args.seed,
-      "objects_compared": len(clean),
-      "clean_executed": n_clean,
-      "chaos_executed": n_chaos,
-      "faults_injected": injected,
-      "dlq_poison_deliveries": poison["deliveries"],
-      "counters": counters,
-      "wall_s": round(time.monotonic() - t0, 2),
-      "byte_identical": True,
-    }, indent=2))
+    report = {"seed": args.seed, "scenario": args.scenario}
+    if args.scenario in ("faults", "all"):
+      report["faults"] = run_faults_scenario(scratch, img, args.seed)
+    if args.scenario in ("preemption", "all"):
+      report["preemption"] = run_preemption_storm(scratch, img, args.seed)
+    report["counters"] = telemetry.counters_snapshot()
+    report["wall_s"] = round(time.monotonic() - t0, 2)
+    print(json.dumps(report, indent=2))
   finally:
     if args.keep:
       print(f"scratch kept at {scratch}", file=sys.stderr)
