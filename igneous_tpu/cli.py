@@ -1363,10 +1363,16 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
                    "in-flight task, release the rest, exit 83) when this "
                    "file appears [default: $IGNEOUS_PREEMPT_SENTINEL; "
                    "SIGTERM/SIGINT and $IGNEOUS_PREEMPT_URL drain too].")
+@click.option("--pipeline/--no-pipeline", "pipeline", default=None,
+              help="Staged execution pipeline (ISSUE 3): thread each "
+                   "task's chunk encode/uploads and prefetch batched "
+                   "rounds' cutouts; byte-identical output, joined before "
+                   "every lease delete [default: $IGNEOUS_PIPELINE].")
 @click.pass_context
 def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
             exit_on_empty, min_sec, quiet, timing, batch_size,
-            max_deliveries, task_deadline, heartbeat_sec, drain_sentinel):
+            max_deliveries, task_deadline, heartbeat_sec, drain_sentinel,
+            pipeline):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
@@ -1387,6 +1393,9 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
     lease_sec = secrets.lease_seconds()
   if aws_region:
     os.environ["SQS_REGION_NAME"] = aws_region
+  if pipeline is not None:
+    # env (not a param thread) so spawned workers inherit the choice
+    os.environ["IGNEOUS_PIPELINE"] = "1" if pipeline else "off"
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp

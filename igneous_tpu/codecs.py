@@ -26,6 +26,11 @@ JPEG_DEFAULT_QUALITY = 85
 
 
 def encode_raw(img: np.ndarray) -> bytes:
+  # tobytes("F") on a strided view falls into numpy's element-wise slow
+  # path (~4x slower than memcpy); consolidating to F-order first keeps
+  # the whole encode at copy speed. Bytes are identical either way.
+  if not img.flags.f_contiguous:
+    img = np.asfortranarray(img)
   return img.tobytes("F")
 
 

@@ -104,24 +104,28 @@ def batched_downsample(
   if pooling._host_pool_active():
     # CPU-only host: per-cutout native pooling is the production path
     # (same policy as batched_ccl_faces) — an XLA-CPU batch dispatch is
-    # a ~9x pessimization on the most common task type
+    # a ~9x pessimization on the most common task type. The cutout
+    # stream still pipelines: downloads prefetch and chunk encodes
+    # thread while the native kernels pool (ISSUE 3).
     stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
              "native_cutouts": 0, "drained": False}
     from ..lib import chunk_bboxes
+    from ..pipeline import run_tasks_pipelined
 
-    for gbox in chunk_bboxes(bounds, shape, offset=bounds.minpt, clamp=False):
-      if drain_flag is not None and drain_flag.is_set():
-        stats["drained"] = True
-        break
-      if Bbox.intersection(gbox, bounds).empty():
-        continue
-      DownsampleTask(
-        layer_path=layer_path, mip=mip, shape=shape.tolist(),
-        offset=[int(v) for v in gbox.minpt], fill_missing=fill_missing,
-        sparse=sparse, num_mips=len(factors), factor=tuple(factor),
-        compress=compress, downsample_method=method,
-      ).execute()
-      stats["native_cutouts"] += 1
+    def native_tasks():
+      for gbox in chunk_bboxes(bounds, shape, offset=bounds.minpt, clamp=False):
+        if Bbox.intersection(gbox, bounds).empty():
+          continue
+        yield DownsampleTask(
+          layer_path=layer_path, mip=mip, shape=shape.tolist(),
+          offset=[int(v) for v in gbox.minpt], fill_missing=fill_missing,
+          sparse=sparse, num_mips=len(factors), factor=tuple(factor),
+          compress=compress, downsample_method=method,
+        )
+
+    run_stats = run_tasks_pipelined(native_tasks(), drain_flag=drain_flag)
+    stats["native_cutouts"] = run_stats["executed"]
+    stats["drained"] = run_stats["drained"]
     return stats
 
   full_boxes = []
@@ -152,10 +156,14 @@ def batched_downsample(
       stats["drained"] = True
     return stats["drained"]
 
-  def upload_batch(io_pool, boxes, mips_out):
-    """Submit the uploads and return their futures — callers overlap them
-    with the next batch's compute and only join one batch behind."""
-    futures = []
+  from ..pipeline import shared_encode_pool, shared_prefetch_pool
+
+  def upload_batch(boxes, mips_out):
+    """Route every chunk encode+put through the shared encode pool under
+    one ticket — callers overlap it with the next batch's compute and
+    only join one batch behind (ISSUE 3: the encode stage was the serial
+    tail of every device round)."""
+    ticket = shared_encode_pool().ticket()
     for mip_idx, batch_arr in enumerate(mips_out):
       f = Vec(*np.prod(np.asarray(factors[: mip_idx + 1]), axis=0))
       dest_mip = mip + mip_idx + 1
@@ -165,64 +173,70 @@ def batched_downsample(
         dest_box = Bbox(mn, mn + Vec(*arr.shape[:3]))
         dest_box = Bbox.intersection(dest_box, vol.meta.bounds(dest_mip))
         sl = tuple(slice(0, int(s)) for s in dest_box.size3())
-        futures.append(io_pool.submit(
-          vol.upload, dest_box, arr[sl].astype(vol.dtype, copy=False),
-          dest_mip, compress,
-        ))
-    return futures
+        vol.upload(
+          dest_box, arr[sl].astype(vol.dtype, copy=False),
+          dest_mip, compress, sink=ticket,
+        )
+    return ticket
 
-  def run_batch(io_pool, boxes, imgs):
+  def run_batch(boxes, imgs):
     mips_out = device_pyramid_batch(executor, imgs, is_u64_mode)
     stats["batched_cutouts"] += len(boxes)
     stats["dispatches"] += 1
-    return upload_batch(io_pool, boxes, mips_out)
+    return upload_batch(boxes, mips_out)
 
   # double buffering: batch i+1's downloads run while batch i computes
-  # and uploads
+  # and uploads (prefetch pool is distinct from the chunk-get pool the
+  # downloads fan out to — same-pool nesting would deadlock)
   batches = [
     full_boxes[i : i + batch_size]
     for i in range(0, len(full_boxes), batch_size)
   ]
-  with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+  io_pool = shared_prefetch_pool()
+  pending = (
+    [io_pool.submit(vol.download, b) for b in batches[0]]
+    if batches else []
+  )
+  prev_ticket = None
+  for i, batch in enumerate(batches):
+    if draining():
+      break
+    imgs = [f.result() for f in pending]
     pending = (
-      [io_pool.submit(vol.download, b) for b in batches[0]]
-      if batches else []
+      [io_pool.submit(vol.download, b) for b in batches[i + 1]]
+      if i + 1 < len(batches) else []
     )
-    prev_uploads = []
-    for i, batch in enumerate(batches):
-      if draining():
-        break
-      imgs = [f.result() for f in pending]
-      pending = (
-        [io_pool.submit(vol.download, b) for b in batches[i + 1]]
-        if i + 1 < len(batches) else []
-      )
-      # join batch i-1's uploads only now: they overlapped batch i's
-      # downloads and this batch's device dispatch
-      for fut in prev_uploads:
-        fut.result()
-      prev_uploads = run_batch(io_pool, batch, imgs)
-    for fut in prev_uploads:
-      fut.result()
+    # join batch i-1's uploads only now: they overlapped batch i's
+    # downloads and this batch's device dispatch
+    if prev_ticket is not None:
+      prev_ticket.join()
+    prev_ticket = run_batch(batch, imgs)
+  if prev_ticket is not None:
+    prev_ticket.join()
+  for f in pending:  # drained mid-stream: settle abandoned downloads
+    try:
+      f.result()
+    except Exception:  # noqa: BLE001 - nothing consumed them
+      pass
 
-    # ragged edge cells: the standard per-task path (nominal grid shape —
-    # the task clamps to bounds itself, keeping even pooling extents)
-    for offset in edge_offsets:
-      if draining():
-        break
-      DownsampleTask(
-        layer_path=layer_path,
-        mip=mip,
-        shape=shape.tolist(),
-        offset=[int(v) for v in offset],
-        fill_missing=fill_missing,
-        sparse=sparse,
-        num_mips=len(factors),
-        factor=tuple(factor),
-        compress=compress,
-        downsample_method=method,
-      ).execute()
-      stats["edge_cutouts"] += 1
+  # ragged edge cells: the standard per-task path (nominal grid shape —
+  # the task clamps to bounds itself, keeping even pooling extents)
+  for offset in edge_offsets:
+    if draining():
+      break
+    DownsampleTask(
+      layer_path=layer_path,
+      mip=mip,
+      shape=shape.tolist(),
+      offset=[int(v) for v in offset],
+      fill_missing=fill_missing,
+      sparse=sparse,
+      num_mips=len(factors),
+      factor=tuple(factor),
+      compress=compress,
+      downsample_method=method,
+    ).execute()
+    stats["edge_cutouts"] += 1
 
   return stats
 

@@ -29,7 +29,6 @@ solo within the same lease round.
 
 from __future__ import annotations
 
-import concurrent.futures as cf
 import random
 import time
 from collections import defaultdict
@@ -39,6 +38,15 @@ import numpy as np
 
 from ..lib import Bbox
 from ..queues.filequeue import failure_reason, run_with_deadline
+
+
+def _cutout_key(task):
+  """Cache key for a prefetched downsample cutout download."""
+  return (
+    task.src_path, int(task.mip),
+    tuple(int(v) for v in task.offset),
+    tuple(int(v) for v in task.shape),
+  )
 
 
 def _group_key(task, volmeta_cache):
@@ -164,11 +172,19 @@ class LeaseBatcher:
     self.timing = timing
     self.stats = {
       "executed": 0, "batched": 0, "solo": 0, "failed": 0,
-      "group_fallbacks": 0, "released": 0,
+      "group_fallbacks": 0, "released": 0, "prefetched_rounds": 0,
+      "prefetched_cutouts": 0,
       "dispatches": defaultdict(int),
     }
     self._completed_in_group = set()
     self._hb = None
+    # next-round pipelining (ISSUE 3): while round i's device dispatch
+    # and completions run, a background thread leases round i+1's
+    # members and downloads their groupable cutouts, so the chip never
+    # waits on the queue or the object store between rounds
+    self._next_round = None   # cf.Future -> list[(task, lease_id)]
+    self._img_cache = {}      # download-prefetch results, keyed by
+                              # (src_path, mip, offset, shape)
 
   def _draining(self) -> bool:
     return self.drain_flag is not None and self.drain_flag.is_set()
@@ -209,17 +225,24 @@ class LeaseBatcher:
     backoff = 1.0
     while True:
       if self._draining():
+        self._surrender_prefetch()
         return self.stats["executed"]
       if stop_fn is not None and stop_fn(
         executed=self.stats["executed"], empty=False
       ):
+        self._surrender_prefetch()
         return self.stats["executed"]
       cap = self.batch_size
       if task_budget is not None:
         cap = min(cap, task_budget - self.stats["executed"])
         if cap <= 0:
+          self._surrender_prefetch()
           return self.stats["executed"]
-      members = []
+      members = self._take_prefetched()
+      if len(members) > cap:
+        # the budget shrank between prefetch and now: surplus goes back
+        self._release_members(members[cap:])
+        members = members[:cap]
       while len(members) < cap and not self._draining():
         leased = self.queue.lease(self.lease_seconds)
         if leased is None:
@@ -239,6 +262,21 @@ class LeaseBatcher:
         backoff = min(backoff * 2, max_backoff_window)
         continue
       backoff = 1.0
+      # pipeline the NEXT round while this one dispatches/completes
+      if len(members) == cap and (
+        task_budget is None
+        or task_budget - self.stats["executed"] - len(members) > 0
+      ):
+        next_cap = self.batch_size
+        if task_budget is not None:
+          next_cap = min(
+            next_cap, task_budget - self.stats["executed"] - len(members)
+          )
+        from ..pipeline import shared_prefetch_pool
+
+        self._next_round = shared_prefetch_pool().submit(
+          self._prelease_and_prefetch, next_cap
+        )
       if self.timing:
         import json
 
@@ -258,6 +296,72 @@ class LeaseBatcher:
         }))
       else:
         self.run_round(members)
+
+  # -- next-round pipelining ------------------------------------------------
+
+  def _take_prefetched(self):
+    fut, self._next_round = self._next_round, None
+    if fut is None:
+      return []
+    return fut.result()
+
+  def _surrender_prefetch(self):
+    """Drain/stop path: pre-leased members of a round that will never
+    run go straight back to the queue."""
+    try:
+      self._release_members(self._take_prefetched())
+    finally:
+      self._img_cache.clear()
+
+  def _prelease_and_prefetch(self, cap: int):
+    """Background half of the round pipeline: lease round i+1's members
+    and download the cutouts its downsample groups will need, while
+    round i owns the device. Download failures are dropped silently —
+    the round's own download retries and surfaces the real error."""
+    members = []
+    while len(members) < cap and not self._draining():
+      leased = self.queue.lease(self.lease_seconds)
+      if leased is None:
+        break
+      members.append(leased)
+    if not members:
+      return members
+    self.stats["prefetched_rounds"] += 1
+    # bound the cache: entries a round never consumed (handler fell back
+    # solo, say) must not accumulate; insertion order evicts oldest
+    while len(self._img_cache) > 2 * max(cap, 1):
+      self._img_cache.pop(next(iter(self._img_cache)), None)
+    volmeta_cache = {}
+    vols = {}
+    from .. import telemetry
+    from ..volume import Volume
+
+    for task, _lease_id in members:
+      if self._draining():
+        break
+      try:
+        key = _group_key(task, volmeta_cache)
+      except Exception:
+        continue
+      if key is None or key[0] != "downsample":
+        continue
+      ckey = _cutout_key(task)
+      if ckey in self._img_cache:
+        continue
+      vkey = (task.src_path, int(task.mip), bool(task.fill_missing))
+      try:
+        if vkey not in vols:
+          vols[vkey] = Volume(
+            task.src_path, mip=task.mip, fill_missing=task.fill_missing
+          )
+        self._img_cache[ckey] = vols[vkey].download(
+          Bbox(task.offset, task.offset + task.shape)
+        )
+        self.stats["prefetched_cutouts"] += 1
+        telemetry.incr("pipeline.lease.prefetched_cutouts")
+      except Exception:
+        continue
+    return members
 
   def run_round(self, members):
     """Execute one lease round: group, dispatch groups, solo the rest.
@@ -404,8 +508,17 @@ class LeaseBatcher:
       return
     method = pooling.method_for_layer(dest.layer_type, t0.downsample_method)
     boxes = [Bbox(t.offset, t.offset + t.shape) for t, _ in group]
-    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
-      imgs = list(io_pool.map(src.download, boxes))
+
+    def fetch(pair):
+      task, box = pair
+      img = self._img_cache.pop(_cutout_key(task), None)
+      return img if img is not None else src.download(box)
+
+    from ..pipeline import shared_prefetch_pool
+
+    imgs = list(shared_prefetch_pool().map(
+      fetch, zip([t for t, _ in group], boxes)
+    ))
     is_u64 = method == "mode" and dest.dtype.itemsize == 8
     mesh = self.mesh if self.mesh is not None else make_mesh()
     executor = cached_chunk_executor(
@@ -416,13 +529,23 @@ class LeaseBatcher:
     self.stats["dispatches"]["downsample"] += 1
 
     def finish(k, task):
+      # the member's chunk encodes+puts thread on the shared pool; the
+      # join keeps the completion contract (delete only after every
+      # byte landed) inside the member's own deadline window
+      from ..pipeline import SerialSink, config as pcfg, shared_encode_pool
+
+      sink = (
+        shared_encode_pool().ticket() if pcfg.use_threads() else SerialSink()
+      )
       downsample_and_upload(
         None, boxes[k], dest,
         task_shape=task.shape, mip=task.mip, num_mips=task.num_mips,
         factor=task.factor, sparse=task.sparse,
         method=task.downsample_method, compress=task.compress,
         _mips_out=[_from_batch_layout(np.asarray(m[k])) for m in mips_out],
+        sink=sink,
       )
+      sink.join()
 
     self._finish_members(group, finish)
 
@@ -444,8 +567,9 @@ class LeaseBatcher:
         bounded=False,
       ))
 
-    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
-      preps = list(io_pool.map(prep, [t for t, _ in group]))
+    from ..pipeline import shared_prefetch_pool
+
+    preps = list(shared_prefetch_pool().map(prep, [t for t, _ in group]))
 
     live = [i for i, p in enumerate(preps) if p is not None]
     fields = {}
@@ -493,8 +617,9 @@ class LeaseBatcher:
         task.dust_threshold,
       )
 
-    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
-      preps = list(io_pool.map(prep, [t for t, _ in group]))
+    from ..pipeline import shared_prefetch_pool
+
+    preps = list(shared_prefetch_pool().map(prep, [t for t, _ in group]))
 
     imgs = np.stack([p[0] for p in preps])
     comps = connected_components_batch(

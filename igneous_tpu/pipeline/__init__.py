@@ -1,0 +1,49 @@
+"""Staged execution pipeline (ISSUE 3): overlapped download → device
+compute → parallel encode/upload.
+
+The subsystem in three pieces:
+
+  * :mod:`buffers`  — byte-budgeted bounded hand-off between stages,
+    with stall/depth/bytes telemetry and StopFlag-aware waits.
+  * :mod:`encoder`  — persistent encode/upload pool; deterministic
+    parallel compression grouped under per-task completion tickets.
+  * :mod:`runner`   — the scheduler: prefetch pool ∥ in-order compute ∥
+    async upload, with write barriers, drain, and fault containment.
+
+Env knobs (see :mod:`config`): ``IGNEOUS_PIPELINE``,
+``IGNEOUS_PIPELINE_MEM_MB``, ``IGNEOUS_PIPELINE_PREFETCH``,
+``IGNEOUS_PIPELINE_IO_THREADS``, ``IGNEOUS_PIPELINE_ENCODE_THREADS``.
+"""
+
+from . import config
+from .buffers import BoundedBuffer, PipelineInterrupted
+from .encoder import (
+  EncodePool,
+  SerialSink,
+  UploadTicket,
+  shared_encode_pool,
+  shared_io_pool,
+  shared_prefetch_pool,
+)
+from .runner import (
+  StagePlan,
+  execute_with_sink,
+  run_tasks_pipelined,
+  stage_plan_of,
+)
+
+__all__ = [
+  "config",
+  "BoundedBuffer",
+  "PipelineInterrupted",
+  "EncodePool",
+  "SerialSink",
+  "UploadTicket",
+  "shared_encode_pool",
+  "shared_io_pool",
+  "shared_prefetch_pool",
+  "StagePlan",
+  "execute_with_sink",
+  "run_tasks_pipelined",
+  "stage_plan_of",
+]

@@ -1,0 +1,171 @@
+"""Bounded byte-budgeted hand-off between pipeline stages.
+
+A stage queue that bounds MEMORY, not item count: producers acquire the
+item's byte weight before starting work (a prefetch thread blocks before
+it downloads a cutout there is no room for, instead of after), consumers
+release it once the item leaves the pipeline. Stall time on both sides
+and the bytes-in-flight high-water mark are reported through telemetry
+(``pipeline.<name>.producer_stall_s`` / ``consumer_stall_s`` /
+``pipeline.<name>.bytes``), which is how an operator tells "storage is
+the wall" from "compute is the wall" without a profiler.
+
+Drain cooperation: ``interrupt(flag)`` wires a lifecycle.StopFlag (or any
+``is_set()``) into every blocking wait — a preemption notice wakes
+blocked producers/consumers immediately instead of deadlocking a
+half-full pipeline on a dying pod.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .. import telemetry
+
+
+class PipelineInterrupted(Exception):
+  """A blocking buffer wait was woken by the drain flag."""
+
+
+class BoundedBuffer:
+  """FIFO with a byte budget. One item may exceed the budget when the
+  buffer is empty (a single oversized cutout must still flow, else a
+  misconfigured budget deadlocks the whole run)."""
+
+  def __init__(self, budget_bytes: int, name: str = "buffer"):
+    self.budget = max(int(budget_bytes), 1)
+    self.name = name
+    self._lock = threading.Lock()
+    self._not_full = threading.Condition(self._lock)
+    self._not_empty = threading.Condition(self._lock)
+    self._items: deque = deque()
+    self._bytes_held = 0  # acquired weight (includes producers mid-work)
+    self._closed = False
+    self._flag = None  # optional drain flag; wakes all waiters when set
+    # FIFO budget grants: producers racing for the last budget slice out
+    # of order can starve the OLDEST producer — the one the consumer is
+    # blocked on — which deadlocks the whole pipeline. Sequences are
+    # reserved at submit time (consumer thread, in order) and acquire()
+    # grants strictly in sequence.
+    self._seq_next = 0
+    self._seq_grant = 0
+
+  # -- drain cooperation ----------------------------------------------------
+
+  def interrupt(self, flag) -> None:
+    """Attach a StopFlag-like object; waits poll it and raise
+    PipelineInterrupted once set."""
+    with self._lock:
+      self._flag = flag
+
+  def _interrupted(self) -> bool:
+    return self._flag is not None and self._flag.is_set()
+
+  def _wait(self, cond: threading.Condition, pred, stall_counter: str):
+    """Wait for pred() under the lock; accounts stall time; drain-aware."""
+    if pred():
+      return
+    t0 = time.perf_counter()
+    while not pred():
+      if self._interrupted():
+        telemetry.observe(stall_counter, time.perf_counter() - t0)
+        raise PipelineInterrupted(self.name)
+      if self._closed:
+        break
+      cond.wait(timeout=0.1)
+    telemetry.observe(stall_counter, time.perf_counter() - t0)
+
+  # -- producer side --------------------------------------------------------
+
+  def reserve_seq(self) -> int:
+    """Reserve this producer's place in the FIFO grant order. Call from
+    the thread that SUBMITS producers (in item order) — pool scheduling
+    must not reorder who gets budget first."""
+    with self._lock:
+      seq = self._seq_next
+      self._seq_next += 1
+      return seq
+
+  def acquire(self, nbytes: int, seq: Optional[int] = None) -> None:
+    """Reserve ``nbytes`` of budget BEFORE producing the item (blocks
+    while the pipeline is full). The reservation is what bounds memory:
+    a downloading thread holds its cutout's weight from before the first
+    byte arrives until the consumer releases it. ``seq`` (from
+    reserve_seq) serializes grants so a younger producer can never
+    starve the older one the consumer is waiting on."""
+    nbytes = max(int(nbytes), 0)
+    with self._not_full:
+      if seq is None:
+        seq = self._seq_next
+        self._seq_next += 1
+      try:
+        self._wait(
+          self._not_full,
+          lambda: self._seq_grant == seq and (
+            self._bytes_held == 0 or self._bytes_held + nbytes <= self.budget
+          ),
+          f"pipeline.{self.name}.producer_stall_s",
+        )
+        self._bytes_held += nbytes
+        telemetry.gauge_max(f"pipeline.{self.name}.bytes", self._bytes_held)
+      finally:
+        # the grant advances even on an interrupted wait: siblings
+        # behind an abandoned producer must not block forever
+        if self._seq_grant == seq:
+          self._seq_grant = seq + 1
+          self._not_full.notify_all()
+
+  def resize(self, old_nbytes: int, new_nbytes: int) -> None:
+    """Correct a reservation once the real payload size is known (the
+    producer estimated from task geometry before downloading)."""
+    with self._not_full:
+      self._bytes_held += int(new_nbytes) - int(old_nbytes)
+      telemetry.gauge_max(f"pipeline.{self.name}.bytes", self._bytes_held)
+      self._not_full.notify_all()
+
+  def put(self, item) -> None:
+    """Enqueue an item whose weight was already acquire()d."""
+    with self._lock:
+      self._items.append(item)
+      telemetry.gauge_max(f"pipeline.{self.name}.depth", len(self._items))
+      self._not_empty.notify()
+
+  def release(self, nbytes: int) -> None:
+    """Return ``nbytes`` of budget (the consumer is done with the item,
+    or the producer failed and never enqueued it)."""
+    with self._not_full:
+      self._bytes_held -= max(int(nbytes), 0)
+      self._not_full.notify_all()
+
+  # -- consumer side --------------------------------------------------------
+
+  def get(self):
+    """Dequeue the next item; blocks until one arrives or the buffer is
+    closed empty (returns None)."""
+    with self._not_empty:
+      self._wait(
+        self._not_empty,
+        lambda: bool(self._items) or self._closed,
+        f"pipeline.{self.name}.consumer_stall_s",
+      )
+      if self._items:
+        return self._items.popleft()
+      return None
+
+  def close(self) -> None:
+    """No more puts; blocked consumers drain what remains, then get None."""
+    with self._lock:
+      self._closed = True
+      self._not_empty.notify_all()
+      self._not_full.notify_all()
+
+  @property
+  def bytes_held(self) -> int:
+    with self._lock:
+      return self._bytes_held
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._items)

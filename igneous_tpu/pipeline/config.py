@@ -1,0 +1,110 @@
+"""Pipeline sizing: env knobs + the memory budget behind every queue bound.
+
+The staged pipeline holds decoded cutouts (download→compute buffer) and
+encoded chunk payloads (encode→upload queue) in RAM at once. Both bounds
+derive from ONE byte budget so an operator reasons about a single number:
+
+  IGNEOUS_PIPELINE          on|off|auto   master switch (default auto:
+                                          stream runners pipeline, solo
+                                          task execution stays serial)
+  IGNEOUS_PIPELINE_MEM_MB   int           stage-buffer byte budget
+                                          (default: 2x the downsample
+                                          memory target, i.e. room for
+                                          the cutout in compute plus one
+                                          prefetched cutout)
+  IGNEOUS_PIPELINE_PREFETCH int           max cutouts downloading ahead
+                                          of compute (default 2)
+  IGNEOUS_PIPELINE_IO_THREADS int         download/decode pool width
+  IGNEOUS_PIPELINE_ENCODE_THREADS int     encode/upload pool width
+
+Thread-width defaults follow the host: min(8, cores*2) for IO (storage
+gets block on network/disk), min(8, cores) for encode (deflate is CPU).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# the downsample planner's default task byte target
+# (task_creation.image.create_downsampling_tasks memory_target) — the
+# pipeline budget defaults to a multiple of the same solver's output so
+# the two knobs stay coherent
+DEFAULT_MEMORY_TARGET = int(3.5e9)
+
+
+def _cores() -> int:
+  try:
+    return len(os.sched_getaffinity(0))
+  except AttributeError:
+    return os.cpu_count() or 1
+
+
+def enabled(default: Optional[bool] = None) -> bool:
+  """Master switch. ``default`` is what "auto" means at this call site:
+  stream runners (LocalTaskQueue, batch_runner) pass True, solo task
+  execution passes False — pipelining a one-task poll loop only adds
+  thread churn, while a task STREAM is where the stages overlap."""
+  val = os.environ.get("IGNEOUS_PIPELINE", "auto").strip().lower()
+  if val in ("1", "on", "true", "yes"):
+    return True
+  if val in ("0", "off", "false", "no"):
+    return False
+  return bool(default)
+
+
+def memory_budget_bytes(
+  task_nbytes: Optional[int] = None,
+  memory_target: Optional[int] = None,
+) -> int:
+  """Byte budget for stage buffers.
+
+  Explicit env wins; otherwise size from the same memory-target math the
+  downsample planner uses (downsample_scales.pyramid_memory_bytes feeds
+  ``memory_target``): budget = 2x the per-task working set, so one cutout
+  can prefetch while one computes. ``task_nbytes`` (a known cutout size)
+  tightens the default for small-task streams.
+  """
+  env = os.environ.get("IGNEOUS_PIPELINE_MEM_MB")
+  if env:
+    return max(int(float(env) * 1e6), 1)
+  base = memory_target if memory_target else DEFAULT_MEMORY_TARGET
+  if task_nbytes:
+    base = min(base, int(task_nbytes) * 2)
+  return max(int(base), 1)
+
+
+def prefetch_depth() -> int:
+  return max(int(os.environ.get("IGNEOUS_PIPELINE_PREFETCH", "2")), 1)
+
+
+def use_threads() -> bool:
+  """Whether the staged runner actually overlaps stages with threads.
+
+  ``IGNEOUS_PIPELINE_THREADS`` forces it (1/0); auto follows the host:
+  on a single-core host the three stages contend for one CPU — inflate,
+  native pooling, and deflate are all CPU-bound even though they release
+  the GIL — so threading only adds context-switch overhead. The runner
+  then degrades to in-order execution of the SAME stage plans (same
+  bytes, same telemetry), and the pipeline's win comes from the
+  persistent pools + encode fast paths instead of overlap."""
+  val = os.environ.get("IGNEOUS_PIPELINE_THREADS", "auto").strip().lower()
+  if val in ("1", "on", "true", "yes"):
+    return True
+  if val in ("0", "off", "false", "no"):
+    return False
+  return _cores() > 1
+
+
+def io_threads() -> int:
+  env = os.environ.get("IGNEOUS_PIPELINE_IO_THREADS")
+  if env:
+    return max(int(env), 1)
+  return min(8, _cores() * 2)
+
+
+def encode_threads() -> int:
+  env = os.environ.get("IGNEOUS_PIPELINE_ENCODE_THREADS")
+  if env:
+    return max(int(env), 1)
+  return min(8, max(_cores(), 1))

@@ -1,0 +1,404 @@
+"""The staged task runner: download(i+1) ∥ compute(i) ∥ encode/upload(i-1).
+
+Chunkflow (arXiv:1904.10489) showed for connectomics exactly what SURVEY
+§7 names as this framework's hard part: a task's wall clock is storage
+IO + codec work wrapped around a much faster compute kernel, and the fix
+is to run the three as concurrent stages over a stream of tasks. This
+module does that for any task that publishes a :class:`StagePlan`:
+
+  prefetch pool ──> BoundedBuffer ──> compute (caller thread) ──> encode/
+  (download+decode)  (byte budget)                               upload pool
+
+Correctness rules the scheduler enforces:
+
+  * **Byte identity** — stages call the exact code serial execution
+    calls (``Volume.download``, the pooling kernels, ``Volume.upload``
+    routed through a sink); scheduling changes WHEN bytes are produced,
+    never what bytes. gzip is mtime=0 deterministic per object.
+  * **Ordering** — compute runs in task order on the caller's thread
+    (device dispatch order is unchanged); only IO overlaps.
+  * **Write barriers** — a task whose read set intersects a pending
+    task's write set (or that publishes no plan at all) waits for every
+    in-flight upload before running; mixed streams degrade to serial
+    instead of racing reads against writes.
+  * **Completion** — a task is reported executed only after its upload
+    ticket joins; failures surface as that task's failure (the same
+    retry/DLQ path a synchronous failure takes).
+  * **Drain** — a lifecycle StopFlag stops admission, wakes every
+    blocked stage wait, finishes the in-flight task's uploads, and
+    returns with ``drained=True``; nothing half-written remains because
+    chunk puts are atomic and unjoined work belongs to tasks never
+    reported complete.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, Iterable, Optional
+
+from .. import telemetry
+from . import config
+from .buffers import BoundedBuffer, PipelineInterrupted
+from .encoder import SerialSink, shared_encode_pool, shared_prefetch_pool
+
+
+class StagePlan:
+  """How one task decomposes into pipeline stages.
+
+  ``download()`` → payload; ``compute(payload)`` → outputs;
+  ``upload(outputs, sink)`` routes chunk encode+put through ``sink``
+  (an UploadTicket in pipelined runs, SerialSink when executed solo).
+  ``reads``/``writes`` are sets of (layer_path, mip) used for conflict
+  barriers; ``nbytes_hint`` is the decoded payload size estimate the
+  byte budget reserves before the download starts.
+  """
+
+  __slots__ = ("download", "compute", "upload", "reads", "writes", "nbytes_hint")
+
+  def __init__(self, download, compute, upload, reads=(), writes=(),
+               nbytes_hint: int = 0):
+    self.download = download
+    self.compute = compute
+    self.upload = upload
+    self.reads = frozenset(reads)
+    self.writes = frozenset(writes)
+    self.nbytes_hint = int(nbytes_hint)
+
+
+def stage_plan_of(task) -> Optional[StagePlan]:
+  """A task's plan, or None (execute solo). Any planning failure routes
+  the task to the solo path, where the real error surfaces with the
+  task's own context."""
+  planner = getattr(task, "stage_plan", None)
+  if planner is None:
+    return None
+  return planner()
+
+
+class _Member:
+  __slots__ = ("task", "plan", "future", "nbytes", "ticket", "out_nbytes")
+
+  def __init__(self, task, plan):
+    self.task = task
+    self.plan = plan
+    self.future = None
+    self.nbytes = 0
+    self.ticket = None
+    self.out_nbytes = 0
+
+
+def run_tasks_pipelined(
+  tasks: Iterable,
+  drain_flag=None,
+  memory_target: Optional[int] = None,
+  on_error: Optional[Callable] = None,
+  on_complete: Optional[Callable] = None,
+) -> dict:
+  """Run a task stream through the staged pipeline.
+
+  ``on_error(task, exc)``: containment hook — when given, a failed task
+  is reported and the stream continues (LocalTaskQueue max_deliveries
+  semantics); without it the first failure drains in-flight work and
+  re-raises (fail-fast parity with serial insert).
+  ``on_complete(task)``: called after a task's uploads joined.
+  Returns ``{"executed", "staged", "solo", "failed", "drained"}``.
+  """
+  stats = {"executed": 0, "staged": 0, "solo": 0, "failed": 0, "drained": False}
+  if not config.use_threads():
+    return _run_tasks_inorder(tasks, stats, drain_flag, on_error, on_complete)
+  io_pool = shared_prefetch_pool()
+  encode_pool = shared_encode_pool()
+  buffer = BoundedBuffer(
+    config.memory_budget_bytes(memory_target=memory_target), name="prefetch"
+  )
+  if drain_flag is not None:
+    buffer.interrupt(drain_flag)
+
+  it = iter(tasks)
+  lookahead: deque = deque()  # _Member admitted to the pipeline, in order
+  uploading: deque = deque()  # members whose ticket is outstanding
+  pending_writes: dict = {}   # (path, mip) -> refcount across uploading
+
+  def draining() -> bool:
+    if drain_flag is not None and drain_flag.is_set():
+      stats["drained"] = True
+    return stats["drained"]
+
+  def writes_add(member):
+    for key in member.plan.writes:
+      pending_writes[key] = pending_writes.get(key, 0) + 1
+
+  def writes_remove(member):
+    for key in member.plan.writes:
+      n = pending_writes.get(key, 0) - 1
+      if n <= 0:
+        pending_writes.pop(key, None)
+      else:
+        pending_writes[key] = n
+
+  def join_member(member, raise_errors=True):
+    """Join one member's uploads; account completion or failure."""
+    try:
+      member.ticket.join()
+    except Exception as e:  # noqa: BLE001 - routed to containment hook
+      writes_remove(member)
+      buffer.release(member.out_nbytes)
+      stats["failed"] += 1
+      telemetry.incr("pipeline.tasks.failed")
+      if on_error is not None:
+        on_error(member.task, e)
+        return
+      if raise_errors:
+        raise
+      return
+    writes_remove(member)
+    buffer.release(member.out_nbytes)
+    stats["executed"] += 1
+    stats["staged"] += 1
+    if on_complete is not None:
+      on_complete(member.task)
+
+  def upload_barrier():
+    while uploading:
+      join_member(uploading.popleft())
+
+  def fail_member(member, exc):
+    stats["failed"] += 1
+    telemetry.incr("pipeline.tasks.failed")
+    if on_error is None:
+      raise exc
+    on_error(member.task, exc)
+
+  def submit_download(member):
+    hint = member.plan.nbytes_hint
+    member.nbytes = hint
+    # budget grant order is fixed HERE (caller thread, task order) so a
+    # younger download racing on the pool can never starve the one the
+    # compute stage blocks on next
+    seq = buffer.reserve_seq()
+
+    def work():
+      buffer.acquire(hint, seq=seq)
+      try:
+        t0 = time.perf_counter()
+        payload = member.plan.download()
+        telemetry.observe("pipeline.download.s", time.perf_counter() - t0)
+        return payload
+      except BaseException:
+        buffer.release(hint)
+        raise
+
+    member.future = io_pool.submit(work)
+
+  def admit_next() -> Optional[_Member]:
+    """Pull one task from the stream and classify it. Returns the member
+    (stageable, download submitted) or runs barriers + solo execution
+    inline and returns None."""
+    try:
+      task = next(it)
+    except StopIteration:
+      return StopIteration
+    try:
+      plan = stage_plan_of(task)
+    except Exception:
+      plan = None  # solo path surfaces the real error with task context
+    if plan is None:
+      return _Member(task, None)
+    member = _Member(task, plan)
+    return member
+
+  def conflicts(member) -> bool:
+    if member.plan is None:
+      return True
+    return any(key in pending_writes for key in member.plan.reads)
+
+  try:
+    depth = config.prefetch_depth()
+    done = False
+    while not done or lookahead:
+      if draining():
+        break
+      # keep up to `depth` stageable downloads in flight; admission stops
+      # at the first task that must barrier (no plan, or read conflict)
+      while not done and len(lookahead) < depth + 1:
+        if lookahead and (
+          lookahead[-1].plan is None or lookahead[-1].future is None
+        ):
+          break  # a barrier task is queued; don't admit past it
+        nxt = admit_next()
+        if nxt is StopIteration:
+          done = True
+          break
+        lookahead.append(nxt)
+        if nxt.plan is not None and not conflicts(nxt):
+          writes_add(nxt)
+          submit_download(nxt)
+        # members with a conflict (or no plan) wait unsubmitted: the
+        # upload barrier ahead of them clears pending_writes first
+
+      if not lookahead:
+        break
+
+      member = lookahead.popleft()
+
+      if member.plan is None:
+        # solo task: full barrier (it may read anything, write anything)
+        upload_barrier()
+        if draining():
+          break
+        try:
+          member.task.execute()
+        except Exception as e:  # noqa: BLE001
+          fail_member(member, e)
+        else:
+          stats["executed"] += 1
+          stats["solo"] += 1
+          if on_complete is not None:
+            on_complete(member.task)
+        continue
+
+      if member.future is None:
+        # admitted with a read conflict: barrier, then download inline
+        upload_barrier()
+        if draining():
+          break
+        writes_add(member)
+        submit_download(member)
+
+      # join the oldest uploads so at most `depth` tickets ride along
+      while len(uploading) > depth:
+        join_member(uploading.popleft())
+
+      try:
+        payload = member.future.result()
+      except PipelineInterrupted:
+        writes_remove(member)
+        break
+      except Exception as e:  # noqa: BLE001
+        writes_remove(member)
+        fail_member(member, e)
+        continue
+
+      try:
+        t0 = time.perf_counter()
+        outputs = member.plan.compute(payload)
+        telemetry.observe("pipeline.compute.s", time.perf_counter() - t0)
+        member.ticket = encode_pool.ticket()
+        t0 = time.perf_counter()
+        member.plan.upload(outputs, member.ticket)
+        telemetry.observe("pipeline.upload_submit.s", time.perf_counter() - t0)
+      except Exception as e:  # noqa: BLE001
+        if member.ticket is not None:
+          try:
+            member.ticket.join()
+          except Exception:  # noqa: BLE001 - the primary error wins
+            pass
+        writes_remove(member)
+        buffer.release(member.nbytes)
+        fail_member(member, e)
+        continue
+
+      # the decoded payload is consumed; outputs (≈1/3 the bytes for a
+      # (2,2,1) pyramid) stay reserved until the uploads land
+      member.out_nbytes = max(member.nbytes // 3, 1)
+      buffer.resize(member.nbytes, member.out_nbytes)
+      uploading.append(member)
+
+  finally:
+    # drain path and normal exit share one join: every submitted upload
+    # either lands or surfaces as its member's failure — no thread is
+    # left writing after return, no lease/complete is reported early
+    drain_error = None
+    while uploading:
+      try:
+        join_member(uploading.popleft())
+      except Exception as e:  # noqa: BLE001
+        if drain_error is None:
+          drain_error = e
+    # abandoned prefetches: block until each settles, then release budget
+    for member in lookahead:
+      if member.future is not None:
+        try:
+          member.future.result()
+          buffer.release(member.nbytes)
+        except PipelineInterrupted:
+          pass
+        except Exception:  # noqa: BLE001 - task never ran; not a failure
+          pass
+        writes_remove(member)
+    if drain_error is not None:
+      raise drain_error
+
+  return stats
+
+
+def _run_tasks_inorder(tasks, stats, drain_flag, on_error, on_complete) -> dict:
+  """Single-core degenerate mode: the same stage plans, executed in
+  order with a serial sink. No threads to stall, so the per-stage spans
+  measure pure work — the telemetry an operator compares against a
+  threaded run to see what overlap would buy."""
+  sink = SerialSink()
+  for task in tasks:
+    if drain_flag is not None and drain_flag.is_set():
+      stats["drained"] = True
+      break
+    try:
+      plan = stage_plan_of(task)
+    except Exception:  # noqa: BLE001 - solo path surfaces the real error
+      plan = None
+    try:
+      if plan is None:
+        task.execute()
+        stats["solo"] += 1
+      else:
+        t0 = time.perf_counter()
+        payload = plan.download()
+        t1 = time.perf_counter()
+        telemetry.observe("pipeline.download.s", t1 - t0)
+        outputs = plan.compute(payload)
+        t2 = time.perf_counter()
+        telemetry.observe("pipeline.compute.s", t2 - t1)
+        plan.upload(outputs, sink)
+        telemetry.observe("pipeline.upload_submit.s", time.perf_counter() - t2)
+        stats["staged"] += 1
+    except Exception as e:  # noqa: BLE001
+      stats["failed"] += 1
+      telemetry.incr("pipeline.tasks.failed")
+      if on_error is None:
+        raise
+      on_error(task, e)
+      continue
+    stats["executed"] += 1
+    if on_complete is not None:
+      on_complete(task)
+  return stats
+
+
+def execute_with_sink(task) -> None:
+  """Tier-A pipelining for SOLO execution paths (queue poll loops): when
+  ``IGNEOUS_PIPELINE=1``, a task's own chunk encodes+puts run on the
+  shared pool and are joined before execute() returns — the lease
+  delete still happens strictly after every byte landed."""
+  plan = stage_plan_of(task)
+  if plan is None:
+    task.execute()
+    return
+  if not config.enabled(default=False) or not config.use_threads():
+    task.execute()
+    return
+  ticket = shared_encode_pool().ticket()
+  outputs = plan.compute(plan.download())
+  try:
+    plan.upload(outputs, ticket)
+  finally:
+    ticket.join()
+
+
+__all__ = [
+  "StagePlan",
+  "SerialSink",
+  "run_tasks_pipelined",
+  "execute_with_sink",
+  "stage_plan_of",
+]

@@ -161,7 +161,16 @@ def poll_loop(
       try:
         if before_fn:
           before_fn(task)
-        run_with_deadline(task.execute, task_deadline_seconds)
+        # IGNEOUS_PIPELINE=1 opts the solo worker loop into tier-A
+        # pipelining: the task's chunk encodes+puts thread on the shared
+        # pool, joined before the lease delete below — completion
+        # semantics are unchanged (execute_with_sink falls back to plain
+        # execute() when the task has no stage plan or pipelining is off)
+        from ..pipeline import execute_with_sink
+
+        run_with_deadline(
+          lambda: execute_with_sink(task), task_deadline_seconds
+        )
         if after_fn:
           after_fn(task)
       except Exception as e:

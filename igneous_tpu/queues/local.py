@@ -96,6 +96,15 @@ class LocalTaskQueue:
     telemetry.incr("dlq.promoted")
 
   def insert(self, tasks: Iterable, total: Optional[int] = None):
+    if self.parallel == 1:
+      from ..pipeline import config as pipeline_config
+
+      # a task STREAM on one process is exactly what the staged pipeline
+      # exists for: download(i+1) overlaps compute(i) overlaps
+      # encode/upload(i-1), byte-identical to this serial loop
+      # (IGNEOUS_PIPELINE=off restores strict serial execution)
+      if pipeline_config.enabled(default=True):
+        return self._insert_pipelined(tasks, total)
     payloads = (serialize(t) for t in self._iter(tasks))
     bar = tqdm(
       total=total, desc="Tasks", disable=(not self.progress), unit="task"
@@ -148,6 +157,60 @@ class LocalTaskQueue:
             if self._draining():
               break
     bar.close()
+
+  def _insert_pipelined(self, tasks: Iterable, total: Optional[int] = None):
+    """parallel=1 insert through the staged pipeline (ISSUE 3).
+
+    Semantics preserved from the serial loop: tasks round-trip through
+    serialize/deserialize, ``inserted``/``completed`` tally the same
+    way, drain stops admission and finishes in-flight work, fail-fast
+    raises the first failure (after in-flight uploads join — a task is
+    never abandoned mid-write), and ``max_deliveries`` retries failures
+    solo before dead-lettering them."""
+    from ..pipeline import run_tasks_pipelined
+    from .filequeue import failure_reason
+
+    bar = tqdm(
+      total=total, desc="Tasks", disable=(not self.progress), unit="task"
+    )
+
+    def stream():
+      for t in self._iter(tasks):
+        payload = serialize(t)
+        self.inserted += 1
+        yield deserialize(payload)
+
+    def on_complete(task):
+      self.completed += 1
+      bar.update(1)
+
+    on_error = None
+    if self.max_deliveries is not None:
+      def on_error(task, exc):
+        payload = serialize(task)
+        if self.max_deliveries <= 1:
+          self._record_dead_letter(payload, failure_reason(exc))
+          bar.update(1)
+          return
+        # the pipelined attempt spent one delivery; the rest run solo
+        _p, err = _execute_payload_contained(payload, self.max_deliveries - 1)
+        if err is not None:
+          self._record_dead_letter(payload, err)
+        else:
+          self.completed += 1
+        bar.update(1)
+
+    try:
+      stats = run_tasks_pipelined(
+        stream(),
+        drain_flag=self.drain_flag,
+        on_error=on_error,
+        on_complete=on_complete,
+      )
+      if stats["drained"]:
+        self.drained = True
+    finally:
+      bar.close()
 
   insert_all = insert
 

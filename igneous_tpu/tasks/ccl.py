@@ -38,9 +38,15 @@ from ..ops import remap as fastremap
 
 
 def _npy_bytes(arr: np.ndarray) -> bytes:
+  from ..storage import scratch_gzip_level
+
   buf = io.BytesIO()
   np.save(buf, arr)
-  return gzip.compress(buf.getvalue(), compresslevel=4, mtime=0)
+  # face planes are scratch (pass-2 consumes, gc deletes): level follows
+  # IGNEOUS_SCRATCH_COMPRESS; the historical 4 holds when unset
+  return gzip.compress(
+    buf.getvalue(), compresslevel=scratch_gzip_level(4), mtime=0
+  )
 
 
 def _npy_load(data: bytes) -> np.ndarray:

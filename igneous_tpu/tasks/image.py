@@ -28,7 +28,12 @@ from ..downsample_scales import (
   truncate_writable_factors,
 )
 from ..ops import pooling
+from ..pipeline import StagePlan
 from .. import telemetry
+
+# empty-cutout tasks stage as no-ops: the pipeline treats them uniformly
+# instead of barriering the stream for a solo no-op execute()
+_NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
 
 
 def _resolve_factors(
@@ -75,6 +80,7 @@ def downsample_and_upload(
   method: str = "auto",
   compress="gzip",
   _mips_out=None,
+  sink=None,
 ):
   """Build the mip pyramid for one cutout and upload every level.
 
@@ -82,7 +88,8 @@ def downsample_and_upload(
   scales exist in the destination info (or up to num_mips). ``_mips_out``
   injects a pre-computed pyramid (the lease batcher's one-dispatch device
   stage) so only the upload loop runs here — keeping batched chunk bytes
-  identical to solo execution."""
+  identical to solo execution. ``sink`` routes chunk encode+put through
+  the staged pipeline's upload pool (the caller joins it)."""
   factors = _resolve_factors(vol, mip, task_shape, num_mips, factor)
   if not factors:
     return
@@ -113,6 +120,7 @@ def downsample_and_upload(
         np.asarray(mipped[sl], dtype=vol.dtype),
         mip=dest_mip,
         compress=compress,
+        sink=sink,
       )
 
 
@@ -170,7 +178,7 @@ class TransferTask(RegisteredTask):
         "set agglomerate=True (roots) or stop_layer=2 (L2 ids)"
       )
 
-  def execute(self):
+  def _volumes_and_bounds(self):
     src = Volume(
       self.src_path, mip=self.mip, fill_missing=self.fill_missing
     )
@@ -183,45 +191,100 @@ class TransferTask(RegisteredTask):
     )
     bounds = Bbox(self.offset, self.offset + self.shape)
     bounds = Bbox.intersection(bounds, src.bounds)
+    return src, dest, bounds
+
+  def execute(self):
+    src, dest, bounds = self._volumes_and_bounds()
     if bounds.empty():
       return
-
     if self._try_raw_copy(src, dest, bounds):
       return
+    from ..pipeline import SerialSink
 
-    with telemetry.stage("download"):
-      image = src.download(
-        bounds, agglomerate=self.agglomerate,
-        timestamp=self.timestamp, stop_layer=self.stop_layer,
-      )
+    # solo execution runs the SAME stage code the pipeline schedules —
+    # one implementation, one set of bytes
+    plan = self._build_plan(src, dest, bounds)
+    plan.upload(plan.compute(plan.download()), SerialSink())
+
+  def stage_plan(self):
+    """Pipeline decomposition (pipeline.runner.StagePlan): download the
+    cutout / build the pyramid / route chunk encode+put through the
+    sink. None routes the task solo — the raw-copy fast path is pure
+    streaming IO with no compute stage to overlap."""
+    src, dest, bounds = self._volumes_and_bounds()
+    if bounds.empty():
+      return _NOOP_PLAN
+    if self._raw_copy_eligible(src, dest, bounds):
+      return None
+    return self._build_plan(src, dest, bounds)
+
+  def _build_plan(self, src, dest, bounds: Bbox):
     dest_bounds = bounds.translate(self.translate)
-
-    if not self.skip_first:
-      with telemetry.stage("upload"):
-        dest.upload(dest_bounds, image, compress=self.compress)
-    if not self.skip_downsamples:
-      downsample_and_upload(
-        image,
-        dest_bounds,
-        dest,
-        task_shape=self.shape,
-        mip=self.mip,
-        num_mips=self.num_mips,
-        factor=self.factor,
-        sparse=self.sparse,
-        method=self.downsample_method,
-        compress=self.compress,
+    if self.skip_downsamples:
+      factors = []
+    else:
+      factors = _resolve_factors(
+        dest, self.mip, self.shape, self.num_mips, self.factor
       )
+    reads = {(self.src_path, self.mip)}
+    writes = set()
+    if not self.skip_first:
+      writes.add((self.dest_path, self.mip))
+    writes.update((self.dest_path, self.mip + i + 1) for i in range(len(factors)))
 
+    def download():
+      with telemetry.stage("download"):
+        return src.download(
+          bounds, agglomerate=self.agglomerate,
+          timestamp=self.timestamp, stop_layer=self.stop_layer,
+        )
 
-  def _try_raw_copy(self, src, dest, bounds: Bbox) -> bool:
-    """Most efficient transfer type: when the grids, dtype, and encoding
-    line up exactly and no resampling/remapping is requested, copy the
-    stored chunk objects without decoding a single voxel (reference
-    image.py:483-497 `transfer_to` fast path)."""
+    def compute(image):
+      if not factors:
+        return image, None
+      method = pooling.method_for_layer(dest.layer_type, self.downsample_method)
+      with telemetry.stage("device_pool"):
+        mips_out = pooling.downsample_auto(
+          image, factors, len(factors), method=method, sparse=self.sparse
+        )
+      return image, mips_out
+
+    def upload(outputs, sink):
+      image, mips_out = outputs
+      if not self.skip_first:
+        with telemetry.stage("upload"):
+          dest.upload(dest_bounds, image, compress=self.compress, sink=sink)
+      if not self.skip_downsamples and mips_out is not None:
+        downsample_and_upload(
+          image,
+          dest_bounds,
+          dest,
+          task_shape=self.shape,
+          mip=self.mip,
+          num_mips=self.num_mips,
+          factor=self.factor,
+          sparse=self.sparse,
+          method=self.downsample_method,
+          compress=self.compress,
+          _mips_out=mips_out,
+          sink=sink,
+        )
+
+    nbytes = int(np.prod([int(v) for v in bounds.size3()]))
+    nbytes *= dest.dtype.itemsize * dest.num_channels
+    return StagePlan(
+      download, compute, upload, reads=reads, writes=writes,
+      nbytes_hint=nbytes,
+    )
+
+  def _raw_copy_eligible(self, src, dest, bounds: Bbox) -> bool:
+    """When the grids, dtype, and encoding line up exactly and no
+    resampling/remapping is requested, stored chunk objects can be
+    copied without decoding a single voxel (reference image.py:483-497
+    `transfer_to` fast path)."""
     mip = self.mip
     sm, dm = src.meta, dest.meta
-    eligible = (
+    return (
       self.skip_downsamples
       and not self.skip_first  # skip_first + skip_downsamples = no-op
       and not self.agglomerate
@@ -235,21 +298,25 @@ class TransferTask(RegisteredTask):
       # under keys dest readers never request
       and src.bounds == dest.bounds
       and not sm.is_sharded(mip) and not dm.is_sharded(mip)
-      and np.all(sm.chunk_size(mip) == dm.chunk_size(mip))
-      and np.all(sm.voxel_offset(mip) == dm.voxel_offset(mip))
+      and bool(np.all(sm.chunk_size(mip) == dm.chunk_size(mip)))
+      and bool(np.all(sm.voxel_offset(mip) == dm.voxel_offset(mip)))
       and src.dtype == dest.dtype
       and sm.encoding(mip) == dm.encoding(mip)
       and (
         sm.encoding(mip) != "compressed_segmentation"
-        or np.all(sm.cseg_block_size(mip) == dm.cseg_block_size(mip))
+        or bool(np.all(sm.cseg_block_size(mip) == dm.cseg_block_size(mip)))
       )
       and bounds == Bbox.intersection(
         bounds.expand_to_chunk_size(sm.chunk_size(mip), sm.voxel_offset(mip)),
         src.bounds,
       )
     )
-    if not eligible:
+
+  def _try_raw_copy(self, src, dest, bounds: Bbox) -> bool:
+    if not self._raw_copy_eligible(src, dest, bounds):
       return False
+    mip = self.mip
+    sm, dm = src.meta, dest.meta
     from ..lib import chunk_bboxes
     from ..storage import CloudFiles
 

@@ -17,10 +17,14 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..lib import Bbox, Vec
+from ..pipeline import SerialSink, StagePlan
 from ..queues.registry import RegisteredTask
 from ..volume import Volume
 from ..ops import pooling
 from ..sharded_image import upload_shard
+
+# shard-aligned empty cutouts stage as no-ops (see tasks/image.py)
+_NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
 
 
 class ImageShardTransferTask(RegisteredTask):
@@ -53,18 +57,41 @@ class ImageShardTransferTask(RegisteredTask):
     self.stop_layer = stop_layer
 
   def execute(self):
+    plan = self.stage_plan()
+    plan.upload(plan.compute(plan.download()), SerialSink())
+
+  def stage_plan(self):
+    """Pipeline decomposition: shard synthesis is one indivisible write
+    (shard files are immutable), so the whole upload_shard call rides
+    the sink as a single unit — it overlaps the NEXT task's download
+    and compute rather than parallelizing internally."""
     src = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
     dest = Volume(self.dest_path, mip=self.mip)
     bounds = Bbox.intersection(
       Bbox(self.offset, self.offset + self.shape), src.bounds
     )
     if bounds.empty():
-      return
-    img = src.download(
-      bounds, agglomerate=self.agglomerate, timestamp=self.timestamp,
-      stop_layer=self.stop_layer,
+      return _NOOP_PLAN
+
+    def download():
+      return src.download(
+        bounds, agglomerate=self.agglomerate, timestamp=self.timestamp,
+        stop_layer=self.stop_layer,
+      )
+
+    def upload(img, sink):
+      sink.submit(lambda: upload_shard(
+        dest, bounds.translate(self.translate), img, self.mip
+      ))
+
+    nbytes = int(np.prod([int(v) for v in bounds.size3()]))
+    nbytes *= dest.dtype.itemsize * dest.num_channels
+    return StagePlan(
+      download, lambda img: img, upload,
+      reads={(self.src_path, self.mip)},
+      writes={(self.dest_path, self.mip)},
+      nbytes_hint=nbytes,
     )
-    upload_shard(dest, bounds.translate(self.translate), img, self.mip)
 
 
 class ImageShardDownsampleTask(RegisteredTask):
@@ -102,29 +129,53 @@ class ImageShardDownsampleTask(RegisteredTask):
     self.timestamp = timestamp
 
   def execute(self):
+    plan = self.stage_plan()
+    plan.upload(plan.compute(plan.download()), SerialSink())
+
+  def stage_plan(self):
     vol = Volume(self.src_path, mip=self.mip, fill_missing=self.fill_missing)
     bounds = Bbox.intersection(
       Bbox(self.offset, self.offset + self.shape), vol.bounds
     )
     if bounds.empty():
-      return
-    img = vol.download(
-      bounds, agglomerate=self.agglomerate, timestamp=self.timestamp
-    )
-    method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
+      return _NOOP_PLAN
     factor = tuple(int(v) for v in self.factor)
-    mips_out = pooling.downsample_auto(
-      img, factor, self.num_mips, method=method, sparse=self.sparse,
-    )
     cum = np.ones(3, dtype=np.int64)
-    for mipped in mips_out:
+    dest_mips = []
+    for _ in range(self.num_mips):
       cum *= np.asarray(factor, dtype=np.int64)
       # resolve each destination scale by resolution, not positional
       # index: add_scale keeps scales sorted, so mip+i is not guaranteed
       dest_res = np.asarray(vol.meta.resolution(self.mip)) * cum
-      dest_mip = vol.meta.mip_from_resolution(dest_res)
-      dest_min = bounds.minpt // Vec(*cum)
-      dest_bounds = Bbox(dest_min, dest_min + Vec(*mipped.shape[:3]))
-      dest_bounds = Bbox.intersection(dest_bounds, vol.meta.bounds(dest_mip))
-      sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
-      upload_shard(vol, dest_bounds, mipped[sl], dest_mip)
+      dest_mips.append((vol.meta.mip_from_resolution(dest_res), cum.copy()))
+
+    def download():
+      return vol.download(
+        bounds, agglomerate=self.agglomerate, timestamp=self.timestamp
+      )
+
+    def compute(img):
+      method = pooling.method_for_layer(vol.layer_type, self.downsample_method)
+      return pooling.downsample_auto(
+        img, factor, self.num_mips, method=method, sparse=self.sparse,
+      )
+
+    def upload(mips_out, sink):
+      for mipped, (dest_mip, cumf) in zip(mips_out, dest_mips):
+        dest_min = bounds.minpt // Vec(*cumf)
+        dest_bounds = Bbox(dest_min, dest_min + Vec(*mipped.shape[:3]))
+        dest_bounds = Bbox.intersection(dest_bounds, vol.meta.bounds(dest_mip))
+        sl = tuple(slice(0, int(s)) for s in dest_bounds.size3())
+        sink.submit(
+          lambda m=mipped, b=dest_bounds, s=sl, d=dest_mip:
+            upload_shard(vol, b, m[s], d)
+        )
+
+    nbytes = int(np.prod([int(v) for v in bounds.size3()]))
+    nbytes *= vol.dtype.itemsize * vol.num_channels
+    return StagePlan(
+      download, compute, upload,
+      reads={(self.src_path, self.mip)},
+      writes={(self.src_path, m) for m, _ in dest_mips},
+      nbytes_hint=nbytes,
+    )

@@ -500,17 +500,23 @@ class SkeletonTask(RegisteredTask):
     # spatial-index cells to their fragment containers by rename alone
     physical = Bbox(core.minpt * res, core.maxpt * res)
 
+    # intermediate artifacts (merge tasks consume + delete them): the
+    # IGNEOUS_SCRATCH_COMPRESS knob trades scratch bytes for encode time
+    # fleet-wide; unset keeps historical bytes exactly
+    from ..storage import scratch_compression
+
     if self.sharded:
       cf.put(
         f"{sdir}/{physical.to_filename()}.frags",
         FragMap.tobytes(
           {label: s.to_precomputed() for label, s in skels.items()}
         ),
+        compress=scratch_compression(None),
       )
     else:
       for label, s in skels.items():
         cf.put(f"{sdir}/{label}:{core.to_filename()}.sk", s.to_precomputed(),
-               compress="gzip")
+               compress=scratch_compression("gzip"))
 
     if self.spatial_index:
       label_bounds = {}

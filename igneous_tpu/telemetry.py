@@ -40,6 +40,45 @@ def counters_snapshot() -> Dict[str, int]:
 def reset_counters() -> None:
   with _COUNTERS_LOCK:
     _COUNTERS.clear()
+    _TIMERS.clear()
+    _TIMER_COUNTS.clear()
+    _GAUGES.clear()
+
+
+# -- staged-pipeline spans (ISSUE 3) -----------------------------------------
+# float-valued accumulators alongside the int counters: per-stage stall
+# time, bytes in flight, queue depth. Same lock — a pipeline flush reads
+# both families as one consistent snapshot.
+
+_TIMERS: Dict[str, float] = defaultdict(float)
+_TIMER_COUNTS: Dict[str, int] = defaultdict(int)
+_GAUGES: Dict[str, float] = defaultdict(float)  # high-water marks
+
+
+def observe(name: str, seconds: float) -> None:
+  """Accumulate a float span (e.g. "pipeline.download.stall_s")."""
+  with _COUNTERS_LOCK:
+    _TIMERS[name] += float(seconds)
+    _TIMER_COUNTS[name] += 1
+
+
+def gauge_max(name: str, value: float) -> None:
+  """Record a high-water mark (e.g. "pipeline.buffer.bytes" in flight)."""
+  with _COUNTERS_LOCK:
+    if value > _GAUGES[name]:
+      _GAUGES[name] = float(value)
+
+
+def timers_snapshot() -> Dict[str, dict]:
+  with _COUNTERS_LOCK:
+    out = {
+      name: {"seconds": round(total, 4), "count": _TIMER_COUNTS[name]}
+      for name, total in _TIMERS.items()
+    }
+    out.update({
+      name: {"max": round(v, 1)} for name, v in _GAUGES.items()
+    })
+    return out
 
 
 def emit_counters(event: str = "counters", **extra) -> dict:
@@ -47,6 +86,9 @@ def emit_counters(event: str = "counters", **extra) -> dict:
   graceful drain so retry/zombie/DLQ tallies survive the pod — the line
   is the worker's last will, greppable from `kubectl logs --previous`."""
   record = {"event": event, **extra, "counters": counters_snapshot()}
+  timers = timers_snapshot()
+  if timers:
+    record["spans"] = timers
   print(json.dumps(record), flush=True)
   return record
 

@@ -380,8 +380,11 @@ class Volume:
     nthreads = min(parallel or IO_THREADS, max(len(keys), 1))
     if nthreads <= 1 or len(keys) <= 1:
       return [self.cf.get(k) for k in keys]
-    with cf.ThreadPoolExecutor(max_workers=nthreads) as ex:
-      return list(ex.map(self.cf.get, keys))
+    # persistent pool: spawning a fresh executor per cutout showed up as
+    # pure thread-start overhead in the e2e profile (ISSUE 3)
+    from .pipeline.encoder import shared_io_pool
+
+    return list(shared_io_pool().map(self.cf.get, keys))
 
   def __getitem__(self, slices) -> np.ndarray:
     bbox = self._interpret_slices(slices)
@@ -428,7 +431,14 @@ class Volume:
     mip: Optional[int] = None,
     compress: Optional[str] = "gzip",
     parallel: Optional[int] = None,
+    sink=None,
   ):
+    """``sink`` (pipeline.UploadTicket / SerialSink): when given, chunk
+    encode+compress+put runs as submitted closures instead of inline —
+    the staged pipeline's parallel encode/upload stage. The caller owns
+    joining the sink before treating the upload as durable, and must not
+    mutate ``img`` until then. Bytes are identical either way (each
+    chunk encodes independently, gzip is mtime=0 deterministic)."""
     mip = self.mip if mip is None else mip
     if img.ndim == 3:
       img = img[..., np.newaxis]
@@ -477,7 +487,7 @@ class Volume:
       enc_kw["jpeg_quality"] = int(scale["jpeg_quality"])
     elif encoding == "png" and "png_level" in scale:
       enc_kw["png_level"] = int(scale["png_level"])
-    puts = []
+    jobs = []  # (key, cutout): encode deferred so a sink can thread it
     deletes = []
     for gchunk in chunk_bboxes(bbox, cs, offset=offset, clamp=False):
       chunk_bbx = Bbox.intersection(gchunk, bounds)  # stored chunk extent
@@ -511,11 +521,23 @@ class Volume:
       if self.delete_black_uploads and np.all(cutout == self.background_color):
         deletes.append(key)
         continue
-      puts.append((key, codecs.encode(
-        cutout, encoding, block_size=block_size, **enc_kw
-      )))
+      jobs.append((key, cutout))
 
-    self._parallel_put(puts, compress, parallel)
+    if sink is not None:
+      for key, cutout in jobs:
+        def encode_and_put(key=key, cutout=cutout):
+          self.cf.put(
+            key,
+            codecs.encode(cutout, encoding, block_size=block_size, **enc_kw),
+            compress=compress,
+          )
+        sink.submit(encode_and_put)
+    else:
+      puts = [
+        (key, codecs.encode(cutout, encoding, block_size=block_size, **enc_kw))
+        for key, cutout in jobs
+      ]
+      self._parallel_put(puts, compress, parallel)
     if deletes:
       self.cf.delete(deletes)
 
@@ -525,8 +547,11 @@ class Volume:
       for key, data in puts:
         self.cf.put(key, data, compress=compress)
       return
-    with cf.ThreadPoolExecutor(max_workers=nthreads) as ex:
-      list(ex.map(lambda kv: self.cf.put(kv[0], kv[1], compress=compress), puts))
+    from .pipeline.encoder import shared_io_pool
+
+    list(shared_io_pool().map(
+      lambda kv: self.cf.put(kv[0], kv[1], compress=compress), puts
+    ))
 
   def __setitem__(self, slices, img):
     bbox = self._interpret_slices(slices)

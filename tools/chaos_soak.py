@@ -104,6 +104,25 @@ def drain(queue, lease_seconds=0.5, deadline=120.0):
   )
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def pipeline_disabled():
+  """The CLEAN reference run always pins bytes with the strict-serial
+  path, even when --pipeline turns the staged pipeline on for the
+  fault/storm runs — that asymmetry IS the byte-identity claim."""
+  prev = os.environ.get("IGNEOUS_PIPELINE")
+  os.environ["IGNEOUS_PIPELINE"] = "off"
+  try:
+    yield
+  finally:
+    if prev is None:
+      os.environ.pop("IGNEOUS_PIPELINE", None)
+    else:
+      os.environ["IGNEOUS_PIPELINE"] = prev
+
+
 def run_pipeline(workdir, img, chaos_cfg=None, tag="", task_fn=None):
   layer = f"file://{workdir}/layer"
   Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
@@ -139,9 +158,10 @@ def poison_phase(workdir):
 def run_faults_scenario(scratch, img, seed):
   """ISSUE 1 acceptance: fault storm vs clean run, byte-identical; then
   the poison task must end in the DLQ."""
-  n_clean, clean = run_pipeline(
-    os.path.join(scratch, "clean"), img, tag="clean"
-  )
+  with pipeline_disabled():
+    n_clean, clean = run_pipeline(
+      os.path.join(scratch, "clean"), img, tag="clean"
+    )
 
   cfg = ChaosConfig(
     seed=seed,
@@ -237,10 +257,11 @@ def run_preemption_storm(scratch, img, seed):
       path, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
     ))
 
-  n_clean, clean = run_pipeline(
-    os.path.join(scratch, "storm-clean"), img, tag="storm-clean",
-    task_fn=storm_tasks,
-  )
+  with pipeline_disabled():
+    n_clean, clean = run_pipeline(
+      os.path.join(scratch, "storm-clean"), img, tag="storm-clean",
+      task_fn=storm_tasks,
+    )
 
   workdir = os.path.join(scratch, "storm")
   layer = f"file://{workdir}/layer"
@@ -344,9 +365,22 @@ def main():
                   default="faults",
                   help="faults: ISSUE 1 storage/queue fault storm; "
                        "preemption: ISSUE 2 worker kill storm + zombie")
+  ap.add_argument("--pipeline", action="store_true",
+                  help="run the soak with the staged execution pipeline "
+                       "enabled (ISSUE 3): the CLEAN reference run stays "
+                       "strict-serial while every fault/storm run executes "
+                       "through the pipeline's threaded encode/upload and "
+                       "prefetch stages — byte identity must still hold")
   args = ap.parse_args()
 
   os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  if args.pipeline:
+    # the clean run pins the reference bytes serially; run_pipeline's
+    # FileQueue.poll drains pick the pipeline up from the env (tier-A
+    # execute_with_sink), threads forced so 1-core CI still exercises
+    # real concurrency
+    os.environ["IGNEOUS_PIPELINE"] = "1"
+    os.environ["IGNEOUS_PIPELINE_THREADS"] = "1"
   scratch = tempfile.mkdtemp(prefix="chaos-soak-")
   telemetry.reset_counters()
   t0 = time.monotonic()
