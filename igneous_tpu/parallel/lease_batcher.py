@@ -222,6 +222,24 @@ class LeaseBatcher:
     more leases than the remaining budget, so ``--num-tasks N`` means N
     even when N < batch_size (stop_fn alone is only consulted between
     rounds and would overshoot by up to batch_size-1)."""
+    from ..queues.heartbeat import LeaseHeartbeat
+
+    # ONE heartbeat spans the whole poll loop, not one per round: round
+    # i+1's pre-leased members must keep renewing WHILE round i executes,
+    # or a round longer than lease_seconds would expire them and re-issue
+    # the tasks to other workers — the duplicate-execution window the
+    # heartbeats exist to close
+    self._hb = LeaseHeartbeat(
+      self.queue, self.lease_seconds, interval=self.heartbeat_seconds
+    )
+    self._hb.start()
+    try:
+      return self._poll_inner(stop_fn, max_backoff_window, task_budget)
+    finally:
+      self._hb.stop()
+      self._hb = None
+
+  def _poll_inner(self, stop_fn, max_backoff_window, task_budget) -> int:
     backoff = 1.0
     while True:
       if self._draining():
@@ -248,9 +266,11 @@ class LeaseBatcher:
         if leased is None:
           break
         members.append(leased)
+        self._hb.track(leased[1])
       if self._draining():
         # preempted between lease and dispatch: nothing ran, so every
-        # member goes straight back (no heartbeat is tracking them yet)
+        # member goes straight back (_release_members untracks each
+        # lease from the heartbeat as it releases)
         self._release_members(members)
         return self.stats["executed"]
       if not members:
@@ -262,7 +282,8 @@ class LeaseBatcher:
         backoff = min(backoff * 2, max_backoff_window)
         continue
       backoff = 1.0
-      # pipeline the NEXT round while this one dispatches/completes
+      # pipeline the NEXT round while this one dispatches/completes; the
+      # prefetch is fenced off every (path, mip) this round writes
       if len(members) == cap and (
         task_budget is None
         or task_budget - self.stats["executed"] - len(members) > 0
@@ -275,7 +296,8 @@ class LeaseBatcher:
         from ..pipeline import shared_prefetch_pool
 
         self._next_round = shared_prefetch_pool().submit(
-          self._prelease_and_prefetch, next_cap
+          self._prelease_and_prefetch, next_cap,
+          self._round_write_set(members),
         )
       if self.timing:
         import json
@@ -313,20 +335,84 @@ class LeaseBatcher:
     finally:
       self._img_cache.clear()
 
-  def _prelease_and_prefetch(self, cap: int):
+  def _round_write_set(self, members):
+    """Conservative (path, mip) image-chunk write set for a round's
+    members, or None when a member's writes are unknowable (an arbitrary
+    task type may write any layer). Fences the next round's cutout
+    prefetch off chunks this round is still producing."""
+    from ..tasks.ccl import CCLFacesTask
+    from ..tasks.image import TransferTask
+    from ..tasks.mesh import MeshTask
+    from ..tasks.skeleton import SkeletonTask
+
+    writes = set()
+    for task, _lease_id in members:
+      if isinstance(task, TransferTask):  # DownsampleTask included
+        if not task.skip_first:
+          writes.add((task.dest_path, int(task.mip)))
+        if task.skip_downsamples:
+          continue
+        if task.num_mips is None:
+          return None  # pyramid depth resolves from dest metadata
+        writes.update(
+          (task.dest_path, int(task.mip) + m)
+          for m in range(1, int(task.num_mips) + 1)
+        )
+      elif type(task) in (SkeletonTask, CCLFacesTask, MeshTask):
+        # these write frag/scratch artifacts, never the image chunks a
+        # downsample cutout prefetch reads
+        continue
+      else:
+        return None
+    return writes
+
+  def _invalidate_cache(self, writes):
+    """Drop prefetched cutouts whose (path, mip) a round wrote — a stale
+    image must never feed a later round's dispatch. ``writes=None``
+    (unknowable write set) drops everything."""
+    if writes is None:
+      self._img_cache.clear()
+      return
+    if not writes:
+      return
+    for ckey in [k for k in self._img_cache if (k[0], k[1]) in writes]:
+      self._img_cache.pop(ckey, None)
+
+  def _prelease_and_prefetch(self, cap: int, busy_writes=frozenset()):
     """Background half of the round pipeline: lease round i+1's members
     and download the cutouts its downsample groups will need, while
-    round i owns the device. Download failures are dropped silently —
-    the round's own download retries and surfaces the real error."""
+    round i owns the device. ``busy_writes`` is the running round's
+    (path, mip) write set: cutouts intersecting it are NOT downloaded
+    (their chunks are still changing under round i's uploads — the
+    round's own fetch reads them fresh after the writes land), and stale
+    cache leftovers matching it are dropped. Download failures are
+    dropped silently — the round's own download retries and surfaces the
+    real error."""
     members = []
     while len(members) < cap and not self._draining():
       leased = self.queue.lease(self.lease_seconds)
       if leased is None:
         break
+      if self._draining():
+        # the drain raced our lease: a member the dying round just
+        # released (or a fresh task) must go straight back UNCOUNTED —
+        # keeping it would double-account the same task as both a round
+        # release and a surrendered prefetch
+        try:
+          self.queue.release(leased[1])
+        except Exception:
+          pass
+        break
       members.append(leased)
+      if self._hb is not None:
+        # renew from the moment of pre-lease: round i may run longer
+        # than lease_seconds, and an expired pre-lease re-delivers the
+        # task to another worker while we still hold it
+        self._hb.track(leased[1])
     if not members:
       return members
     self.stats["prefetched_rounds"] += 1
+    self._invalidate_cache(busy_writes)
     # bound the cache: entries a round never consumed (handler fell back
     # solo, say) must not accumulate; insertion order evicts oldest
     while len(self._img_cache) > 2 * max(cap, 1):
@@ -348,6 +434,8 @@ class LeaseBatcher:
       ckey = _cutout_key(task)
       if ckey in self._img_cache:
         continue
+      if busy_writes is None or (ckey[0], ckey[1]) in busy_writes:
+        continue  # round i is still writing this (path, mip)
       vkey = (task.src_path, int(task.mip), bool(task.fill_missing))
       try:
         if vkey not in vols:
@@ -371,17 +459,24 @@ class LeaseBatcher:
     dispatched, solo members not yet executing) back to the queue."""
     from ..queues.heartbeat import LeaseHeartbeat
 
-    self._hb = LeaseHeartbeat(
-      self.queue, self.lease_seconds, interval=self.heartbeat_seconds
-    )
+    owns_hb = self._hb is None  # direct callers outside poll()
+    if owns_hb:
+      self._hb = LeaseHeartbeat(
+        self.queue, self.lease_seconds, interval=self.heartbeat_seconds
+      )
+      self._hb.start()
     for _task, lease_id in members:
-      self._hb.track(lease_id)
-    self._hb.start()
+      self._hb.track(lease_id)  # idempotent for pre-leased members
     try:
       self._run_round_inner(members)
     finally:
-      self._hb.stop()
-      self._hb = None
+      # cutouts this round's writes made stale must never feed a later
+      # round from the prefetch cache (a member re-leased after failure,
+      # say, whose cutout lingered unconsumed)
+      self._invalidate_cache(self._round_write_set(members))
+      if owns_hb:
+        self._hb.stop()
+        self._hb = None
 
   def _run_round_inner(self, members):
     volmeta_cache = {}

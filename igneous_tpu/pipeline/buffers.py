@@ -117,14 +117,6 @@ class BoundedBuffer:
           self._seq_grant = seq + 1
           self._not_full.notify_all()
 
-  def resize(self, old_nbytes: int, new_nbytes: int) -> None:
-    """Correct a reservation once the real payload size is known (the
-    producer estimated from task geometry before downloading)."""
-    with self._not_full:
-      self._bytes_held += int(new_nbytes) - int(old_nbytes)
-      telemetry.gauge_max(f"pipeline.{self.name}.bytes", self._bytes_held)
-      self._not_full.notify_all()
-
   def put(self, item) -> None:
     """Enqueue an item whose weight was already acquire()d."""
     with self._lock:

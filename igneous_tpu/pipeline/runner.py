@@ -20,7 +20,12 @@ Correctness rules the scheduler enforces:
   * **Write barriers** — a task whose read set intersects a pending
     task's write set (or that publishes no plan at all) waits for every
     in-flight upload before running; mixed streams degrade to serial
-    instead of racing reads against writes.
+    instead of racing reads against writes. Two writers of the same
+    (layer, mip) also barrier unless BOTH prove their writes chunk
+    aligned: Volume.upload's non-aligned path read-modify-writes
+    boundary chunks, so overlapped writers could drop each other's
+    voxels. Aligned writers (the planner's grid decomposition) touch
+    disjoint chunk objects and keep pipelining.
   * **Completion** — a task is reported executed only after its upload
     ticket joins; failures surface as that task's failure (the same
     retry/DLQ path a synchronous failure takes).
@@ -52,18 +57,29 @@ class StagePlan:
   ``reads``/``writes`` are sets of (layer_path, mip) used for conflict
   barriers; ``nbytes_hint`` is the decoded payload size estimate the
   byte budget reserves before the download starts.
+
+  ``aligned_writes=True`` asserts every write the plan issues is chunk
+  aligned (or clipped at dataset bounds) — i.e. Volume.upload will never
+  take its read-modify-write path — so the scheduler may overlap it with
+  other aligned writers of the same (layer_path, mip). Leave False
+  whenever alignment cannot be proven; unproven writers serialize
+  against any in-flight write to a shared key.
   """
 
-  __slots__ = ("download", "compute", "upload", "reads", "writes", "nbytes_hint")
+  __slots__ = (
+    "download", "compute", "upload", "reads", "writes", "nbytes_hint",
+    "aligned_writes",
+  )
 
   def __init__(self, download, compute, upload, reads=(), writes=(),
-               nbytes_hint: int = 0):
+               nbytes_hint: int = 0, aligned_writes: bool = False):
     self.download = download
     self.compute = compute
     self.upload = upload
     self.reads = frozenset(reads)
     self.writes = frozenset(writes)
     self.nbytes_hint = int(nbytes_hint)
+    self.aligned_writes = bool(aligned_writes)
 
 
 def stage_plan_of(task) -> Optional[StagePlan]:
@@ -77,7 +93,7 @@ def stage_plan_of(task) -> Optional[StagePlan]:
 
 
 class _Member:
-  __slots__ = ("task", "plan", "future", "nbytes", "ticket", "out_nbytes")
+  __slots__ = ("task", "plan", "future", "nbytes", "ticket")
 
   def __init__(self, task, plan):
     self.task = task
@@ -85,7 +101,6 @@ class _Member:
     self.future = None
     self.nbytes = 0
     self.ticket = None
-    self.out_nbytes = 0
 
 
 def run_tasks_pipelined(
@@ -119,23 +134,34 @@ def run_tasks_pipelined(
   lookahead: deque = deque()  # _Member admitted to the pipeline, in order
   uploading: deque = deque()  # members whose ticket is outstanding
   pending_writes: dict = {}   # (path, mip) -> refcount across uploading
+  pending_rmw: dict = {}      # subset from plans WITHOUT proven alignment
 
   def draining() -> bool:
     if drain_flag is not None and drain_flag.is_set():
       stats["drained"] = True
     return stats["drained"]
 
+  def _refcount_add(table, keys):
+    for key in keys:
+      table[key] = table.get(key, 0) + 1
+
+  def _refcount_remove(table, keys):
+    for key in keys:
+      n = table.get(key, 0) - 1
+      if n <= 0:
+        table.pop(key, None)
+      else:
+        table[key] = n
+
   def writes_add(member):
-    for key in member.plan.writes:
-      pending_writes[key] = pending_writes.get(key, 0) + 1
+    _refcount_add(pending_writes, member.plan.writes)
+    if not member.plan.aligned_writes:
+      _refcount_add(pending_rmw, member.plan.writes)
 
   def writes_remove(member):
-    for key in member.plan.writes:
-      n = pending_writes.get(key, 0) - 1
-      if n <= 0:
-        pending_writes.pop(key, None)
-      else:
-        pending_writes[key] = n
+    _refcount_remove(pending_writes, member.plan.writes)
+    if not member.plan.aligned_writes:
+      _refcount_remove(pending_rmw, member.plan.writes)
 
   def join_member(member, raise_errors=True):
     """Join one member's uploads; account completion or failure."""
@@ -143,7 +169,7 @@ def run_tasks_pipelined(
       member.ticket.join()
     except Exception as e:  # noqa: BLE001 - routed to containment hook
       writes_remove(member)
-      buffer.release(member.out_nbytes)
+      buffer.release(member.nbytes)
       stats["failed"] += 1
       telemetry.incr("pipeline.tasks.failed")
       if on_error is not None:
@@ -153,7 +179,7 @@ def run_tasks_pipelined(
         raise
       return
     writes_remove(member)
-    buffer.release(member.out_nbytes)
+    buffer.release(member.nbytes)
     stats["executed"] += 1
     stats["staged"] += 1
     if on_complete is not None:
@@ -211,7 +237,19 @@ def run_tasks_pipelined(
   def conflicts(member) -> bool:
     if member.plan is None:
       return True
-    return any(key in pending_writes for key in member.plan.reads)
+    if any(key in pending_writes for key in member.plan.reads):
+      return True
+    # write-write: a non-aligned writer read-modify-writes boundary
+    # chunks (Volume.upload does cf.get at submit), so it must not
+    # overlap ANY in-flight writer of the same (path, mip) — and no
+    # writer may overlap an in-flight NON-ALIGNED one, whose RMW chunks
+    # can extend past its own bbox. Aligned-vs-aligned writers touch
+    # disjoint chunk objects and keep pipelining.
+    if any(key in pending_rmw for key in member.plan.writes):
+      return True
+    if not member.plan.aligned_writes:
+      return any(key in pending_writes for key in member.plan.writes)
+    return False
 
   try:
     depth = config.prefetch_depth()
@@ -220,7 +258,8 @@ def run_tasks_pipelined(
       if draining():
         break
       # keep up to `depth` stageable downloads in flight; admission stops
-      # at the first task that must barrier (no plan, or read conflict)
+      # at the first task that must barrier (no plan, or a read/write
+      # conflict with an in-flight write)
       while not done and len(lookahead) < depth + 1:
         if lookahead and (
           lookahead[-1].plan is None or lookahead[-1].future is None
@@ -259,7 +298,7 @@ def run_tasks_pipelined(
         continue
 
       if member.future is None:
-        # admitted with a read conflict: barrier, then download inline
+        # admitted with a read/write conflict: barrier, then download inline
         upload_barrier()
         if draining():
           break
@@ -299,10 +338,10 @@ def run_tasks_pipelined(
         fail_member(member, e)
         continue
 
-      # the decoded payload is consumed; outputs (≈1/3 the bytes for a
-      # (2,2,1) pyramid) stay reserved until the uploads land
-      member.out_nbytes = max(member.nbytes // 3, 1)
-      buffer.resize(member.nbytes, member.out_nbytes)
+      # the pending upload closures keep the decoded payload alive
+      # (chunk cutouts are views pinning the base array), so the FULL
+      # reservation stays held until the ticket joins — shrinking it
+      # here would let resident memory exceed the byte budget
       uploading.append(member)
 
   finally:

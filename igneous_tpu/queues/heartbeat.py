@@ -65,10 +65,13 @@ class LeaseHeartbeat:
     self._thread: Optional[threading.Thread] = None
 
   def track(self, lease_id):
-    """Begin renewing ``lease_id``; returns the key for current()/untrack()."""
+    """Begin renewing ``lease_id``; returns the key for current()/untrack().
+    Idempotent: re-tracking an already-tracked lease (a pre-leased batch
+    member tracked again at round start) keeps the renewed current token
+    instead of clobbering it with the stale original."""
     if self.enabled:
       with self._lock:
-        self._current[lease_id] = lease_id
+        self._current.setdefault(lease_id, lease_id)
     return lease_id
 
   def current(self, key):

@@ -275,7 +275,39 @@ class TransferTask(RegisteredTask):
     return StagePlan(
       download, compute, upload, reads=reads, writes=writes,
       nbytes_hint=nbytes,
+      aligned_writes=self._writes_chunk_aligned(dest, dest_bounds, factors),
     )
+
+  def _writes_chunk_aligned(self, dest, dest_bounds: Bbox, factors) -> bool:
+    """True when every bbox upload() will write — the first-mip cutout
+    and each pyramid level (the same bounds walk downsample_and_upload
+    does, with the kernels' ceil-division output shapes) — is chunk
+    aligned or clipped at dataset bounds, i.e. Volume.upload never takes
+    its read-modify-write path. Proven-aligned plans may overlap other
+    aligned writers of the same (path, mip) in the staged pipeline."""
+    def aligned(box: Bbox, mip: int) -> bool:
+      if box.empty():
+        return True  # writes nothing
+      expanded = box.expand_to_chunk_size(
+        dest.meta.chunk_size(mip), dest.meta.voxel_offset(mip)
+      )
+      return Bbox.intersection(expanded, dest.meta.bounds(mip)) == box
+
+    if not self.skip_first and not aligned(dest_bounds, self.mip):
+      return False
+    cur_min = dest_bounds.minpt
+    cur_shape = np.asarray([int(v) for v in dest_bounds.size3()], dtype=np.int64)
+    for i, f in enumerate(factors):
+      fa = np.asarray([int(v) for v in f], dtype=np.int64)
+      cur_min = Vec(*(np.asarray(cur_min, dtype=np.int64) // fa))
+      cur_shape = -(-cur_shape // fa)
+      dest_mip = self.mip + i + 1
+      box = Bbox.intersection(
+        Bbox(cur_min, cur_min + Vec(*cur_shape)), dest.meta.bounds(dest_mip)
+      )
+      if not aligned(box, dest_mip):
+        return False
+    return True
 
   def _raw_copy_eligible(self, src, dest, bounds: Bbox) -> bool:
     """When the grids, dtype, and encoding line up exactly and no

@@ -91,6 +91,10 @@ class ImageShardTransferTask(RegisteredTask):
       reads={(self.src_path, self.mip)},
       writes={(self.dest_path, self.mip)},
       nbytes_hint=nbytes,
+      # shard files are immutable and each is written exactly once by
+      # the task owning its shard-aligned bbox: no read-modify-write, so
+      # same-(path, mip) shard writers may overlap in the pipeline
+      aligned_writes=True,
     )
 
 
@@ -178,4 +182,6 @@ class ImageShardDownsampleTask(RegisteredTask):
       reads={(self.src_path, self.mip)},
       writes={(self.src_path, m) for m, _ in dest_mips},
       nbytes_hint=nbytes,
+      # immutable one-shot shard writes (see ImageShardTransferTask)
+      aligned_writes=True,
     )

@@ -377,11 +377,12 @@ class Volume:
     return out
 
   def _parallel_get(self, keys: List[str], parallel: Optional[int]) -> List[Optional[bytes]]:
-    nthreads = min(parallel or IO_THREADS, max(len(keys), 1))
-    if nthreads <= 1 or len(keys) <= 1:
+    # parallel=1 keeps strict serial semantics; anything wider rides the
+    # fixed-width shared pool — spawning a fresh executor per cutout (to
+    # honor an exact thread count) showed up as pure thread-start
+    # overhead in the e2e profile (ISSUE 3)
+    if (parallel or IO_THREADS) <= 1 or len(keys) <= 1:
       return [self.cf.get(k) for k in keys]
-    # persistent pool: spawning a fresh executor per cutout showed up as
-    # pure thread-start overhead in the e2e profile (ISSUE 3)
     from .pipeline.encoder import shared_io_pool
 
     return list(shared_io_pool().map(self.cf.get, keys))
@@ -542,8 +543,9 @@ class Volume:
       self.cf.delete(deletes)
 
   def _parallel_put(self, puts, compress, parallel: Optional[int]):
-    nthreads = min(parallel or IO_THREADS, max(len(puts), 1))
-    if nthreads <= 1 or len(puts) <= 1:
+    # same policy as _parallel_get: parallel=1 is serial, wider requests
+    # share the fixed-width pool
+    if (parallel or IO_THREADS) <= 1 or len(puts) <= 1:
       for key, data in puts:
         self.cf.put(key, data, compress=compress)
       return

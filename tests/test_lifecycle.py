@@ -262,6 +262,38 @@ def test_poll_loop_drain_finishes_inflight_only(tmp_path):
     SetDrainFlagTask.flag = None
 
 
+def test_preleased_members_heartbeat_from_lease_time(tmp_path):
+  """Round i+1's pre-leased members renew from the moment they are
+  leased — NOT only once their own round starts — so a round i that
+  outlives lease_seconds cannot let them expire and re-deliver (the
+  duplicate-execution window the heartbeats exist to close)."""
+  from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([PrintTask(str(i)) for i in range(3)])
+  b = LeaseBatcher(q, batch_size=3, lease_seconds=5.0)
+  # manual beats; a longer renew window so the re-timestamped fq token
+  # visibly differs from the original
+  b._hb = LeaseHeartbeat(q, lease_seconds=60.0, interval=10.0)
+  try:
+    members = b._prelease_and_prefetch(3)
+    assert len(members) == 3
+    # tracked immediately at lease time, before any round runs them
+    assert set(b._hb._current) == {lid for _t, lid in members}
+    b._hb.beat()
+    assert b._hb.renewals == 3
+    for _t, lid in members:
+      # run_round re-tracks pre-leased members: the renewed current
+      # token must survive (track is idempotent, not clobbering)
+      b._hb.track(lid)
+      assert b._hb.current(lid) != lid
+    for _t, lid in members:
+      assert q.delete(b._hb.untrack(lid)) is True
+    assert q.is_empty() and q.completed == 3
+  finally:
+    b._hb = None
+
+
 def test_batcher_drain_releases_unstarted_members(tmp_path):
   """SIGTERM mid-batch: members not yet started go straight back to the
   queue instead of aging out on a dead pod."""

@@ -278,6 +278,166 @@ def test_bounded_buffer_budget_and_interrupt():
   assert err == [True]
 
 
+class _PlanTask:
+  """Minimal task publishing a hand-built StagePlan."""
+
+  def __init__(self, plan):
+    self._plan = plan
+
+  def stage_plan(self):
+    return self._plan
+
+  def execute(self):
+    raise AssertionError("staged task must not run solo")
+
+
+def test_unaligned_write_write_serializes(forced_threads):
+  """Two pipelined tasks writing the same (layer, mip) WITHOUT proven
+  chunk alignment must not overlap: Volume.upload's read-modify-write
+  path reads chunks at submit time, so an overlapped second writer could
+  drop the first one's voxels. The second task's download must wait for
+  the first's uploads to join."""
+  import time as _time
+
+  from igneous_tpu.pipeline.runner import StagePlan
+
+  log = []
+  upload_started = threading.Event()
+  release_upload = threading.Event()
+
+  def a_upload(outputs, sink):
+    def put():
+      upload_started.set()
+      assert release_upload.wait(10)
+      log.append("A.put")
+    sink.submit(put)
+
+  tasks = [
+    _PlanTask(StagePlan(
+      lambda: None, lambda p: None, a_upload,
+      writes={("mem://pipe/ww", 0)},
+    )),
+    _PlanTask(StagePlan(
+      lambda: log.append("B.download"), lambda p: None, lambda o, s: None,
+      writes={("mem://pipe/ww", 0)},
+    )),
+  ]
+  runner = threading.Thread(
+    target=lambda: run_tasks_pipelined(tasks), daemon=True
+  )
+  runner.start()
+  assert upload_started.wait(10)
+  _time.sleep(0.25)  # ample time for a (buggy) overlapped download
+  assert "B.download" not in log, "write-write overlap during A's upload"
+  release_upload.set()
+  runner.join(10)
+  assert not runner.is_alive()
+  assert log == ["A.put", "B.download"]
+
+
+def test_aligned_same_key_writers_keep_pipelining(forced_threads):
+  """Provably chunk-aligned writers of the same (layer, mip) touch
+  disjoint chunk objects — the second task's download overlaps the
+  first's in-flight upload (the pipeline win for a grid-aligned
+  downsample fleet must survive the write-write barrier)."""
+  from igneous_tpu.pipeline.runner import StagePlan
+
+  b_downloaded = threading.Event()
+  release_upload = threading.Event()
+
+  def a_upload(outputs, sink):
+    sink.submit(lambda: release_upload.wait(10))
+
+  tasks = [
+    _PlanTask(StagePlan(
+      lambda: None, lambda p: None, a_upload,
+      writes={("mem://pipe/wwa", 0)}, aligned_writes=True,
+    )),
+    _PlanTask(StagePlan(
+      lambda: b_downloaded.set(), lambda p: None, lambda o, s: None,
+      writes={("mem://pipe/wwa", 0)}, aligned_writes=True,
+    )),
+  ]
+  runner = threading.Thread(
+    target=lambda: run_tasks_pipelined(tasks), daemon=True
+  )
+  runner.start()
+  assert b_downloaded.wait(10), "aligned same-key writers serialized"
+  release_upload.set()
+  runner.join(10)
+  assert not runner.is_alive()
+
+
+def test_plans_prove_write_alignment(rng):
+  """The planner's grid decomposition proves aligned_writes (so fleets
+  keep pipelining); a non-aligned translate cannot prove it."""
+  from igneous_tpu.tasks.image import TransferTask
+
+  img = _fixture(rng, (64, 64, 32))
+  clear_memory_storage()
+  Volume.from_numpy(img, "mem://pipe/al", chunk_size=(16, 16, 16))
+  plans = [t.stage_plan() for t in _make_tasks("mem://pipe/al", num_mips=1)]
+  assert plans and all(p.aligned_writes for p in plans)
+
+  Volume.from_numpy(
+    np.zeros_like(img), "mem://pipe/al_dst", chunk_size=(32, 32, 32)
+  )
+  def transfer(translate):
+    return TransferTask(
+      src_path="mem://pipe/al", dest_path="mem://pipe/al_dst",
+      mip=0, shape=(32, 32, 32), offset=(0, 0, 0),
+      skip_downsamples=True, translate=translate,
+    )
+  assert transfer((0, 0, 0)).stage_plan().aligned_writes
+  assert not transfer((1, 0, 0)).stage_plan().aligned_writes
+
+
+def test_prefetch_fenced_off_running_round_writes(rng, tmp_path, monkeypatch):
+  """While round i writes (layer, mip 1), the round i+1 prefetch must
+  not download mip-1 cutouts (their bytes are still changing under
+  round i's uploads) and must drop stale cache entries for that key —
+  the round's own fetch reads fresh bytes after the writes land."""
+  from igneous_tpu.downsample_scales import create_downsample_scales
+  from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+  from igneous_tpu.queues import FileQueue
+  from igneous_tpu.tasks.image import DownsampleTask
+
+  monkeypatch.setenv("IGNEOUS_POOL_HOST", "0")  # device path: groupable
+  img = _fixture(rng, (64, 64, 16))
+  clear_memory_storage()
+  Volume.from_numpy(img, "mem://pipe/fence", chunk_size=(8, 8, 8))
+  vol = Volume("mem://pipe/fence")
+  create_downsample_scales(vol.meta, 0, (16, 16, 16), (2, 2, 1), num_mips=2)
+  vol.commit_info()
+
+  def ds(mip, offset):
+    return DownsampleTask(
+      layer_path="mem://pipe/fence", mip=mip, shape=(16, 16, 16),
+      offset=offset, num_mips=1, factor=(2, 2, 1),
+    )
+
+  b = LeaseBatcher(FileQueue(f"fq://{tmp_path}/q"), batch_size=4)
+  busy = b._round_write_set([(ds(0, (x, 0, 0)), f"l{x}") for x in (0, 16)])
+  assert busy == {("mem://pipe/fence", 1)}
+
+  # round i+1 READS mip 1 — exactly what round i is still writing
+  b.queue.insert([ds(1, (x, 0, 0)) for x in (0, 16)])
+  b._img_cache[("mem://pipe/fence", 1, (0, 0, 0), (16, 16, 16))] = "stale"
+  members = b._prelease_and_prefetch(2, busy)
+  assert len(members) == 2
+  assert b.stats["prefetched_cutouts"] == 0
+  assert not b._img_cache, "stale cutout survived the write fence"
+  b._release_members(members)
+  assert b.queue.enqueued == 2
+
+  # non-conflicting sources (mip-0 reads vs mip-1 writes) still prefetch
+  b.queue.insert([ds(0, (32, y, 0)) for y in (0, 16)])
+  members = b._prelease_and_prefetch(4, busy)
+  assert len(members) == 4
+  assert b.stats["prefetched_cutouts"] == 2  # the two mip-0 cutouts only
+  b._release_members(members)
+
+
 def test_raw_copy_transfer_stays_solo(rng):
   """A raw-copy-eligible TransferTask publishes no stage plan (the chunk
   stream path is already optimal) and still executes correctly."""
