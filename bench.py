@@ -369,6 +369,159 @@ def measure_inflate_MBps(seg):
   return round(max(rates), 1)
 
 
+def bench_codecs(img, seg):
+  """Per-codec bandwidth table (ISSUE 4 satellite): MB/s of DECODED bytes
+  through each chunk codec + wire compressor, on one stored-chunk-sized
+  cutout of the bench fixtures. ``cseg``/``compresso`` run their
+  production path (native C++ where a toolchain exists, bulk-NumPy
+  otherwise); ``zstd`` is None when the codec doesn't ship."""
+  from igneous_tpu import codecs
+  from igneous_tpu.storage import compress_bytes, decompress_bytes
+
+  chunk = np.asfortranarray(seg[:128, :128, :64, np.newaxis])
+  u8chunk = np.asfortranarray(img[:128, :128, :64, np.newaxis])
+  out = {}
+
+  def rate(nbytes, fn, n=3):
+    best = min(_timed(fn) for _ in range(n))
+    return round(nbytes / best / 1e6, 1)
+
+  def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+  raw_bytes = codecs.encode(chunk, "raw")
+  out["raw_encode_MBps"] = rate(chunk.nbytes, lambda: codecs.encode(chunk, "raw"))
+  out["raw_decode_MBps"] = rate(
+    chunk.nbytes,
+    lambda: codecs.decode(raw_bytes, "raw", chunk.shape, chunk.dtype, writable=False),
+  )
+  cs = codecs.encode(chunk, "compressed_segmentation")
+  out["cseg_encode_MBps"] = rate(
+    chunk.nbytes, lambda: codecs.encode(chunk, "compressed_segmentation")
+  )
+  out["cseg_decode_MBps"] = rate(
+    chunk.nbytes,
+    lambda: codecs.decode(cs, "compressed_segmentation", chunk.shape, chunk.dtype),
+  )
+  cp = codecs.encode(chunk, "compresso")
+  out["compresso_encode_MBps"] = rate(
+    chunk.nbytes, lambda: codecs.encode(chunk, "compresso")
+  )
+  out["compresso_decode_MBps"] = rate(
+    chunk.nbytes, lambda: codecs.decode(cp, "compresso", chunk.shape, chunk.dtype)
+  )
+  # wire compressors measured over the raw u8 image chunk (the EM-image
+  # common case; segmentation normally rides cseg/compresso underneath)
+  u8raw = codecs.encode(u8chunk, "raw")
+  gz = compress_bytes(u8raw, "gzip")
+  out["gzip_deflate_MBps"] = rate(len(u8raw), lambda: compress_bytes(u8raw, "gzip"))
+  out["gzip_inflate_MBps"] = rate(len(u8raw), lambda: decompress_bytes(gz, "gzip"))
+  try:
+    zs = compress_bytes(u8raw, "zstd")
+    out["zstd_deflate_MBps"] = rate(len(u8raw), lambda: compress_bytes(u8raw, "zstd"))
+    out["zstd_inflate_MBps"] = rate(len(u8raw), lambda: decompress_bytes(zs, "zstd"))
+  except ImportError:
+    out["zstd_deflate_MBps"] = None
+    out["zstd_inflate_MBps"] = None
+  return out
+
+
+def bench_cseg_speedup():
+  """Fast cseg paths vs the per-block loop reference (ISSUE 4 tentpole
+  acceptance), on two fixtures: ``uniform`` — 16^3-celled segmentation
+  (the realistic connectomics case: blocks interior to one object
+  dominate, F-ordered like a download cutout); ``mixed`` — the same chunk
+  with 2%% salt noise so nearly every block takes the sort path (worst
+  case). ``fast`` is the production compress/decompress (native C++ here
+  when a toolchain exists); ``numpy`` pins the pure bulk-NumPy fallback
+  (IGNEOUS_TPU_NO_NATIVE honored per call)."""
+  from igneous_tpu import cseg
+
+  rng = np.random.default_rng(7)
+  cells = rng.integers(1, 2**40, size=(8, 8, 4)).astype(np.uint64)
+  uniform = np.asfortranarray(np.kron(cells, np.ones((16, 16, 16), np.uint64)))
+  mixed = uniform.copy(order="F")
+  mixed[rng.random(mixed.shape) < 0.02] = 0
+  out = {}
+  for name, labels in (("uniform", uniform), ("mixed", mixed)):
+    shape4 = labels.shape + (1,)
+    t0 = time.perf_counter()
+    cseg._encode_channel_loop(labels, (8, 8, 8))
+    enc_loop = time.perf_counter() - t0
+    data = cseg.compress(labels)
+    t0 = time.perf_counter()
+    cseg._decompress_loop(data, shape4, np.uint64)
+    dec_loop = time.perf_counter() - t0
+
+    def best(fn, n=3):
+      best_t = 1e9
+      for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best_t = min(best_t, time.perf_counter() - t0)
+      return best_t
+
+    enc_fast = best(lambda: cseg.compress(labels))
+    dec_fast = best(lambda: cseg.decompress(data, shape4, np.uint64))
+    os.environ["IGNEOUS_TPU_NO_NATIVE"] = "1"
+    try:
+      enc_np = best(lambda: cseg.compress(labels))
+      dec_np = best(lambda: cseg.decompress(data, shape4, np.uint64))
+    finally:
+      os.environ.pop("IGNEOUS_TPU_NO_NATIVE", None)
+    out[name] = {
+      "encode_loop_ms": round(enc_loop * 1e3, 1),
+      "decode_loop_ms": round(dec_loop * 1e3, 1),
+      "fast_encode_speedup": round(enc_loop / enc_fast, 1),
+      "fast_decode_speedup": round(dec_loop / dec_fast, 1),
+      "numpy_encode_speedup": round(enc_loop / enc_np, 1),
+      "numpy_decode_speedup": round(dec_loop / dec_np, 1),
+    }
+  return out
+
+
+def bench_transfer_passthrough(seg):
+  """Aligned same-geometry transfer throughput (ISSUE 4 tentpole): the
+  compressed-domain passthrough (stored bytes move verbatim) vs the same
+  transfer forced down the decode/re-encode path. Returns
+  (passthrough_voxps, decode_voxps)."""
+  from igneous_tpu import chunk_cache
+  from igneous_tpu.storage import clear_memory_storage
+  from igneous_tpu.tasks.image import TransferTask
+  from igneous_tpu.volume import Volume
+
+  sub = np.ascontiguousarray(seg[:256, :256, :128])
+  clear_memory_storage()
+  src = Volume.from_numpy(
+    sub, "mem://bench/xfer_src", chunk_size=(128, 128, 64),
+    layer_type="segmentation", encoding="compressed_segmentation",
+  )
+
+  def run_transfer(dest_path):
+    chunk_cache.clear()
+    task = TransferTask(
+      src_path="mem://bench/xfer_src", dest_path=dest_path, mip=0,
+      shape=sub.shape, offset=(0, 0, 0), skip_downsamples=True,
+    )
+    Volume.create(
+      dest_path, Volume("mem://bench/xfer_src").info,
+    )
+    t0 = time.perf_counter()
+    task.execute()
+    return sub.size / (time.perf_counter() - t0)
+
+  passthrough = max(run_transfer(f"mem://bench/xfer_pt{i}") for i in range(2))
+  os.environ["IGNEOUS_TRANSFER_PASSTHROUGH"] = "off"
+  try:
+    decode = max(run_transfer(f"mem://bench/xfer_dec{i}") for i in range(2))
+  finally:
+    os.environ.pop("IGNEOUS_TRANSFER_PASSTHROUGH", None)
+  clear_memory_storage()
+  return round(passthrough, 1), round(decode, 1)
+
+
 def measure_transfer_MBps():
   import jax
 
@@ -586,13 +739,17 @@ def run_bench(platform: str):
   up, down = measure_transfer_MBps()
   mesh_rate = bench_mesh_kernel()
   ccl_rate = bench_ccl_kernel("scan")
-  # the gather-free variant is only worth timing where gathers are the
-  # question (TPU); on the CPU-fallback path it would blow the child
-  # deadline for a number BASELINE doesn't use
-  ccl_relax_rate = bench_ccl_kernel("relax") if platform == "tpu" else None
+  # run the gather-free variant on the CPU fallback too (ISSUE 4
+  # satellite): every run so far recorded null here because it was gated
+  # on platform == "tpu", so the trajectory had no number to compare when
+  # a TPU round finally lands
+  ccl_relax_rate = bench_ccl_kernel("relax")
   pool_ab = bench_pool_ab() if platform == "tpu" else None
   edt_rate = bench_edt_kernel()
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
+  codec_tbl = bench_codecs(img, seg)
+  cseg_speedup = bench_cseg_speedup()
+  xfer_passthrough, xfer_decode = bench_transfer_passthrough(seg)
 
   # Headline = the framework's production kernel path on this platform:
   # device pyramid on TPU; on the CPU fallback, the native threaded host
@@ -652,6 +809,14 @@ def run_bench(platform: str):
       "ccl_kernel_voxps": round(ccl_rate, 1),
       "ccl_relax_kernel_voxps": (
         round(ccl_relax_rate, 1) if ccl_relax_rate is not None else None
+      ),
+      # ISSUE 4: compressed-domain fast paths
+      "codec_MBps": codec_tbl,
+      "cseg_vs_loop": cseg_speedup,
+      "transfer_passthrough_voxps": xfer_passthrough,
+      "transfer_decode_voxps": xfer_decode,
+      "transfer_passthrough_speedup": (
+        round(xfer_passthrough / xfer_decode, 2) if xfer_decode else None
       ),
       "edt_kernel_voxps": round(edt_rate, 1),
       "pool_ab": pool_ab,

@@ -369,14 +369,20 @@ class LeaseBatcher:
   def _invalidate_cache(self, writes):
     """Drop prefetched cutouts whose (path, mip) a round wrote — a stale
     image must never feed a later round's dispatch. ``writes=None``
-    (unknowable write set) drops everything."""
+    (unknowable write set) drops everything. The shared chunk decode
+    cache follows the same fence (its digest keys keep late readers
+    correct regardless; this frees doomed entries at the round edge)."""
+    from .. import chunk_cache
+
     if writes is None:
       self._img_cache.clear()
+      chunk_cache.clear()
       return
     if not writes:
       return
     for ckey in [k for k in self._img_cache if (k[0], k[1]) in writes]:
       self._img_cache.pop(ckey, None)
+    chunk_cache.invalidate_writes(writes)
 
   def _prelease_and_prefetch(self, cap: int, busy_writes=frozenset()):
     """Background half of the round pipeline: lease round i+1's members

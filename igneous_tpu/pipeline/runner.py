@@ -42,7 +42,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
-from .. import telemetry
+from .. import chunk_cache, telemetry
 from . import config
 from .buffers import BoundedBuffer, PipelineInterrupted
 from .encoder import SerialSink, shared_encode_pool, shared_prefetch_pool
@@ -169,6 +169,9 @@ def run_tasks_pipelined(
       member.ticket.join()
     except Exception as e:  # noqa: BLE001 - routed to containment hook
       writes_remove(member)
+      # even a failed ticket may have landed some chunk objects: doomed
+      # decode-cache entries under the written (path, mip)s go now
+      chunk_cache.invalidate_writes(member.plan.writes)
       buffer.release(member.nbytes)
       stats["failed"] += 1
       telemetry.incr("pipeline.tasks.failed")
@@ -179,6 +182,9 @@ def run_tasks_pipelined(
         raise
       return
     writes_remove(member)
+    # the writes just landed: the same (path, mip) fencing the prefetch
+    # write-set enforces, applied to the shared chunk decode cache
+    chunk_cache.invalidate_writes(member.plan.writes)
     buffer.release(member.nbytes)
     stats["executed"] += 1
     stats["staged"] += 1

@@ -80,6 +80,17 @@ def compress_bytes(data: bytes, method) -> bytes:
   raise ValueError(f"Unsupported compression: {method}")
 
 
+def wire_ext(compress) -> Optional[str]:
+  """The on-wire filename extension a ``compress=`` selection produces
+  ("" for uncompressed), or None when the method is unknown — callers
+  treat None as "not eligible for a compressed-domain move" and take the
+  decode path, where the unknown method raises with full context."""
+  try:
+    return COMPRESSION_EXTS[compress]
+  except (KeyError, TypeError):
+    return None
+
+
 def scratch_compression(default="gzip"):
   """Compression for INTERMEDIATE artifacts (.frags containers, CCL face
   planes, transfer scratch) — objects a later merge/fixup task consumes
@@ -442,6 +453,18 @@ class CloudFiles:
     if data is None:
       return None
     return data if raw else decompress_bytes(data, method)
+
+  def get_stored(self, key: str) -> Tuple[Optional[bytes], Optional[str]]:
+    """(stored bytes, wire compression method) — the compressed-domain
+    read: callers that only need to MOVE or digest an object skip the
+    inflate entirely (zero-decode transfers, decode-cache keys)."""
+    return self._resolve(key)
+
+  def put_stored(self, key: str, data: bytes, method) -> None:
+    """Store already-wire-compressed bytes verbatim under the extension
+    ``method`` implies — the zero-decode transfer's write half. ``method``
+    must name the compression the bytes actually carry."""
+    self.backend.put(key + COMPRESSION_EXTS[method], bytes(data))
 
   def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
     """Ranged read of an UNCOMPRESSED object (sharded-format reads).

@@ -15,6 +15,7 @@ what keeps label compute in the TPU's native integer width).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,6 +35,13 @@ from .. import telemetry
 # empty-cutout tasks stage as no-ops: the pipeline treats them uniformly
 # instead of barriering the stream for a solo no-op execute()
 _NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
+
+
+def _passthrough_enabled() -> bool:
+  """``IGNEOUS_TRANSFER_PASSTHROUGH=0|off`` forces eligible transfers down
+  the decode/re-encode path (debugging aid + the bench's A/B switch)."""
+  val = os.environ.get("IGNEOUS_TRANSFER_PASSTHROUGH", "1").strip().lower()
+  return val not in ("0", "off", "false", "no")
 
 
 def _resolve_factors(
@@ -197,25 +205,27 @@ class TransferTask(RegisteredTask):
     src, dest, bounds = self._volumes_and_bounds()
     if bounds.empty():
       return
-    if self._try_raw_copy(src, dest, bounds):
-      return
     from ..pipeline import SerialSink
 
     # solo execution runs the SAME stage code the pipeline schedules —
     # one implementation, one set of bytes
-    plan = self._build_plan(src, dest, bounds)
+    plan = self._plan_for(src, dest, bounds)
     plan.upload(plan.compute(plan.download()), SerialSink())
 
   def stage_plan(self):
     """Pipeline decomposition (pipeline.runner.StagePlan): download the
     cutout / build the pyramid / route chunk encode+put through the
-    sink. None routes the task solo — the raw-copy fast path is pure
-    streaming IO with no compute stage to overlap."""
+    sink. Passthrough-eligible transfers publish a compressed-domain
+    plan (stored-byte moves with no decode/compute), so they overlap
+    with the rest of the stream instead of barriering it."""
     src, dest, bounds = self._volumes_and_bounds()
     if bounds.empty():
       return _NOOP_PLAN
-    if self._raw_copy_eligible(src, dest, bounds):
-      return None
+    return self._plan_for(src, dest, bounds)
+
+  def _plan_for(self, src, dest, bounds: Bbox):
+    if self._passthrough_eligible(src, dest, bounds):
+      return self._passthrough_plan(src, dest, bounds)
     return self._build_plan(src, dest, bounds)
 
   def _build_plan(self, src, dest, bounds: Bbox):
@@ -309,21 +319,29 @@ class TransferTask(RegisteredTask):
         return False
     return True
 
-  def _raw_copy_eligible(self, src, dest, bounds: Bbox) -> bool:
+  def _passthrough_eligible(self, src, dest, bounds: Bbox) -> bool:
     """When the grids, dtype, and encoding line up exactly and no
     resampling/remapping is requested, stored chunk objects can be
-    copied without decoding a single voxel (reference image.py:483-497
-    `transfer_to` fast path)."""
+    moved without decoding a single voxel (reference image.py:483-497
+    `transfer_to` fast path, Palace-style compressed-domain residency)."""
+    from ..storage import wire_ext
+
     mip = self.mip
     sm, dm = src.meta, dest.meta
     return (
-      self.skip_downsamples
+      _passthrough_enabled()
+      and self.skip_downsamples
       and not self.skip_first  # skip_first + skip_downsamples = no-op
       and not self.agglomerate
       and self.stop_layer is None
       # fill_missing's decode path writes explicit zero chunks for holes;
       # a raw copy would silently leave them missing
       and not self.fill_missing
+      # delete_black_uploads' decode path DELETES all-background chunks;
+      # a stored-byte move cannot tell black from data without decoding
+      and not self.delete_black_uploads
+      # unknown wire compression: the decode path raises with context
+      and wire_ext(self.compress) is not None
       and tuple(int(v) for v in self.translate) == (0, 0, 0)
       # equal bounds: edge chunks are clamped to the volume bounds in
       # their NAMES — differing extents would file src-clamped chunks
@@ -344,28 +362,83 @@ class TransferTask(RegisteredTask):
       )
     )
 
-  def _try_raw_copy(self, src, dest, bounds: Bbox) -> bool:
-    if not self._raw_copy_eligible(src, dest, bounds):
-      return False
+  def _passthrough_plan(self, src, dest, bounds: Bbox):
+    """Compressed-domain transfer: stored chunk bytes move verbatim when
+    source wire compression already matches ``compress`` (zero decode,
+    zero deflate), and are re-wrapped wire-only otherwise (gunzip +
+    re-deflate, still no chunk codec in the path). Writes are whole
+    canonical chunk objects — never read-modify-write — so the plan
+    proves alignment and overlaps other aligned writers."""
+    from ..lib import chunk_bboxes
+    from ..storage import CloudFiles, wire_ext
+
     mip = self.mip
     sm, dm = src.meta, dest.meta
-    from ..lib import chunk_bboxes
-    from ..storage import CloudFiles
-
     src_cf = CloudFiles(self.src_path)
     dest_cf = CloudFiles(self.dest_path)
-    with telemetry.stage("raw_copy"):
-      for gc in chunk_bboxes(
-        bounds, sm.chunk_size(mip), offset=sm.voxel_offset(mip), clamp=False
-      ):
-        c = Bbox.intersection(gc, src.bounds)
-        if c.empty():
-          continue
-        data = src_cf.get(sm.chunk_name(mip, c))
-        if data is None:
-          continue  # missing chunks stay missing, like transfer_to
-        dest_cf.put(dm.chunk_name(mip, c), data, compress=self.compress)
-    return True
+    dest_ext = wire_ext(self.compress)
+    chunks = [
+      c
+      for c in (
+        Bbox.intersection(gc, src.bounds)
+        for gc in chunk_bboxes(
+          bounds, sm.chunk_size(mip), offset=sm.voxel_offset(mip), clamp=False
+        )
+      )
+      if not c.empty()
+    ]
+
+    def download():
+      keys = [sm.chunk_name(mip, c) for c in chunks]
+      with telemetry.stage("passthrough_download"):
+        if len(keys) > 1:
+          from ..pipeline.encoder import shared_io_pool
+
+          stored = list(shared_io_pool().map(src_cf.get_stored, keys))
+        else:
+          stored = [src_cf.get_stored(k) for k in keys]
+      return stored
+
+    def compute(stored):
+      return stored  # compressed-domain: nothing to decode or resample
+
+    def upload(stored, sink):
+      from ..storage import compress_bytes, decompress_bytes, wire_ext as wext
+
+      with telemetry.stage("passthrough_upload"):
+        for c, (data, method) in zip(chunks, stored):
+          if data is None:
+            continue  # missing chunks stay missing, like transfer_to
+          key = dm.chunk_name(mip, c)
+
+          def put_one(key=key, data=data, method=method):
+            telemetry.incr("transfer.passthrough.chunks")
+            telemetry.incr("transfer.passthrough.bytes", len(data))
+            if wext(method) == dest_ext:
+              telemetry.incr("transfer.passthrough.verbatim")
+              dest_cf.put_stored(key, data, method)
+            else:
+              # wire recompress only (the IGNEOUS_SCRATCH_COMPRESS codec
+              # table): the chunk encoding itself is never touched
+              telemetry.incr("transfer.passthrough.recompressed")
+              dest_cf.put_stored(
+                key,
+                compress_bytes(decompress_bytes(data, method), self.compress),
+                self.compress,
+              )
+
+          sink.submit(put_one)
+      from .. import chunk_cache
+
+      chunk_cache.invalidate(dest.cloudpath, mip)
+
+    nbytes = int(np.prod([int(v) for v in bounds.size3()]))
+    nbytes *= dest.dtype.itemsize * dest.num_channels
+    return StagePlan(
+      download, compute, upload,
+      reads={(self.src_path, mip)}, writes={(self.dest_path, mip)},
+      nbytes_hint=nbytes, aligned_writes=True,
+    )
 
 
 class DownsampleTask(TransferTask):

@@ -19,10 +19,10 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import codecs
+from . import chunk_cache, codecs
 from .lib import Bbox, Vec, chunk_bboxes, jsonify
 from .meta import PrecomputedMetadata
-from .storage import CloudFiles
+from .storage import CloudFiles, decompress_bytes
 
 IO_THREADS = 8
 
@@ -253,6 +253,38 @@ class Volume:
       writable=writable,
     )
 
+  def _decode_stored(
+    self, stored, chunk_bbx: Bbox, mip: int
+  ) -> np.ndarray:
+    """Decode a (stored bytes, wire method) pair through the shared chunk
+    decode cache: a digest hit skips BOTH the inflate and the chunk codec.
+    Returns a read-only chunk — every caller copies voxels into its own
+    cutout assembly (the ``writable=False`` contract)."""
+    data, method = stored
+    if data is None:
+      return self._decode_chunk(None, chunk_bbx, mip, writable=False)
+    encoding = self.meta.encoding(mip)
+    # uncompressed raw chunks decode as a zero-copy view; caching those
+    # would spend budget to save nothing
+    cacheable = chunk_cache.enabled() and (
+      method is not None or encoding != "raw"
+    )
+    if not cacheable:
+      return self._decode_chunk(
+        decompress_bytes(data, method), chunk_bbx, mip, writable=False
+      )
+    bbox_key = (
+      tuple(int(v) for v in chunk_bbx.minpt),
+      tuple(int(v) for v in chunk_bbx.maxpt),
+    )
+    key, arr = chunk_cache.lookup(self.cloudpath, mip, bbox_key, data)
+    if arr is not None:
+      return arr
+    arr = self._decode_chunk(
+      decompress_bytes(data, method), chunk_bbx, mip, writable=False
+    )
+    return chunk_cache.store(key, arr)
+
   def download(
     self,
     bbox: Bbox,
@@ -312,12 +344,13 @@ class Volume:
         if not c.empty()
       ]
       keys = [self.meta.chunk_name(mip, c) for c in chunks]
-      datas = self._parallel_get(keys, parallel)
-      # read-only decode: the voxels are copied into the assembly buffer
-      # below, so a writable defensive copy here would be pure overhead
+      stored = self._parallel_get_stored(keys, parallel)
+      # read-only decode (possibly straight from the shared decode
+      # cache): the voxels are copied into the assembly buffer below, so
+      # a writable defensive copy here would be pure overhead
       renders = [
-        (c, self._decode_chunk(data, c, mip, writable=False))
-        for c, data in zip(chunks, datas)
+        (c, self._decode_stored(s, c, mip))
+        for c, s in zip(chunks, stored)
       ]
 
     # Fortran order end to end: decoded chunks are F-order views, the
@@ -376,16 +409,18 @@ class Volume:
       return out, mapping
     return out
 
-  def _parallel_get(self, keys: List[str], parallel: Optional[int]) -> List[Optional[bytes]]:
+  def _parallel_get_stored(self, keys: List[str], parallel: Optional[int]):
+    # stored-domain reads: (wire bytes, method) pairs, decompressed by
+    # the caller AFTER the cache digest gets a chance to skip the work.
     # parallel=1 keeps strict serial semantics; anything wider rides the
     # fixed-width shared pool — spawning a fresh executor per cutout (to
     # honor an exact thread count) showed up as pure thread-start
     # overhead in the e2e profile (ISSUE 3)
     if (parallel or IO_THREADS) <= 1 or len(keys) <= 1:
-      return [self.cf.get(k) for k in keys]
+      return [self.cf.get_stored(k) for k in keys]
     from .pipeline.encoder import shared_io_pool
 
-    return list(shared_io_pool().map(self.cf.get, keys))
+    return list(shared_io_pool().map(self.cf.get_stored, keys))
 
   def __getitem__(self, slices) -> np.ndarray:
     bbox = self._interpret_slices(slices)
@@ -541,6 +576,12 @@ class Volume:
       self._parallel_put(puts, compress, parallel)
     if deletes:
       self.cf.delete(deletes)
+    # decode-cache hygiene: entries under this (path, mip) are doomed
+    # (digest keying already keeps late readers correct — a rewritten
+    # chunk hashes differently — this frees the memory now). Sink-routed
+    # puts may still be in flight; the pipeline runner re-invalidates
+    # when the ticket joins.
+    chunk_cache.invalidate(self.cloudpath, mip)
 
   def _parallel_put(self, puts, compress, parallel: Optional[int]):
     # same policy as _parallel_get: parallel=1 is serial, wider requests
@@ -579,6 +620,7 @@ class Volume:
       for c in chunk_bboxes(bbox, cs, offset=offset)
     ]
     self.cf.delete(keys)
+    chunk_cache.invalidate(self.cloudpath, mip)
 
   def __repr__(self):
     return (

@@ -1,0 +1,511 @@
+"""Compressed-domain fast paths (ISSUE 4): vectorized cseg byte identity
++ checked-in golden chunks, zero-decode transfer passthrough, and the
+shared chunk decode cache.
+
+The golden files under tests/golden/ pin WIRE-FORMAT STABILITY: the exact
+bytes every codec emitted when the fixtures were frozen. A legitimate
+format change must regenerate them on purpose
+(``IGNEOUS_GOLDEN_REGEN=1 pytest -k golden``) — silent drift is the bug
+class this file exists to catch, because at-least-once execution and the
+chaos soak's byte-identity contract both assume re-encoding a chunk
+reproduces it bit for bit.
+"""
+
+import gzip
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from igneous_tpu import chunk_cache, codecs, cseg, telemetry
+from igneous_tpu import task_creation as tc
+from igneous_tpu.lib import Bbox
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.storage import CloudFiles, clear_memory_storage
+from igneous_tpu.tasks.image import TransferTask
+from igneous_tpu.volume import Volume
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+  chunk_cache.clear()
+  yield
+  chunk_cache.clear()
+
+
+@pytest.fixture
+def rng():
+  return np.random.default_rng(1234)
+
+
+# ---------------------------------------------------------------------------
+# cseg: vectorized vs per-block-loop byte identity
+
+
+def _labels(rng, shape, dtype, density):
+  hival = 2**31 if dtype == np.uint32 else 2**55
+  return (
+    rng.integers(0, density, shape) * (hival // density + 1)
+  ).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.uint32, np.uint64])
+@pytest.mark.parametrize(
+  "shape",
+  [
+    (16, 16, 16),           # block-aligned
+    (8, 8, 8),              # single block
+    (13, 11, 7),            # odd, non-multiple of the block everywhere
+    (17, 9, 5),             # odd with a 1-wide remainder category
+    (32, 5, 19),            # one axis below the block size
+  ],
+)
+@pytest.mark.parametrize("block_size", [(8, 8, 8), (4, 4, 4)])
+def test_cseg_vectorized_matches_loop(rng, dtype, shape, block_size):
+  for density in (1, 4, 10**6):
+    labels = _labels(rng, shape, dtype, density)
+    vec = cseg._encode_channel(labels, block_size)
+    loop = cseg._encode_channel_loop(labels, block_size)
+    assert np.array_equal(vec, loop), "encoded words differ from loop"
+
+    data = cseg.compress(labels, block_size=block_size)
+    # production stream == loop stream (offset word + channel words)
+    ref = np.concatenate(
+      [np.array([1], dtype=np.uint32), loop]
+    ).tobytes()
+    assert data == ref
+    out = cseg.decompress(data, shape + (1,), dtype, block_size=block_size)
+    out_loop = cseg._decompress_loop(
+      data, shape + (1,), dtype, block_size=block_size
+    )
+    assert np.array_equal(out, out_loop)
+    assert np.array_equal(out[..., 0], labels)
+
+
+def test_cseg_table_sharing_chain_matches_loop(rng):
+  """Long runs of identical lookup tables (uniform regions) exercise the
+  share-with-last-EMITTED-table rule; the vectorized pairwise-equality
+  shortcut must reproduce the loop's chained decision."""
+  labels = np.full((32, 16, 16), 7, np.uint64)
+  labels[24:, :, :] = 9  # one table change mid-stream
+  vec = cseg._encode_channel(labels, (8, 8, 8))
+  loop = cseg._encode_channel_loop(labels, (8, 8, 8))
+  assert np.array_equal(vec, loop)
+
+
+def test_cseg_corrupt_stream_raises_not_crashes(rng, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_TPU_NO_NATIVE", "1")  # pin the numpy decoder
+  labels = _labels(rng, (16, 16, 16), np.uint64, 50)
+  data = bytearray(cseg.compress(labels))
+  # truncations: word-misaligned length, then offsets past the end
+  for nbytes in (len(data) // 2, 9, 8, 4):
+    with pytest.raises(ValueError, match="corrupt compressed_segmentation"):
+      cseg.decompress(bytes(data[:nbytes]), (16, 16, 16, 1), np.uint64)
+  # invalid bit width in a block header
+  words = np.frombuffer(bytes(data), np.uint32).copy()
+  words[1] = (np.uint32(3) << np.uint32(24)) | (words[1] & np.uint32(0xFFFFFF))
+  with pytest.raises(ValueError, match="invalid bit width"):
+    cseg.decompress(words.tobytes(), (16, 16, 16, 1), np.uint64)
+
+
+def test_cseg_decompress_leaves_input_untouched(rng):
+  """The decoders take a read-only view of the stream (no defensive
+  bytearray copy); the caller's buffer must come back bit-identical."""
+  labels = _labels(rng, (16, 16, 16), np.uint64, 50)
+  data = cseg.compress(labels)
+  before = bytes(data)
+  cseg.decompress(data, (16, 16, 16, 1), np.uint64)
+  assert data == before
+
+
+# ---------------------------------------------------------------------------
+# golden chunks: wire-format stability
+
+
+def _golden_fixtures():
+  rng = np.random.default_rng(20260804)
+  cells = rng.integers(1, 2**40, size=(4, 4, 2)).astype(np.uint64)
+  seg = np.kron(cells, np.ones((8, 8, 8), np.uint64))  # (32, 32, 16)
+  seg[rng.random(seg.shape) < 0.05] = 0
+  odd = seg[:29, :27, :13]
+  img8 = rng.integers(0, 255, (32, 32, 8)).astype(np.uint8)
+  return [
+    ("cseg_u64.bin", seg, "compressed_segmentation", {}),
+    ("cseg_u32.bin", seg.astype(np.uint32), "compressed_segmentation", {}),
+    ("cseg_u64_odd.bin", odd, "compressed_segmentation", {}),
+    (
+      "cseg_u64_block44.bin", odd, "compressed_segmentation",
+      {"block_size": (4, 4, 4)},
+    ),
+    ("compresso_u64.bin", seg, "compresso", {}),
+    ("raw_u8.bin", img8, "raw", {}),
+  ]
+
+
+@pytest.mark.parametrize(
+  "fname,arr,encoding,kw",
+  _golden_fixtures(),
+  ids=[f[0] for f in _golden_fixtures()],
+)
+def test_golden_chunk_bytes(fname, arr, encoding, kw):
+  data = codecs.encode(arr, encoding, **kw)
+  path = GOLDEN_DIR / fname
+  if os.environ.get("IGNEOUS_GOLDEN_REGEN"):
+    GOLDEN_DIR.mkdir(exist_ok=True)
+    path.write_bytes(data)
+  golden = path.read_bytes()
+  assert data == golden, (
+    f"{encoding} wire bytes drifted from {fname}; if the change is "
+    "intentional, regenerate with IGNEOUS_GOLDEN_REGEN=1"
+  )
+  shape = arr.shape if arr.ndim == 4 else arr.shape + (1,)
+  out = codecs.decode(golden, encoding, shape, arr.dtype, **kw)
+  assert np.array_equal(out[..., 0] if arr.ndim == 3 else out, arr)
+
+
+def test_golden_gzip_wire_stability():
+  """mtime=0 deterministic gzip is what makes re-run tasks byte-identical;
+  pin the wire bytes of a compressed chunk end to end."""
+  from igneous_tpu.storage import compress_bytes
+
+  _, seg, enc, _ = _golden_fixtures()[0]
+  data = compress_bytes(codecs.encode(seg, enc), "gzip")
+  path = GOLDEN_DIR / "cseg_u64.bin.gz"
+  if os.environ.get("IGNEOUS_GOLDEN_REGEN"):
+    path.write_bytes(data)
+  assert data == path.read_bytes()
+  assert gzip.decompress(data) == (GOLDEN_DIR / "cseg_u64.bin").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# zero-decode transfer passthrough
+
+
+def _make_seg_volume(path, shape=(64, 64, 32), chunk=(32, 32, 32),
+                     compress="gzip", rng=None):
+  rng = rng or np.random.default_rng(7)
+  cells = rng.integers(1, 2**40, size=(8, 8, 4)).astype(np.uint64)
+  reps = [s // c for s, c in zip(shape, (8, 8, 4))]
+  seg = np.kron(cells, np.ones(reps, np.uint64))
+  seg[rng.random(shape) < 0.03] = 0
+  vol = Volume.from_numpy(
+    seg, path, chunk_size=chunk, layer_type="segmentation",
+    encoding="compressed_segmentation", compress=compress,
+  )
+  return vol, seg
+
+
+def _transfer(src, dest, **kw):
+  task = TransferTask(
+    src_path=src, dest_path=dest, mip=0,
+    shape=Volume(src).shape[:3], offset=(0, 0, 0), skip_downsamples=True,
+    **kw,
+  )
+  Volume.create(dest, Volume(src).info)
+  task.execute()
+  return task
+
+
+def _layer_files(root):
+  """Stored chunk objects (raw wire bytes) of a layer, metadata excluded
+  (provenance embeds wall-clock dates by design)."""
+  cf = CloudFiles(root)
+  return {
+    k: cf.get(k, raw=True)
+    for k in cf.backend.list("")
+    if not k.startswith(("provenance", "info"))
+  }
+
+
+def test_passthrough_verbatim_byte_identity(tmp_path):
+  """Same encoding + geometry + wire compression: stored chunk objects
+  move verbatim — byte-identical to the source AND to what the
+  decode/re-encode path would have written — with zero chunk decodes."""
+  src = f"file://{tmp_path}/src"
+  _make_seg_volume(src)
+  before = telemetry.counters_snapshot().get("transfer.passthrough.verbatim", 0)
+
+  _transfer(src, f"file://{tmp_path}/fast")
+  counters = telemetry.counters_snapshot()
+  assert counters.get("transfer.passthrough.verbatim", 0) > before
+  assert counters.get("transfer.passthrough.chunks", 0) > 0
+
+  os.environ["IGNEOUS_TRANSFER_PASSTHROUGH"] = "off"
+  try:
+    _transfer(src, f"file://{tmp_path}/slow")
+  finally:
+    os.environ.pop("IGNEOUS_TRANSFER_PASSTHROUGH", None)
+
+  src_files = _layer_files(src)
+  fast = _layer_files(f"file://{tmp_path}/fast")
+  slow = _layer_files(f"file://{tmp_path}/slow")
+  assert fast == src_files, "verbatim passthrough altered stored bytes"
+  assert fast == slow, "passthrough and decode paths wrote different bytes"
+
+
+def test_passthrough_recompress_only(tmp_path):
+  """Wire compression differs (gzip source → uncompressed dest): bytes
+  re-wrap wire-only — still no chunk decode — and the payload matches
+  the decode path exactly."""
+  src = f"file://{tmp_path}/src"
+  _, seg = _make_seg_volume(src, compress="gzip")
+
+  before = telemetry.counters_snapshot().get(
+    "transfer.passthrough.recompressed", 0
+  )
+  _transfer(src, f"file://{tmp_path}/uncomp", compress=None)
+  assert telemetry.counters_snapshot().get(
+    "transfer.passthrough.recompressed", 0
+  ) > before
+
+  dest = Volume(f"file://{tmp_path}/uncomp")
+  assert np.array_equal(dest.download(dest.bounds)[..., 0], seg)
+  # the stored objects really are uncompressed (no .gz twin)
+  chunk_keys = list(_layer_files(f"file://{tmp_path}/uncomp"))
+  assert chunk_keys and not any(k.endswith(".gz") for k in chunk_keys)
+
+
+def test_passthrough_ineligible_falls_back(tmp_path):
+  """delete_black_uploads needs the decoded voxels (black chunks are
+  DELETED, not copied): the transfer silently takes the decode path and
+  drops all-background chunks."""
+  src = f"file://{tmp_path}/src"
+  rng = np.random.default_rng(3)
+  seg = np.zeros((64, 64, 32), np.uint64)
+  seg[:32, :32, :] = 77  # half the chunks stay all-background
+  Volume.from_numpy(
+    seg, src, chunk_size=(32, 32, 32), layer_type="segmentation",
+    encoding="compressed_segmentation",
+  )
+  before = telemetry.counters_snapshot().get("transfer.passthrough.chunks", 0)
+  _transfer(src, f"file://{tmp_path}/dbu", delete_black_uploads=True)
+  assert telemetry.counters_snapshot().get(
+    "transfer.passthrough.chunks", 0
+  ) == before, "ineligible transfer took the passthrough path"
+  dest = Volume(f"file://{tmp_path}/dbu", fill_missing=True)
+  assert np.array_equal(dest.download(dest.bounds)[..., 0], seg)
+  chunk_keys = list(_layer_files(f"file://{tmp_path}/dbu"))
+  src_keys = list(_layer_files(src))
+  assert len(chunk_keys) < len(src_keys), "black chunks were not dropped"
+
+
+def test_passthrough_missing_chunks_stay_missing(tmp_path):
+  src = f"file://{tmp_path}/src"
+  _, seg = _make_seg_volume(src)
+  src_vol = Volume(src)
+  victim = src_vol.meta.chunk_name(0, Bbox((0, 0, 0), (32, 32, 32)))
+  src_vol.cf.delete(victim)
+  _transfer(src, f"file://{tmp_path}/holes")
+  dest_cf = CloudFiles(f"file://{tmp_path}/holes")
+  assert not dest_cf.exists(victim)
+
+
+def test_chaos_fault_mid_passthrough_no_partials(tmp_path):
+  """Chaos-injected put failures and a mid-upload crash during a
+  passthrough transfer must leave no partial/tmp objects; the retried
+  task converges to byte-identical output (at-least-once idempotency in
+  the compressed domain)."""
+  from igneous_tpu.chaos import ChaosConfig, chaos_storage
+
+  src = f"file://{tmp_path}/src"
+  _make_seg_volume(src)
+  dest = f"file://{tmp_path}/chaos"
+  cfg = ChaosConfig(
+    seed=11, put_fail=0.4, crash_put=0.25, max_faults_per_key=2,
+  )
+  attempts = 0
+  with chaos_storage(cfg):
+    while True:
+      attempts += 1
+      # transient faults are capped per (op, key), so the retry count is
+      # bounded by the total fault budget (each attempt fails fast on
+      # its first faulted put)
+      assert attempts < 80, "chaos passthrough never converged"
+      try:
+        _transfer(src, dest)
+        break
+      except Exception:  # noqa: BLE001 - chaos faults; retry like a lease
+        continue
+  dest_dir = pathlib.Path(str(tmp_path)) / "chaos"
+  tmp_turds = [p for p in dest_dir.rglob("*") if ".tmp." in p.name]
+  assert not tmp_turds, f"partial objects left behind: {tmp_turds}"
+  assert _layer_files(dest) == _layer_files(src)
+
+
+def test_passthrough_pipelined_stream_byte_identity(rng):
+  """A stream of passthrough transfers through run_tasks_pipelined: all
+  staged (no solo barrier), outputs byte-identical to solo execution."""
+  from igneous_tpu.pipeline import run_tasks_pipelined
+
+  clear_memory_storage()
+  srcs = []
+  for i in range(3):
+    path = f"mem://fastpaths/src{i}"
+    _make_seg_volume(path, rng=np.random.default_rng(100 + i))
+    srcs.append(path)
+  tasks = []
+  for i, src in enumerate(srcs):
+    dest = f"mem://fastpaths/dst{i}"
+    Volume.create(dest, Volume(src).info)
+    tasks.append(TransferTask(
+      src_path=src, dest_path=dest, mip=0,
+      shape=Volume(src).shape[:3], offset=(0, 0, 0), skip_downsamples=True,
+    ))
+  os.environ["IGNEOUS_PIPELINE_THREADS"] = "1"
+  try:
+    stats = run_tasks_pipelined(iter(tasks))
+  finally:
+    os.environ.pop("IGNEOUS_PIPELINE_THREADS", None)
+  assert stats["executed"] == 3
+  assert stats["staged"] == 3 and stats["solo"] == 0
+  for i, src in enumerate(srcs):
+    assert _layer_files(src) == _layer_files(f"mem://fastpaths/dst{i}")
+  clear_memory_storage()
+
+
+# ---------------------------------------------------------------------------
+# shared chunk decode cache
+
+
+def _cache_volume(path, rng=None):
+  return _make_seg_volume(path, rng=rng)
+
+
+def test_cache_hit_skips_decode_and_matches(tmp_path):
+  src = f"file://{tmp_path}/layer"
+  _, seg = _cache_volume(src)
+  vol = Volume(src)
+  telemetry.reset_counters()
+  first = vol.download(vol.bounds)
+
+  import igneous_tpu.codecs as codecs_mod
+
+  real = codecs_mod.decode
+  calls = {"n": 0}
+  codecs_mod.decode = lambda *a, **k: (
+    calls.__setitem__("n", calls["n"] + 1) or real(*a, **k)
+  )
+  try:
+    second = vol.download(vol.bounds)
+  finally:
+    codecs_mod.decode = real
+  assert calls["n"] == 0, "repeat download decoded chunks despite cache"
+  assert np.array_equal(first, second)
+  counters = telemetry.counters_snapshot()
+  assert counters.get("chunk_cache.hits", 0) >= 4
+  assert counters.get("chunk_cache.bytes_saved", 0) > 0
+
+
+def test_cache_invalidated_by_write_to_same_layer_mip(tmp_path):
+  src = f"file://{tmp_path}/layer"
+  _, seg = _cache_volume(src)
+  vol = Volume(src)
+  vol.download(vol.bounds)  # fill
+  assert len(chunk_cache.shared_cache()) > 0
+
+  new = np.full_like(seg, 123456)
+  vol.upload(vol.bounds, new[..., np.newaxis])
+  # the write fenced its own (path, mip) out of the cache...
+  assert len(chunk_cache.shared_cache()) == 0
+  # ...and a fresh read sees the new bytes
+  assert np.array_equal(vol.download(vol.bounds)[..., 0], new)
+
+
+def test_cache_digest_defeats_out_of_band_write(tmp_path):
+  """A writer that bypasses Volume.upload (no invalidation hook at all)
+  still cannot serve stale voxels: the stored-bytes digest in the key
+  misses and the chunk re-decodes."""
+  src = f"file://{tmp_path}/layer"
+  _, seg = _cache_volume(src)
+  vol = Volume(src)
+  vol.download(vol.bounds)  # fill
+  entries_before = len(chunk_cache.shared_cache())
+  assert entries_before > 0
+
+  new_chunk = np.full((32, 32, 32, 1), 42, np.uint64)
+  key = vol.meta.chunk_name(0, Bbox((0, 0, 0), (32, 32, 32)))
+  vol.cf.put(
+    key, codecs.encode(new_chunk, "compressed_segmentation"), compress="gzip"
+  )
+  out = vol.download(Bbox((0, 0, 0), (32, 32, 32)))
+  assert np.array_equal(out, new_chunk)
+
+
+def test_cache_respects_byte_budget(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_CHUNK_CACHE_MB", "0.3")  # 300 KB
+  src = f"file://{tmp_path}/layer"
+  _cache_volume(src)
+  vol = Volume(src)
+  vol.download(vol.bounds)  # 4 chunks x 256 KB decoded
+  cache = chunk_cache.shared_cache()
+  assert cache.nbytes <= 300_000
+  assert telemetry.counters_snapshot().get("chunk_cache.evicted", 0) > 0
+
+
+def test_cache_entries_are_read_only(tmp_path):
+  src = f"file://{tmp_path}/layer"
+  _cache_volume(src)
+  vol = Volume(src)
+  vol.download(vol.bounds)
+  cache = chunk_cache.shared_cache()
+  for arr in cache._entries.values():
+    assert not arr.flags.writeable
+
+
+def test_cache_off_switch(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_CHUNK_CACHE", "off")
+  src = f"file://{tmp_path}/layer"
+  _, seg = _cache_volume(src)
+  vol = Volume(src)
+  out = vol.download(vol.bounds)
+  assert np.array_equal(out[..., 0], seg)
+  assert len(chunk_cache.shared_cache()) == 0
+
+
+def test_cache_shared_with_lease_batcher_fencing():
+  """The lease batcher's round write-set fencing also drops chunk-cache
+  entries for the written (path, mip)s."""
+  from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+  from igneous_tpu.queues import LocalTaskQueue
+
+  clear_memory_storage()
+  path = "mem://fastpaths/fence"
+  _make_seg_volume(path)
+  vol = Volume(path)
+  vol.download(vol.bounds)
+  assert len(chunk_cache.shared_cache()) > 0
+  batcher = LeaseBatcher(LocalTaskQueue(parallel=1))
+  batcher._invalidate_cache({(path, 0)})
+  assert len(chunk_cache.shared_cache()) == 0
+  clear_memory_storage()
+
+
+def test_downsample_e2e_bytes_identical_with_cache(tmp_path):
+  """The cache must never change produced bytes: the same downsample run
+  with the cache on and off writes identical chunk objects."""
+  rng = np.random.default_rng(5)
+  img = rng.integers(0, 255, (64, 64, 32)).astype(np.uint8)
+
+  def run(root, env):
+    path = f"file://{root}"
+    Volume.from_numpy(img, path, chunk_size=(32, 32, 32), compress="gzip")
+    for k, v in env.items():
+      os.environ[k] = v
+    try:
+      LocalTaskQueue(parallel=1, progress=False).insert(
+        tc.create_downsampling_tasks(path, mip=0, num_mips=1, compress="gzip")
+      )
+    finally:
+      for k in env:
+        os.environ.pop(k, None)
+    return _layer_files(path)
+
+  with_cache = run(tmp_path / "on", {})
+  without = run(tmp_path / "off", {"IGNEOUS_CHUNK_CACHE": "off"})
+  drop = lambda files: {  # noqa: E731
+    k: v for k, v in files.items() if not k.startswith("provenance")
+  }
+  assert drop(with_cache) == drop(without)

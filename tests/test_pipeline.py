@@ -438,9 +438,11 @@ def test_prefetch_fenced_off_running_round_writes(rng, tmp_path, monkeypatch):
   b._release_members(members)
 
 
-def test_raw_copy_transfer_stays_solo(rng):
-  """A raw-copy-eligible TransferTask publishes no stage plan (the chunk
-  stream path is already optimal) and still executes correctly."""
+def test_raw_copy_transfer_stages_as_passthrough(rng):
+  """A passthrough-eligible TransferTask publishes a compressed-domain
+  stage plan (ISSUE 4): proven-aligned writes so it pipelines with the
+  stream instead of barriering it, and zero chunk decodes end to end."""
+  import igneous_tpu.codecs as codecs_mod
   from igneous_tpu.tasks.image import TransferTask
 
   img = _fixture(rng, (64, 64, 32))
@@ -454,8 +456,22 @@ def test_raw_copy_transfer_stays_solo(rng):
     src_path="mem://pipe/rc_src", dest_path="mem://pipe/rc_dst",
     mip=0, shape=(64, 64, 32), offset=(0, 0, 0), skip_downsamples=True,
   )
-  assert task.stage_plan() is None
-  task.execute()
+  plan = task.stage_plan()
+  assert plan is not None
+  assert plan.aligned_writes  # whole-chunk object moves never RMW
+  assert plan.reads == {("mem://pipe/rc_src", 0)}
+  assert plan.writes == {("mem://pipe/rc_dst", 0)}
+
+  real_decode = codecs_mod.decode
+  decodes = {"n": 0}
+  codecs_mod.decode = lambda *a, **k: (
+    decodes.__setitem__("n", decodes["n"] + 1) or real_decode(*a, **k)
+  )
+  try:
+    task.execute()
+  finally:
+    codecs_mod.decode = real_decode
+  assert decodes["n"] == 0, "passthrough transfer decoded voxels"
   got = Volume("mem://pipe/rc_dst").download(src.bounds)
   assert np.array_equal(got[..., 0], img)
 
