@@ -98,6 +98,7 @@ class ChaosConfig:
     per the seeded roll until the per-(op,key) budget is spent."""
     if self.permanent and self.permanent in key:
       telemetry.incr(f"chaos.{op}.permanent")
+      self._trace_event(op, key)
       return True
     if rate <= 0.0:
       return False
@@ -107,8 +108,18 @@ class ChaosConfig:
     if self.roll(op, key) < rate:
       self._faults[(op, key)] = spent + 1
       telemetry.incr(f"chaos.{op}")
+      self._trace_event(op, key)
       return True
     return False
+
+  @staticmethod
+  def _trace_event(op: str, key: str):
+    """Mark the injected fault on the active task's trace, so `igneous
+    fleet trace` shows WHY a delivery failed/retried, not just that it
+    did (no-op outside a sampled trace)."""
+    from .observability import trace
+
+    trace.event(f"chaos.{op}", key=key[-80:])
 
 
 class ChaosStorage:

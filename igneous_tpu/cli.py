@@ -12,7 +12,8 @@ every reference command and --option has a counterpart here):
             spatial-index {create,db}}
   execute | queue {status,wait,release,rezero,purge,cp,mv,fsck,
                    dlq {ls,retry,purge}}
-  design {ds-memory, ds-shape, bounds} | view | license
+  fleet {status,trace,top} | design {ds-memory, ds-shape, bounds}
+  view | license
 
 Heavy imports (jax, task modules) happen inside commands so --help and
 queue tooling stay instant.
@@ -1368,11 +1369,19 @@ def skeleton_rm(ctx, path, queue, skel_dir, magnitude):
                    "task's chunk encode/uploads and prefetch batched "
                    "rounds' cutouts; byte-identical output, joined before "
                    "every lease delete [default: $IGNEOUS_PIPELINE].")
+@click.option("--metrics-port", "metrics_port", default=None, type=int,
+              help="Serve Prometheus text metrics on this port "
+                   "(/metrics; 0 picks a free port) "
+                   "[default: $IGNEOUS_METRICS_PORT; unset disables].")
+@click.option("--journal", "journal_path", default=None,
+              help="Where to append fleet journal segments (span batches "
+                   "merged by `igneous fleet`) [default: $IGNEOUS_JOURNAL, "
+                   "else <queue>/journal/ for fq:// queues].")
 @click.pass_context
 def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
             exit_on_empty, min_sec, quiet, timing, batch_size,
             max_deliveries, task_deadline, heartbeat_sec, drain_sentinel,
-            pipeline):
+            pipeline, metrics_port, journal_path):
   """Worker poll loop: lease → run → delete
   (reference cli.py:888-964 semantics). QUEUE_SPEC falls back to the
   QUEUE_URL env var and --lease-sec to LEASE_SECONDS, so container CMDs
@@ -1396,6 +1405,13 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
   if pipeline is not None:
     # env (not a param thread) so spawned workers inherit the choice
     os.environ["IGNEOUS_PIPELINE"] = "1" if pipeline else "off"
+  if journal_path is not None:
+    os.environ["IGNEOUS_JOURNAL"] = journal_path  # children inherit too
+  if metrics_port is not None:
+    # multi-process workers each need their own port: 0 lets the OS pick
+    os.environ["IGNEOUS_METRICS_PORT"] = str(
+      0 if ctx.obj["parallel"] > 1 else metrics_port
+    )
   parallel = ctx.obj["parallel"]
   if parallel > 1:
     import multiprocessing as mp
@@ -1453,6 +1469,8 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
 
   import igneous_tpu.tasks  # noqa: F401  register all task classes
   from . import lifecycle, telemetry
+  from .observability import journal as journal_mod
+  from .observability import prom
   from .queues import TaskQueue
 
   flag = lifecycle.StopFlag()
@@ -1461,6 +1479,18 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   watcher.start()
 
   tq = TaskQueue(queue_spec, max_deliveries=max_deliveries)
+
+  # observability (ISSUE 5): journal segments + /metrics endpoint + an
+  # atexit last-will so even a crashing worker leaves its final
+  # counters line and span batch behind
+  jpath = journal_mod.journal_path_for(tq, queue_spec)
+  if jpath:
+    journal_mod.set_active(journal_mod.Journal(jpath))
+  journal_mod.install_last_will({"queue": queue_spec})
+  bound_port = prom.start_http_server()
+  if bound_port is not None and not quiet:
+    click.echo(f"metrics: http://0.0.0.0:{bound_port}/metrics")
+
   start = time.time()
 
   def drained() -> bool:
@@ -1528,14 +1558,24 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
       )
       if not quiet:
         click.echo(f"executed {executed} tasks")
+  except BaseException:
+    # crashing worker (satellite): the final counters line + journal
+    # batch land NOW, with the real event name — not at teardown
+    journal_mod.fire_last_will("crash", {"queue": queue_spec})
+    raise
   finally:
     watcher.stop()
     restore()
   if flag.is_set():
-    # last will: the counters line survives the pod for kubectl logs
-    telemetry.emit_counters(event="drain", reason=flag.reason,
-                            executed=executed)
+    # last will: the counters line survives the pod for kubectl logs,
+    # and the journal's final segment survives it in the bucket
+    journal_mod.fire_last_will(
+      "drain", {"reason": flag.reason, "executed": executed}
+    )
     sys_mod.exit(lifecycle.EXIT_PREEMPTED)
+  # clean exit: flush the journal without the counters line (stdout
+  # contract unchanged for healthy drains)
+  journal_mod.disarm_last_will()
 
 
 @main.group("queue")
@@ -1546,7 +1586,9 @@ def queue_group():
 @queue_group.command("status")
 @click.argument("queue_spec")
 @click.option("--eta", is_flag=True, help="Sample throughput and estimate ETA.")
-@click.option("--sample-sec", default=10.0, show_default=True)
+@click.option("--sample-sec", default=10.0, show_default=True,
+              help="Live-sampling window for --eta; skipped entirely when "
+                   "journal segments provide the throughput.")
 def queue_status(queue_spec, eta, sample_sec):
   from .queues import TaskQueue
 
@@ -1565,10 +1607,16 @@ def queue_status(queue_spec, eta, sample_sec):
     if ages:
       click.echo(f"lease_expiry_sec (min/max): {ages[0]:.0f}/{ages[-1]:.0f}")
   if eta:
+    from .observability import journal as journal_mod
     from .telemetry import queue_eta
 
-    stats = queue_eta(tq, sample_seconds=sample_sec)
-    click.echo(f"tasks/sec: {stats['tasks_per_sec']}")
+    # journal-derived throughput when the fleet left segments behind
+    # (no sampling sleep); live two-sample estimate otherwise
+    stats = queue_eta(
+      tq, sample_seconds=sample_sec,
+      journal_path=journal_mod.journal_path_for(tq, queue_spec),
+    )
+    click.echo(f"tasks/sec: {stats['tasks_per_sec']} ({stats['source']})")
     click.echo(f"eta_sec: {stats['eta_sec']}")
 
 
@@ -1721,6 +1769,131 @@ def queue_mv(src, dest):
   from .queues import move_queue
 
   click.echo(f"moved {move_queue(src, dest)} tasks")
+
+
+# ---------------------------------------------------------------------------
+# fleet observability (ISSUE 5)
+
+
+@main.group("fleet")
+def fleet_group():
+  """Fleet observability: merge worker journal segments from the bucket.
+
+  Workers running `igneous execute` append span/counter batches as JSONL
+  segments under <queue>/journal/ (or $IGNEOUS_JOURNAL). These commands
+  aggregate them AFTER the fact — no live connection to any worker."""
+
+
+def _fleet_records(queue_spec, journal_path):
+  from .observability import fleet, journal as journal_mod
+  from .queues import TaskQueue
+
+  path = journal_path or os.environ.get("IGNEOUS_JOURNAL")
+  if path is None and queue_spec:
+    path = journal_mod.journal_path_for(TaskQueue(queue_spec), queue_spec)
+  if not path:
+    raise click.UsageError(
+      "no journal location: pass --journal, set $IGNEOUS_JOURNAL, or give "
+      "an fq:// queue spec (whose journal/ sidecar is implied)"
+    )
+  records = fleet.load(path)
+  if not records:
+    raise click.ClickException(f"no journal segments under {path}")
+  return records
+
+
+def _journal_opts(fn):
+  for opt in (
+    click.option("--journal", "journal_path", default=None,
+                 help="Journal path override [default: $IGNEOUS_JOURNAL or "
+                      "<queue>/journal/]."),
+    click.option("--queue", "-q", "queue_spec", default=None,
+                 help="Queue whose journal/ sidecar to read "
+                      "[default: $QUEUE_URL]."),
+  ):
+    fn = opt(fn)
+  return fn
+
+
+@fleet_group.command("status")
+@_journal_opts
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable.")
+def fleet_status(queue_spec, journal_path, as_json):
+  """Per-stage fleet aggregates: p50/p95 stage times, stall ratio,
+  throughput, zombie/DLQ tallies — merged across every worker."""
+  import json as json_mod
+
+  from . import secrets
+  from .observability import fleet
+
+  st = fleet.status(_fleet_records(queue_spec or secrets.queue_url(),
+                                   journal_path))
+  if as_json:
+    click.echo(json_mod.dumps(st, indent=2))
+    return
+  click.echo(f"workers: {len(st['workers'])} ({', '.join(st['workers'])})")
+  click.echo(f"window: {st['window_sec']}s")
+  click.echo(
+    f"tasks: {st['tasks']} ({st['tasks_failed']} failed spans, "
+    f"{st['tasks_failed_counter']} failure counters)"
+  )
+  if st["tasks_per_sec"] is not None:
+    click.echo(f"tasks/sec: {st['tasks_per_sec']}")
+  if st["stall_ratio"] is not None:
+    click.echo(f"stall ratio: {st['stall_ratio']}")
+  click.echo(f"zombie fences: {st['zombie_fences']}  "
+             f"dlq promoted: {st['dlq_promoted']}")
+  click.echo("stage                                count   total_s  "
+             "p50_ms   p95_ms")
+  for name, s in st["stages"].items():
+    click.echo(
+      f"{name:<36} {s['count']:>6} {s['total_s']:>9} "
+      f"{s['p50_ms']:>8} {s['p95_ms']:>8}"
+    )
+
+
+@fleet_group.command("trace")
+@click.argument("trace_id")
+@_journal_opts
+@click.option("-o", "--out", "out_path", default=None,
+              help="Also write a Perfetto/Chrome trace JSON here "
+                   "(open at ui.perfetto.dev).")
+def fleet_trace(trace_id, queue_spec, journal_path, out_path):
+  """One task's merged lineage: enqueue wait, every delivery (retries
+  included), and the pipeline stage spans inside each, across workers."""
+  from . import secrets
+  from .observability import fleet, perfetto
+
+  records = _fleet_records(queue_spec or secrets.queue_url(), journal_path)
+  spans = fleet.trace_records(records, trace_id)
+  if not spans:
+    raise click.ClickException(f"no spans recorded for trace {trace_id}")
+  for line in fleet.render_trace(spans):
+    click.echo(line)
+  if out_path:
+    n = perfetto.dump(records, out_path, trace_id=trace_id)
+    click.echo(f"wrote {n} events to {out_path}")
+
+
+@fleet_group.command("top")
+@_journal_opts
+@click.option("-n", "top_n", default=10, show_default=True)
+def fleet_top(queue_spec, journal_path, top_n):
+  """Slowest task executions by trace (feed one to `fleet trace`)."""
+  from . import secrets
+  from .observability import fleet
+
+  records = _fleet_records(queue_spec or secrets.queue_url(), journal_path)
+  rows = fleet.slowest_tasks(records, n=top_n)
+  if not rows:
+    raise click.ClickException("no task spans in the journal")
+  click.echo("dur_s     task                       attempt  trace_id")
+  for r in rows:
+    err = f"  ERROR={r['error']}" if r.get("error") else ""
+    click.echo(
+      f"{r['dur_s']:>8.3f}  {r['task']:<25} {str(r['attempt'] or '-'):>7}"
+      f"  {r['trace_id']}  @{r['worker']}{err}"
+    )
 
 
 @main.group()

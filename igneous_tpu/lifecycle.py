@@ -40,6 +40,15 @@ class StopFlag:
       if self.reason is None:
         self.reason = reason
     self._event.set()
+    # a drain request marks the active journal dirty so the poll loop's
+    # next maybe_flush writes the final span batch BEFORE the pod dies
+    # (signal-handler safe: only sets an event, no IO here)
+    try:
+      from .observability import journal
+
+      journal.request_flush()
+    except Exception:
+      pass
 
   def is_set(self) -> bool:
     return self._event.is_set()

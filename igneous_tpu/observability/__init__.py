@@ -1,0 +1,46 @@
+"""Distributed observability: trace context, spans, journal, exporters.
+
+The subsystem in one picture::
+
+    task factory ──mint──> trace_id in the queue payload
+         │                        │
+      enqueue              lease / redeliver / DLQ (identity survives)
+         │                        │
+         └──> worker: task_span + stage spans (pipeline observe() sites,
+              storage ops, lease rounds) → per-thread span buffers
+                                  │
+              Journal.flush ──> <queue>/journal/*.jsonl segments
+                                  │
+         igneous fleet status|trace|top   Prometheus /metrics   Perfetto
+
+``igneous_tpu.telemetry`` remains as a compat shim over
+:mod:`.metrics`; new code should import from here.
+"""
+
+from . import fleet, journal, perfetto, prom, trace
+from .metrics import (
+  StageTimes,
+  counters_snapshot,
+  device_trace,
+  emit_counters,
+  gauge_max,
+  gauges_snapshot,
+  histograms_snapshot,
+  incr,
+  observe,
+  queue_eta,
+  reset_all,
+  reset_counters,
+  stage,
+  task_timing,
+  timed_poll_hooks,
+  timers_snapshot,
+)
+
+__all__ = [
+  "fleet", "journal", "perfetto", "prom", "trace",
+  "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
+  "gauge_max", "gauges_snapshot", "histograms_snapshot", "incr", "observe",
+  "queue_eta", "reset_all", "reset_counters", "stage", "task_timing",
+  "timed_poll_hooks", "timers_snapshot",
+]

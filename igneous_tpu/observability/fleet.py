@@ -1,0 +1,206 @@
+"""Fleet-level aggregation over merged journal segments.
+
+The read half of the journal: ``igneous fleet status|trace|top`` load
+every worker's segments from the bucket and answer the questions tqdm
+bars cannot — where does fleet wall-clock go per stage (p50/p95), how
+much of it is stall vs work, which tasks are slowest, how many zombie
+fences / DLQ promotions fired, and what is one task's full lineage.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Iterable, List, Optional
+
+from . import journal as journal_mod
+
+# stage timer names whose spans measure waiting, not work: the stall
+# ratio `igneous fleet status` reports is stall_time / (stall + work)
+STALL_MARKERS = ("stall_s", "queue.wait")
+
+
+def load(journal_path: str) -> List[dict]:
+  return list(journal_mod.read_records(journal_path))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+  if not sorted_vals:
+    return 0.0
+  idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+  return sorted_vals[idx]
+
+
+def _is_stall(name: str) -> bool:
+  return any(m in name for m in STALL_MARKERS)
+
+
+def status(records: Iterable[dict]) -> dict:
+  """Merged fleet aggregates: per-stage p50/p95/total, stall ratio,
+  counter totals (zombie/DLQ/retries), workers seen, task throughput."""
+  stage_durs: dict = defaultdict(list)
+  task_spans = []
+  workers = set()
+  counters_by_worker: dict = {}
+  ts_min, ts_max = None, None
+
+  for rec in records:
+    kind = rec.get("kind")
+    worker = rec.get("worker", "local")
+    workers.add(worker)
+    if kind == "counters":
+      # cumulative per process: the LAST snapshot per worker is the truth
+      prev = counters_by_worker.get(worker)
+      if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+        counters_by_worker[worker] = rec
+      continue
+    if kind != "span":
+      continue
+    ts, dur = rec.get("ts"), rec.get("dur")
+    if ts is None or dur is None:
+      continue
+    ts_min = ts if ts_min is None else min(ts_min, ts)
+    ts_max = max(ts_max or 0.0, ts + dur)
+    name = rec.get("name", "span")
+    stage_durs[name].append(float(dur))
+    if name == "task":
+      task_spans.append(rec)
+
+  stages = {}
+  stall_total = work_total = 0.0
+  for name, durs in stage_durs.items():
+    durs.sort()
+    total = sum(durs)
+    stages[name] = {
+      "count": len(durs),
+      "total_s": round(total, 3),
+      "p50_ms": round(_percentile(durs, 0.50) * 1e3, 2),
+      "p95_ms": round(_percentile(durs, 0.95) * 1e3, 2),
+    }
+    if _is_stall(name):
+      stall_total += total
+    elif name != "task":  # task spans contain the stage spans; don't double
+      work_total += total
+
+  counters: dict = defaultdict(int)
+  for rec in counters_by_worker.values():
+    for k, v in (rec.get("counters") or {}).items():
+      counters[k] += v
+
+  window = (ts_max - ts_min) if ts_min is not None else 0.0
+  tasks_ok = [r for r in task_spans if not r.get("error")]
+  return {
+    "workers": sorted(workers),
+    "window_sec": round(window, 2),
+    "tasks": len(task_spans),
+    "tasks_failed": len(task_spans) - len(tasks_ok),
+    "tasks_per_sec": round(len(tasks_ok) / window, 3) if window > 0 else None,
+    "stall_ratio": (
+      round(stall_total / (stall_total + work_total), 3)
+      if stall_total + work_total > 0 else None
+    ),
+    "stages": dict(sorted(stages.items())),
+    "zombie_fences": sum(
+      v for k, v in counters.items() if k.startswith("zombie.")
+    ),
+    "dlq_promoted": counters.get("dlq.promoted", 0),
+    "tasks_failed_counter": counters.get("tasks.failed", 0),
+    "counters": dict(sorted(counters.items())),
+  }
+
+
+def slowest_tasks(records: Iterable[dict], n: int = 10) -> List[dict]:
+  """``igneous fleet top``: the n slowest task executions, by trace."""
+  tasks = [
+    r for r in records
+    if r.get("kind") == "span" and r.get("name") == "task"
+    and r.get("dur") is not None
+  ]
+  tasks.sort(key=lambda r: -r["dur"])
+  out = []
+  for rec in tasks[:n]:
+    out.append({
+      "trace_id": rec.get("trace"),
+      "task": rec.get("task", "?"),
+      "dur_s": round(rec["dur"], 3),
+      "worker": rec.get("worker", "local"),
+      "attempt": rec.get("attempt"),
+      "error": rec.get("error"),
+    })
+  return out
+
+
+def trace_records(records: Iterable[dict], trace_id: str) -> List[dict]:
+  """Every span of one trace, time-ordered (the merged lineage)."""
+  spans = [
+    r for r in records
+    if r.get("kind", "span") == "span" and r.get("trace") == trace_id
+  ]
+  spans.sort(key=lambda r: (r.get("ts") or 0.0))
+  return spans
+
+
+def render_trace(spans: List[dict]) -> List[str]:
+  """One text line per span, children indented under their parent —
+  the terminal view of `igneous fleet trace` (the Perfetto export is the
+  graphical one)."""
+  by_id = {r.get("span"): r for r in spans if r.get("span")}
+
+  def depth(rec, seen=()):
+    parent = rec.get("parent")
+    if not parent or parent not in by_id or parent in seen:
+      return 0
+    return 1 + depth(by_id[parent], seen + (rec.get("span"),))
+
+  t0 = min((r.get("ts") or 0.0) for r in spans) if spans else 0.0
+  lines = []
+  for rec in spans:
+    pad = "  " * depth(rec)
+    extras = []
+    if rec.get("attempt") is not None:
+      extras.append(f"attempt={rec['attempt']}")
+    if rec.get("task"):
+      extras.append(rec["task"])
+    if rec.get("error"):
+      extras.append(f"ERROR={rec['error']}")
+    if rec.get("worker"):
+      extras.append(f"@{rec['worker']}")
+    lines.append(
+      f"{(rec.get('ts', 0.0) - t0) * 1e3:9.1f}ms "
+      f"{pad}{rec.get('name', 'span')} "
+      f"[{(rec.get('dur') or 0.0) * 1e3:.1f}ms]"
+      + (" " + " ".join(extras) if extras else "")
+    )
+  return lines
+
+
+def journal_throughput(journal_path: str,
+                       window_sec: float = 600.0) -> Optional[dict]:
+  """Fleet tasks/sec derived from recent journal task spans (the
+  ``queue status --eta`` journal path). None when no segments or no task
+  spans exist — callers fall back to live sampling."""
+  now = time.time()
+  durs = []
+  ts_min = ts_max = None
+  found = False
+  for rec in journal_mod.read_records(journal_path):
+    found = True
+    if rec.get("kind") != "span" or rec.get("name") != "task":
+      continue
+    if rec.get("error"):
+      continue
+    ts = rec.get("ts")
+    if ts is None or ts < now - window_sec:
+      continue
+    durs.append(rec)
+    end = ts + (rec.get("dur") or 0.0)
+    ts_min = ts if ts_min is None else min(ts_min, ts)
+    ts_max = end if ts_max is None else max(ts_max, end)
+  if not found or not durs or ts_max is None or ts_max <= ts_min:
+    return None
+  window = ts_max - ts_min
+  return {
+    "tasks": len(durs),
+    "window_sec": round(window, 2),
+    "tasks_per_sec": len(durs) / window,
+  }

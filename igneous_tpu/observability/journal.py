@@ -1,0 +1,247 @@
+"""Durable fleet event journal: JSONL segments on the storage layer.
+
+Workers append batches of span records + a cumulative metrics snapshot
+as immutable segment objects under ``<queue>/journal/`` (any CloudFiles
+path — a shared filesystem next to an fq:// queue, or a bucket prefix
+for SQS fleets via ``IGNEOUS_JOURNAL``). Segments are write-once and
+worker-unique, so no coordination is needed; ``igneous fleet`` merges
+them after the fact.
+
+Flush triggers: a time interval (``IGNEOUS_JOURNAL_FLUSH_SEC``, default
+30), lease-round boundaries, a lifecycle drain request (StopFlag.set
+marks the journal dirty; the poll loop's next ``maybe_flush`` writes),
+and process exit (the CLI worker arms an atexit last-will so even a
+crashing worker leaves its final batch behind).
+
+Record kinds (one JSON object per line):
+
+  {"kind": "span", "worker": ..., "trace": ..., "span": ..., "parent":
+   ..., "name": ..., "ts": ..., "dur": ..., ...attrs}
+  {"kind": "counters", "worker": ..., "ts": ..., "event": ...,
+   "counters": {...}, "timers": {...}, "gauges": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Iterator, Optional
+
+from . import metrics, trace
+
+FLUSH_SEC_ENV = "IGNEOUS_JOURNAL_FLUSH_SEC"
+PATH_ENV = "IGNEOUS_JOURNAL"
+DEFAULT_FLUSH_SEC = 30.0
+
+
+def default_worker_id() -> str:
+  host = socket.gethostname().split(".")[0] or "worker"
+  return f"{host}-{os.getpid()}"
+
+
+def journal_path_for(queue, spec: Optional[str] = None) -> Optional[str]:
+  """Resolve where a worker's journal lives: ``IGNEOUS_JOURNAL`` wins;
+  fq:// queues get a ``journal/`` sibling of queue/leased/dlq on the same
+  filesystem; other backends (SQS has no storage) need the env."""
+  env = os.environ.get(PATH_ENV)
+  if env:
+    return env
+  path = getattr(queue, "path", None)  # FileQueue
+  if path:
+    return f"file://{path}/journal"
+  if spec:
+    if spec.startswith("fq://"):
+      return f"file://{os.path.abspath(os.path.expanduser(spec[5:]))}/journal"
+    if "://" not in spec:
+      return f"file://{os.path.abspath(os.path.expanduser(spec))}/journal"
+  return None
+
+
+class Journal:
+  """Append-only segment writer for one worker process."""
+
+  def __init__(self, cloudpath: str, worker_id: Optional[str] = None,
+               flush_interval: Optional[float] = None):
+    self.cloudpath = cloudpath
+    self.worker_id = worker_id or default_worker_id()
+    if flush_interval is None:
+      try:
+        flush_interval = float(
+          os.environ.get(FLUSH_SEC_ENV, DEFAULT_FLUSH_SEC)
+        )
+      except ValueError:
+        flush_interval = DEFAULT_FLUSH_SEC
+    self.flush_interval = float(flush_interval)
+    self._seq = 0
+    self._lock = threading.Lock()
+    self._last_flush = time.monotonic()
+    self._dirty = threading.Event()  # drain requested: flush ASAP
+    self.segments_written = 0
+
+  # -- write side -----------------------------------------------------------
+
+  def mark_dirty(self) -> None:
+    """Request an out-of-band flush (lifecycle drain, round boundary);
+    safe to call from signal handlers — it only sets an event."""
+    self._dirty.set()
+
+  def maybe_flush(self, event: Optional[str] = None) -> bool:
+    """Flush if the interval elapsed or a flush was requested. Cheap when
+    neither holds (one monotonic read). Called from poll loops between
+    tasks."""
+    if not self._dirty.is_set():
+      if time.monotonic() - self._last_flush < self.flush_interval:
+        return False
+    return self.flush(event=event)
+
+  def flush(self, event: Optional[str] = None) -> bool:
+    """Write one segment with all pending spans + a metrics snapshot.
+    Skips the write when there is nothing new and no ``event`` to record.
+    Returns True when a segment landed."""
+    with self._lock:
+      self._dirty.clear()
+      spans = trace.drain_spans()
+      self._last_flush = time.monotonic()
+      if not spans and event is None:
+        return False
+      lines = []
+      snap = {
+        "kind": "counters", "worker": self.worker_id, "ts": time.time(),
+        "event": event or "interval",
+        "counters": metrics.counters_snapshot(),
+        "timers": metrics.timer_totals(),
+        "gauges": metrics.gauges_snapshot(),
+      }
+      dropped = trace.dropped_spans()
+      if dropped:
+        snap["spans_dropped"] = dropped
+      lines.append(json.dumps(snap))
+      for rec in spans:
+        rec = dict(rec)
+        rec["kind"] = "span"
+        rec["worker"] = self.worker_id
+        lines.append(json.dumps(rec))
+      name = f"{self.worker_id}-{self._seq:06d}.jsonl"
+      self._seq += 1
+      data = ("\n".join(lines) + "\n").encode("utf8")
+    try:
+      from ..storage import CloudFiles
+
+      CloudFiles(self.cloudpath).put(name, data, compress=None)
+    except Exception:
+      # observability must never kill a healthy worker; the batch is
+      # gone but the next flush carries the cumulative counters anyway
+      metrics.incr("journal.flush_failed")
+      return False
+    self.segments_written += 1
+    metrics.incr("journal.segments")
+    return True
+
+
+# -- process-wide active journal ---------------------------------------------
+
+_ACTIVE: Optional[Journal] = None
+_LAST_WILL = {"armed": False, "fired": False}
+
+
+def set_active(journal: Optional[Journal]) -> None:
+  global _ACTIVE
+  _ACTIVE = journal
+
+
+def get_active() -> Optional[Journal]:
+  return _ACTIVE
+
+
+def maybe_flush_active(event: Optional[str] = None) -> None:
+  j = _ACTIVE
+  if j is not None:
+    j.maybe_flush(event=event)
+
+
+def flush_active(event: Optional[str] = None) -> None:
+  j = _ACTIVE
+  if j is not None:
+    j.flush(event=event)
+
+
+def request_flush() -> None:
+  """Signal-handler-safe: mark the active journal dirty so the next
+  ``maybe_flush`` (poll loop, round boundary) writes the pending batch."""
+  j = _ACTIVE
+  if j is not None:
+    j.mark_dirty()
+
+
+def install_last_will(extra: Optional[dict] = None) -> None:
+  """Arm an atexit hook: whatever kills this worker (unhandled exception,
+  sys.exit, normal return), the final counters line + journal batch land.
+  Re-arms the fire guard each call — a process hosting several worker
+  runs (tests, notebooks) gets one last will per run, not per process —
+  while the atexit registration itself stays singular."""
+  _LAST_WILL["fired"] = False
+  if _LAST_WILL["armed"]:
+    return
+  _LAST_WILL["armed"] = True
+  import atexit
+
+  atexit.register(fire_last_will, "atexit", extra or {})
+
+
+def fire_last_will(event: str = "exit", extra: Optional[dict] = None) -> None:
+  if _LAST_WILL["fired"]:
+    return
+  _LAST_WILL["fired"] = True
+  try:
+    metrics.emit_counters(event=event, **(extra or {}))
+  finally:
+    flush_active(event=event)
+
+
+def disarm_last_will(flush: bool = True) -> None:
+  """Clean-exit path: the journal's final segment still lands, but no
+  counters line prints (healthy workers keep their historical stdout)."""
+  _LAST_WILL["fired"] = True
+  if flush:
+    flush_active(event="exit")
+
+
+# -- read side ----------------------------------------------------------------
+
+
+def read_records(cloudpath: str) -> Iterator[dict]:
+  """Iterate every record of every segment under a journal path (order:
+  segment name, then line order — i.e. per-worker chronological)."""
+  from ..storage import CloudFiles
+
+  cf = CloudFiles(cloudpath)
+  try:
+    keys = sorted(cf.list())
+  except Exception:
+    return
+  for key in keys:
+    data = cf.get(key)
+    if data is None:
+      continue
+    for line in data.decode("utf8", errors="replace").splitlines():
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        rec = json.loads(line)
+      except ValueError:
+        continue
+      rec.setdefault("segment", key)
+      yield rec
+
+
+def segment_count(cloudpath: str) -> int:
+  from ..storage import CloudFiles
+
+  try:
+    return sum(1 for _ in CloudFiles(cloudpath).list())
+  except Exception:
+    return 0

@@ -37,6 +37,8 @@ from typing import Optional
 import numpy as np
 
 from ..lib import Bbox
+from ..observability import journal as journal_mod
+from ..observability import trace
 from ..queues.filequeue import failure_reason, run_with_deadline
 
 
@@ -318,6 +320,9 @@ class LeaseBatcher:
         }))
       else:
         self.run_round(members)
+      # round boundary: the round's spans (one lease.round + K member
+      # task spans) flush as one journal segment
+      journal_mod.maybe_flush_active(event="round")
 
   # -- next-round pipelining ------------------------------------------------
 
@@ -473,9 +478,15 @@ class LeaseBatcher:
       self._hb.start()
     for _task, lease_id in members:
       self._hb.track(lease_id)  # idempotent for pre-leased members
+    t0 = time.time()
     try:
       self._run_round_inner(members)
     finally:
+      # worker-scoped span: one lease round (group dispatch + member
+      # completions) under the process's own trace id
+      trace.record_root(
+        "lease.round", t0, time.time() - t0, members=len(members),
+      )
       # cutouts this round's writes made stale must never feed a later
       # round from the prefetch cache (a member re-leased after failure,
       # say, whose cutout lingered unconsumed)
@@ -537,7 +548,10 @@ class LeaseBatcher:
       if self.verbose:
         print(f"Executing (solo) {task!r}")
       try:
-        run_with_deadline(task.execute, self.task_deadline_seconds)
+        with trace.task_span(
+          task, attempt=self._attempt_of(lease_id), mode="batch-solo"
+        ):
+          run_with_deadline(task.execute, self.task_deadline_seconds)
       except Exception as e:
         self._record_failure(lease_id, e)
         continue
@@ -570,14 +584,27 @@ class LeaseBatcher:
     # group membership tracks the ORIGINAL token (what handlers hold)
     self._completed_in_group.add(lease_id)
 
+  def _attempt_of(self, lease_id):
+    try:
+      if hasattr(self.queue, "delivery_count"):
+        return int(self.queue.delivery_count(lease_id))
+    except Exception:
+      pass
+    return None
+
   def _finish_members(self, group, finish_one):
     """Run each member's host completion; a failure keeps that member's
     lease only."""
     for idx, (task, lease_id) in enumerate(group):
       try:
-        run_with_deadline(
-          lambda: finish_one(idx, task), self.task_deadline_seconds
-        )
+        # the member's completion span: its share of the batched round
+        # (the shared device dispatch is the round's own lease.round span)
+        with trace.task_span(
+          task, attempt=self._attempt_of(lease_id), mode="batched"
+        ):
+          run_with_deadline(
+            lambda: finish_one(idx, task), self.task_deadline_seconds
+          )
       except Exception as e:
         self._record_failure(lease_id, e)
         continue

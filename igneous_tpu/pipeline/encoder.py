@@ -27,9 +27,11 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import threading
+import time
 from typing import Callable, List, Optional
 
 from .. import telemetry
+from ..observability import trace
 from . import config
 
 
@@ -42,6 +44,23 @@ class UploadTicket:
     self._futures: List[cf.Future] = []
 
   def submit(self, fn: Callable[[], None]) -> None:
+    # carry the submitting thread's trace context onto the pool thread:
+    # the chunk's encode+put work (and its storage spans) stays
+    # attributed to the task that produced it
+    ctx = trace.current()
+    if ctx is not None and ctx.sampled:
+      inner = fn
+
+      def fn():
+        with trace.activate(ctx):
+          t0 = time.perf_counter()
+          try:
+            inner()
+          finally:
+            telemetry.observe(
+              "pipeline.encode_upload.s", time.perf_counter() - t0
+            )
+
     fut = self._pool._submit(fn)
     with self._lock:
       self._futures.append(fut)

@@ -123,10 +123,23 @@ def poll_loop(
   e.g. lifecycle.StopFlag) requests graceful shutdown: the in-flight
   task finishes, no new lease is taken."""
   from .. import telemetry
+  from ..observability import journal as journal_mod
+  from ..observability import trace
   from .heartbeat import LeaseHeartbeat
 
   def draining() -> bool:
     return drain_flag is not None and drain_flag.is_set()
+
+  def attempt_of(lease_id) -> Optional[int]:
+    # fq:// persists delivery counts; SQS reports ApproximateReceiveCount
+    try:
+      if hasattr(queue, "delivery_count"):
+        return int(queue.delivery_count(lease_id))
+      if getattr(queue, "last_receive_count", 0):
+        return int(queue.last_receive_count)
+    except Exception:
+      pass
+    return None
 
   def idle(seconds: float):
     # wake early when a drain request lands mid-backoff
@@ -138,8 +151,13 @@ def poll_loop(
   backoff = 1.0
   executed = 0
   hb = LeaseHeartbeat(queue, lease_seconds, interval=heartbeat_seconds)
-  with hb:
+  try:
+   with hb:
     while True:
+      # interval/drain-requested journal flush between tasks: the poll
+      # loop IS the worker's main thread, so batches land without a
+      # dedicated flusher thread
+      journal_mod.maybe_flush_active()
       if draining():
         return executed
       if stop_fn is not None and stop_fn(executed=executed, empty=False):
@@ -168,9 +186,15 @@ def poll_loop(
         # execute() when the task has no stage plan or pipelining is off)
         from ..pipeline import execute_with_sink
 
-        run_with_deadline(
-          lambda: execute_with_sink(task), task_deadline_seconds
-        )
+        # the task span wraps this delivery: stage/storage spans on this
+        # thread (and pool threads the upload ticket propagates to)
+        # parent under it, attributed to the payload's trace
+        with trace.task_span(
+          task, attempt=attempt_of(lease_id), queue=type(queue).__name__
+        ):
+          run_with_deadline(
+            lambda: execute_with_sink(task), task_deadline_seconds
+          )
         if after_fn:
           after_fn(task)
       except Exception as e:
@@ -191,6 +215,12 @@ def poll_loop(
       # tokens, so a zombie's late ack can never complete a re-issued task
       queue.delete(hb.untrack(key))
       executed += 1
+  finally:
+    # whatever ends the loop — drain, stop_fn, an unhandled error — the
+    # pending span batch must not die with the worker
+    journal_mod.flush_active(
+      event="drain" if draining() else "poll_exit"
+    )
 
 
 class FileQueue:

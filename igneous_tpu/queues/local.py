@@ -24,8 +24,11 @@ def _execute_payload(payload: str):
   # runs in a spawned worker: re-import the task universe first
   import igneous_tpu.tasks  # noqa: F401  (registers all task classes)
 
+  from ..observability import trace
+
   task = deserialize(payload)
-  task.execute()
+  with trace.task_span(task, queue="LocalTaskQueue"):
+    task.execute()
   return True
 
 

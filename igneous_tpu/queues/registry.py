@@ -10,6 +10,14 @@ the reference's RegisteredTask-subclass and @queueable-function styles
 RegisteredTask subclasses get automatic serialization: the constructor's
 bound arguments are recorded at instantiation time, so ``__init__``
 signatures ARE the wire schema.
+
+Trace identity (ISSUE 5): every task minted by a factory carries a
+``"trace"`` payload field ({trace_id, ts[, parent_span_id, sampled]})
+assigned at instantiation and restored verbatim on deserialize, so
+enqueue → lease → retry → DLQ is one trace across workers. The field is
+observability metadata, NOT wire schema: equality and hashing ignore it,
+and payloads without it (older queues) deserialize fine — the worker
+mints locally and lineage simply starts at the lease.
 """
 
 from __future__ import annotations
@@ -47,6 +55,9 @@ class RegisteredTask:
           if p.kind is inspect.Parameter.VAR_KEYWORD:
             params.update(params.pop(pname, {}))
         self._params = jsonify(params)
+        from ..observability import trace
+
+        self._trace = trace.mint()
       orig_init(self, *args, **kwargs)
 
     cls.__init__ = wrapped_init
@@ -54,16 +65,24 @@ class RegisteredTask:
   def __init__(self):
     if not hasattr(self, "_params"):
       self._params = {}
+      from ..observability import trace
+
+      self._trace = trace.mint()
 
   def execute(self):
     raise NotImplementedError
 
   def payload(self) -> dict:
-    return {
+    out = {
       "class": type(self).__name__,
       "module": type(self).__module__,
       "params": self._params,
     }
+    tinfo = getattr(self, "_trace", None)
+    if tinfo:
+      # exec_span_id is per-delivery state, never part of the wire trace
+      out["trace"] = {k: v for k, v in tinfo.items() if k != "exec_span_id"}
+    return out
 
   def to_json(self) -> str:
     return json.dumps(self.payload())
@@ -79,7 +98,9 @@ class RegisteredTask:
     )
 
   def __hash__(self):
-    return hash(self.to_json())
+    # class + params only: the trace field is identity metadata, and two
+    # equal tasks (__eq__ compares _params) must share a hash
+    return hash((type(self).__name__, json.dumps(self._params, sort_keys=True)))
 
 
 def queueable(fn: Callable) -> Callable:
@@ -102,11 +123,15 @@ class FunctionTask(RegisteredTask):
     self.kwargs = kwargs or {}
 
   def payload(self) -> dict:
-    return {
+    out = {
       "fn": self.fn_name,
       "args": jsonify(list(self.args)),
       "kwargs": jsonify(dict(self.kwargs)),
     }
+    tinfo = getattr(self, "_trace", None)
+    if tinfo:
+      out["trace"] = {k: v for k, v in tinfo.items() if k != "exec_span_id"}
+    return out
 
   def execute(self):
     if self.fn_name not in FN_REGISTRY:
@@ -144,11 +169,24 @@ def serialize(task) -> str:
   raise TypeError(f"Cannot serialize task: {task!r}")
 
 
+def _reenter_trace(task, payload: dict):
+  """Restore the payload's trace identity onto a deserialized task (the
+  constructor minted a fresh one; the wire's wins so redeliveries and
+  cross-worker hops stay one trace)."""
+  tinfo = payload.get("trace")
+  if tinfo and isinstance(tinfo, dict) and tinfo.get("trace_id"):
+    task._trace = dict(tinfo)
+  return task
+
+
 def deserialize(payload: Union[str, bytes, dict]) -> RegisteredTask:
   if isinstance(payload, (str, bytes)):
     payload = json.loads(payload)
   if "fn" in payload:
-    return FunctionTask(payload["fn"], payload.get("args"), payload.get("kwargs"))
+    return _reenter_trace(
+      FunctionTask(payload["fn"], payload.get("args"), payload.get("kwargs")),
+      payload,
+    )
   name = payload["class"]
   if name not in TASK_REGISTRY and payload.get("module"):
     # cross-process case: the defining module wasn't imported yet
@@ -159,7 +197,9 @@ def deserialize(payload: Union[str, bytes, dict]) -> RegisteredTask:
     raise KeyError(
       f"Task class {name!r} is not registered. Import the module defining it."
     )
-  return TASK_REGISTRY[name](**payload.get("params", {}))
+  return _reenter_trace(
+    TASK_REGISTRY[name](**payload.get("params", {})), payload
+  )
 
 
 totask = deserialize

@@ -40,6 +40,7 @@ except ImportError:  # zstd stays readable/writable only where the codec ships
   zstandard = None
 
 from .lib import jsonify
+from .observability import trace as _trace
 
 # brotli is deliberately absent: no brotli codec ships in this environment,
 # so .br files are left visible under their literal names rather than
@@ -407,7 +408,10 @@ class CloudFiles:
     if isinstance(content, str):
       content = content.encode("utf8")
     ext = COMPRESSION_EXTS[compress]
-    self.backend.put(key + ext, compress_bytes(bytes(content), compress))
+    # storage spans only materialize under a sampled task trace
+    # (observability.trace.maybe_span is a thread-local check otherwise)
+    with _trace.maybe_span("storage.put", protocol=self.pth.protocol):
+      self.backend.put(key + ext, compress_bytes(bytes(content), compress))
 
   def puts(self, files: Iterable, compress=None, **kw):
     total = 0
@@ -434,14 +438,15 @@ class CloudFiles:
   # -- read ----------------------------------------------------------------
 
   def _resolve(self, key: str) -> Tuple[Optional[bytes], Optional[str]]:
-    data = self.backend.get(key)
-    if data is not None:
-      return data, None
-    for ext, method in _EXT_TO_COMPRESSION.items():
-      data = self.backend.get(key + ext)
+    with _trace.maybe_span("storage.get", protocol=self.pth.protocol):
+      data = self.backend.get(key)
       if data is not None:
-        return data, method
-    return None, None
+        return data, None
+      for ext, method in _EXT_TO_COMPRESSION.items():
+        data = self.backend.get(key + ext)
+        if data is not None:
+          return data, method
+      return None, None
 
   def get(self, key: Union[str, Iterable[str]], raw: bool = False):
     if not isinstance(key, str):
@@ -464,7 +469,8 @@ class CloudFiles:
     """Store already-wire-compressed bytes verbatim under the extension
     ``method`` implies — the zero-decode transfer's write half. ``method``
     must name the compression the bytes actually carry."""
-    self.backend.put(key + COMPRESSION_EXTS[method], bytes(data))
+    with _trace.maybe_span("storage.put", protocol=self.pth.protocol):
+      self.backend.put(key + COMPRESSION_EXTS[method], bytes(data))
 
   def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
     """Ranged read of an UNCOMPRESSED object (sharded-format reads).

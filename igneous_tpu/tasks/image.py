@@ -186,6 +186,15 @@ class TransferTask(RegisteredTask):
         "set agglomerate=True (roots) or stop_layer=2 (L2 ids)"
       )
 
+  def trace_attrs(self) -> dict:
+    """Task-span attributes for `igneous fleet top/trace`: WHICH cutout
+    this was, so slow spans map back to bucket regions."""
+    return {
+      "dest": self.dest_path,
+      "mip": self.mip,
+      "bbox": f"{tuple(self.offset)}+{tuple(self.shape)}",
+    }
+
   def _volumes_and_bounds(self):
     src = Volume(
       self.src_path, mip=self.mip, fill_missing=self.fill_missing

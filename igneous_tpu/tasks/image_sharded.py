@@ -56,6 +56,13 @@ class ImageShardTransferTask(RegisteredTask):
     self.timestamp = timestamp
     self.stop_layer = stop_layer
 
+  def trace_attrs(self) -> dict:
+    return {
+      "dest": self.dest_path,
+      "mip": self.mip,
+      "bbox": f"{tuple(self.offset)}+{tuple(self.shape)}",
+    }
+
   def execute(self):
     plan = self.stage_plan()
     plan.upload(plan.compute(plan.download()), SerialSink())
@@ -131,6 +138,13 @@ class ImageShardDownsampleTask(RegisteredTask):
     self.num_mips = int(num_mips)
     self.agglomerate = bool(agglomerate)
     self.timestamp = timestamp
+
+  def trace_attrs(self) -> dict:
+    return {
+      "dest": self.src_path,  # sharded downsample writes back to src layer
+      "mip": self.mip,
+      "bbox": f"{tuple(self.offset)}+{tuple(self.shape)}",
+    }
 
   def execute(self):
     plan = self.stage_plan()

@@ -1,212 +1,42 @@
-"""Per-stage timing + device profiling — first-class observability.
+"""Compat shim over :mod:`igneous_tpu.observability` (ISSUE 5).
 
-The reference has no built-in tracing (SURVEY.md §5.1: tqdm bars and
-queue-level ETA only); this module is the improvement the survey calls
-for: named stage timers threaded through task execution, one-line JSON
-summaries, and an optional jax.profiler trace capture around device work.
+This module used to hold the process-local counters/timers itself; that
+implementation now lives in ``observability/metrics.py`` alongside the
+trace/journal/exporter layers built on top of it. Every public name is
+re-exported so ``from igneous_tpu import telemetry`` call sites keep
+working unchanged.
+
+Behavior change shipped with the move: ``reset_counters()`` clears the
+int counters ONLY — callers that also want timers/gauges/histograms
+cleared (the old conflated behavior) must call ``reset_all()``.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import os
-import threading
-import time
-from collections import defaultdict
-from typing import Dict, Iterator, Optional
+from .observability.metrics import (  # noqa: F401
+  StageTimes,
+  _stack,
+  counters_snapshot,
+  device_trace,
+  emit_counters,
+  gauge_max,
+  gauges_snapshot,
+  histograms_snapshot,
+  incr,
+  observe,
+  queue_eta,
+  reset_all,
+  reset_counters,
+  stage,
+  task_timing,
+  timed_poll_hooks,
+  timer_totals,
+  timers_snapshot,
+)
 
-_local = threading.local()
-
-# -- failure-containment counters (ISSUE 1) ----------------------------------
-# process-wide monotonic counters for retry/fault/DLQ events: cheap enough
-# to always collect, surfaced by `igneous queue status` and the chaos soak.
-
-_COUNTERS: Dict[str, int] = defaultdict(int)
-_COUNTERS_LOCK = threading.Lock()
-
-
-def incr(name: str, n: int = 1) -> None:
-  """Bump a named counter (e.g. "retries.storage_http", "dlq.promoted")."""
-  with _COUNTERS_LOCK:
-    _COUNTERS[name] += n
-
-
-def counters_snapshot() -> Dict[str, int]:
-  with _COUNTERS_LOCK:
-    return dict(_COUNTERS)
-
-
-def reset_counters() -> None:
-  with _COUNTERS_LOCK:
-    _COUNTERS.clear()
-    _TIMERS.clear()
-    _TIMER_COUNTS.clear()
-    _GAUGES.clear()
-
-
-# -- staged-pipeline spans (ISSUE 3) -----------------------------------------
-# float-valued accumulators alongside the int counters: per-stage stall
-# time, bytes in flight, queue depth. Same lock — a pipeline flush reads
-# both families as one consistent snapshot.
-
-_TIMERS: Dict[str, float] = defaultdict(float)
-_TIMER_COUNTS: Dict[str, int] = defaultdict(int)
-_GAUGES: Dict[str, float] = defaultdict(float)  # high-water marks
-
-
-def observe(name: str, seconds: float) -> None:
-  """Accumulate a float span (e.g. "pipeline.download.stall_s")."""
-  with _COUNTERS_LOCK:
-    _TIMERS[name] += float(seconds)
-    _TIMER_COUNTS[name] += 1
-
-
-def gauge_max(name: str, value: float) -> None:
-  """Record a high-water mark (e.g. "pipeline.buffer.bytes" in flight)."""
-  with _COUNTERS_LOCK:
-    if value > _GAUGES[name]:
-      _GAUGES[name] = float(value)
-
-
-def timers_snapshot() -> Dict[str, dict]:
-  with _COUNTERS_LOCK:
-    out = {
-      name: {"seconds": round(total, 4), "count": _TIMER_COUNTS[name]}
-      for name, total in _TIMERS.items()
-    }
-    out.update({
-      name: {"max": round(v, 1)} for name, v in _GAUGES.items()
-    })
-    return out
-
-
-def emit_counters(event: str = "counters", **extra) -> dict:
-  """Flush the counters as one JSON line (stdout). Workers call this on
-  graceful drain so retry/zombie/DLQ tallies survive the pod — the line
-  is the worker's last will, greppable from `kubectl logs --previous`."""
-  record = {"event": event, **extra, "counters": counters_snapshot()}
-  timers = timers_snapshot()
-  if timers:
-    record["spans"] = timers
-  print(json.dumps(record), flush=True)
-  return record
-
-
-def _stack():
-  if not hasattr(_local, "stack"):
-    _local.stack = []
-  return _local.stack
-
-
-class StageTimes:
-  """Accumulates wall-clock per named stage (download/compute/upload/…)."""
-
-  def __init__(self):
-    self.totals: Dict[str, float] = defaultdict(float)
-    self.counts: Dict[str, int] = defaultdict(int)
-
-  def add(self, stage: str, seconds: float):
-    self.totals[stage] += seconds
-    self.counts[stage] += 1
-
-  def summary(self) -> dict:
-    return {
-      stage: {"seconds": round(self.totals[stage], 4), "count": self.counts[stage]}
-      for stage in sorted(self.totals)
-    }
-
-  def __str__(self):
-    return json.dumps(self.summary())
-
-
-@contextlib.contextmanager
-def task_timing() -> Iterator[StageTimes]:
-  """Collect stage timings for one task execution."""
-  st = StageTimes()
-  _stack().append(st)
-  try:
-    yield st
-  finally:
-    _stack().pop()
-
-
-@contextlib.contextmanager
-def stage(name: str):
-  """Time a stage; attributes to every active task_timing() scope."""
-  t0 = time.perf_counter()
-  try:
-    yield
-  finally:
-    dt = time.perf_counter() - t0
-    for st in _stack():
-      st.add(name, dt)
-
-
-@contextlib.contextmanager
-def device_trace(logdir: Optional[str] = None):
-  """jax.profiler trace around a device-heavy region.
-
-  Enabled when ``logdir`` is given or IGNEOUS_TPU_PROFILE_DIR is set;
-  otherwise a no-op (safe in workers without profiling infrastructure).
-  """
-  logdir = logdir or os.environ.get("IGNEOUS_TPU_PROFILE_DIR")
-  if not logdir:
-    yield
-    return
-  import jax
-
-  jax.profiler.start_trace(logdir)
-  try:
-    yield
-  finally:
-    jax.profiler.stop_trace()
-
-
-def timed_poll_hooks(verbose: bool = True):
-  """(before_fn, after_fn) for FileQueue.poll: logs per-task wall time and
-  stage breakdown as one JSON line per completed task."""
-  state = {}
-
-  def _close():
-    scope = state.pop("scope", None)
-    if scope is not None:
-      scope.__exit__(None, None, None)
-
-  def before(task):
-    # poll() calls after_fn only on success: if the previous task raised,
-    # its scope is still open — close it here so the stack never grows
-    _close()
-    state["t0"] = time.perf_counter()
-    scope = task_timing()
-    state["st"] = scope.__enter__()
-    state["scope"] = scope
-
-  def after(task):
-    st: StageTimes = state["st"]
-    _close()
-    record = {
-      "task": type(task).__name__,
-      "wall_s": round(time.perf_counter() - state["t0"], 4),
-      "stages": st.summary(),
-    }
-    if verbose:
-      print(json.dumps(record), flush=True)
-
-  return before, after
-
-
-def queue_eta(queue, sample_seconds: float = 10.0) -> dict:
-  """Tasks/sec + ETA from two enqueued-count samples
-  (reference `igneous queue status --eta`, cli.py:1998-2048)."""
-  first = queue.enqueued
-  t0 = time.time()
-  time.sleep(sample_seconds)
-  second = queue.enqueued
-  dt = time.time() - t0
-  rate = max((first - second) / dt, 0.0)
-  return {
-    "enqueued": second,
-    "tasks_per_sec": round(rate, 3),
-    "eta_sec": round(second / rate, 1) if rate > 0 else None,
-  }
+__all__ = [
+  "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
+  "gauge_max", "gauges_snapshot", "histograms_snapshot", "incr", "observe",
+  "queue_eta", "reset_all", "reset_counters", "stage", "task_timing",
+  "timed_poll_hooks", "timer_totals", "timers_snapshot",
+]

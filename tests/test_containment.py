@@ -341,7 +341,9 @@ def test_retry_policy_jitter_bounded_and_seeded():
 
 
 def test_retry_counter_surfaces_in_telemetry():
-  telemetry.reset_counters()
+  # reset_counters() is counter-only since the ISSUE 5 split; this test
+  # wants a pristine slate across every metric family
+  telemetry.reset_all()
   pol = RetryPolicy(attempts=3, base_s=0.0, jitter="none",
                     sleep_fn=lambda s: None)
   list(pol.retries("unit"))

@@ -1,0 +1,488 @@
+"""ISSUE 5: distributed observability — trace propagation, journal,
+exporters, fleet aggregation.
+
+Covers the satellite checklist: trace-id propagation across FileQueue
+redelivery and DLQ promotion, journal flush on a drain request (the
+in-process form of the SIGTERM path tools/chaos_soak.py exercises with
+real subprocesses), Prometheus text-format validity, Perfetto export as
+valid JSON, sampling=0 disabling span allocation, thread safety under
+the pipeline's encode pool — plus the acceptance lineage demo: one
+factory-minted task, leased, chaos-retried once, executed through the
+staged pipeline, yielding ONE merged trace via `igneous fleet trace`.
+"""
+
+import json
+import re
+import time
+
+import numpy as np
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.chaos import ChaosConfig, ChaosQueue
+from igneous_tpu.observability import (
+  fleet,
+  journal as journal_mod,
+  perfetto,
+  prom,
+  trace,
+)
+from igneous_tpu.queues import FileQueue
+from igneous_tpu.queues.registry import (
+  PrintTask,
+  RegisteredTask,
+  deserialize,
+  serialize,
+)
+from igneous_tpu.tasks import FailTask, TouchFileTask
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+  telemetry.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+  yield
+  telemetry.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+
+
+class DrainingTask(RegisteredTask):
+  """Sets a class-level StopFlag when executed (in-process SIGTERM)."""
+
+  flag = None
+
+  def __init__(self):
+    pass
+
+  def execute(self):
+    if DrainingTask.flag is not None:
+      DrainingTask.flag.set("task")
+
+
+# -- trace identity ----------------------------------------------------------
+
+
+def test_trace_minted_at_creation_and_round_trips():
+  t = PrintTask("x")
+  assert t._trace and t._trace["trace_id"]
+  payload = serialize(t)
+  assert json.loads(payload)["trace"]["trace_id"] == t._trace["trace_id"]
+  t2 = deserialize(payload)
+  assert t2._trace["trace_id"] == t._trace["trace_id"]
+  # trace is identity metadata, not wire schema: equality/hash unaffected
+  assert t2 == t and hash(t2) == hash(t)
+
+
+def test_trace_survives_filequeue_redelivery_and_dlq(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=2)
+  task = FailTask()
+  tid = task._trace["trace_id"]
+  q.insert(task)
+
+  got1 = q.lease(seconds=0.01)
+  assert got1 is not None and got1[0]._trace["trace_id"] == tid
+  q.nack(got1[1], "boom 1")
+  time.sleep(0.03)
+
+  got2 = q.lease(seconds=0.01)  # redelivery: same trace identity
+  assert got2 is not None and got2[0]._trace["trace_id"] == tid
+  q.nack(got2[1], "boom 2")  # budget exhausted -> DLQ
+
+  assert q.dlq_count == 1
+  rec = q.dlq_ls()[0]
+  # the quarantined payload still carries the trace: `fleet trace` can
+  # follow a task all the way into the DLQ
+  assert json.loads(rec["payload"])["trace"]["trace_id"] == tid
+
+
+def test_sampling_zero_disables_span_allocation(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_TRACE_SAMPLE", "0")
+  t = TouchFileTask(path=str(tmp_path / "f"))
+  assert t._trace is None
+  assert "trace" not in json.loads(serialize(t))
+  assert trace.mint() is None
+  with trace.task_span(t) as ctx:
+    assert ctx is None
+    with trace.span("never") as sid:
+      assert sid is None
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(3)])
+  q.poll(lease_seconds=30, stop_fn=lambda executed, empty: empty)
+  assert trace.drain_spans() == []
+
+
+def test_partial_sampling_keeps_identity_drops_spans(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_TRACE_SAMPLE", "0.0000001")
+  # identity still minted (lineage intact), spans almost surely off
+  minted = [trace.mint() for _ in range(50)]
+  assert all(m and m["trace_id"] for m in minted)
+  assert any(m.get("sampled") is False for m in minted)
+
+
+def test_task_span_records_queue_wait_and_error():
+  task = FailTask()
+  with pytest.raises(RuntimeError):
+    with trace.task_span(task, attempt=2):
+      task.execute()
+  spans = trace.drain_spans()
+  names = {s["name"] for s in spans}
+  assert names == {"queue.wait", "task"}
+  tspan = next(s for s in spans if s["name"] == "task")
+  assert tspan["error"] == "RuntimeError"
+  assert tspan["attempt"] == 2
+  assert tspan["trace"] == task._trace["trace_id"]
+  wait = next(s for s in spans if s["name"] == "queue.wait")
+  # the wait span parents under the execution root: one tree per delivery
+  assert wait["parent"] == tspan["span"]
+
+
+def test_nested_spans_parent_chain():
+  ctx = trace.SpanContext("t" * 16, "root0", True)
+  with trace.activate(ctx):
+    with trace.span("outer") as outer_id:
+      with trace.span("inner"):
+        pass
+  spans = {s["name"]: s for s in trace.drain_spans()}
+  assert spans["inner"]["parent"] == outer_id
+  assert spans["outer"]["parent"] == "root0"
+
+
+def test_span_thread_safety_under_encode_pool():
+  """N concurrent closures on the shared encode pool, all recording
+  spans under propagated contexts: every span lands exactly once."""
+  from igneous_tpu.pipeline.encoder import EncodePool
+
+  pool = EncodePool(threads=4)
+  try:
+    ctx = trace.SpanContext("f" * 16, "root", True)
+    ticket = pool.ticket()
+    with trace.activate(ctx):
+      for i in range(200):
+        ticket.submit(lambda: trace.event("unit"))
+    ticket.join()
+  finally:
+    pool.shutdown()
+  spans = trace.drain_spans()
+  units = [s for s in spans if s["name"] == "unit"]
+  encodes = [s for s in spans if s["name"] == "pipeline.encode_upload.s"]
+  assert len(units) == 200 and len(encodes) == 200
+  assert all(s["trace"] == "f" * 16 for s in units)
+  assert len({s["span"] for s in spans}) == len(spans)  # unique ids
+
+
+# -- metrics: reset split + prometheus ---------------------------------------
+
+
+def test_reset_counters_is_counter_only_now():
+  telemetry.incr("c")
+  telemetry.observe("t.s", 0.5)
+  telemetry.gauge_max("g", 3.0)
+  telemetry.reset_counters()
+  assert telemetry.counters_snapshot() == {}
+  snap = telemetry.timers_snapshot()
+  assert snap["t.s"]["count"] == 1 and snap["g"]["max"] == 3.0
+  telemetry.reset_all()
+  assert telemetry.timers_snapshot() == {}
+  assert telemetry.histograms_snapshot() == {}
+
+
+_PROM_LINE = re.compile(
+  r"^(# (TYPE|HELP) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+  r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9.eE+-]+(inf)?)$"
+)
+
+
+def test_prometheus_text_format_valid():
+  telemetry.incr("dlq.promoted", 3)
+  telemetry.incr("zombie.delete")
+  for v in (0.0001, 0.02, 0.3, 7.0, 120.0):
+    telemetry.observe("pipeline.download.s", v)
+  telemetry.gauge_max("pipeline.prefetch.bytes", 1e6)
+  text = prom.render()
+  lines = [ln for ln in text.splitlines() if ln]
+  assert lines, text
+  for ln in lines:
+    assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+  assert "igneous_dlq_promoted_total 3" in lines
+  assert "igneous_zombie_delete_total 1" in lines
+  assert "igneous_pipeline_prefetch_bytes 1000000" in lines
+  # histogram: cumulative buckets, +Inf == count, sum matches
+  buckets = [
+    int(ln.rsplit(" ", 1)[1]) for ln in lines
+    if ln.startswith("igneous_pipeline_download_s_seconds_bucket")
+  ]
+  assert buckets == sorted(buckets), "histogram buckets must be cumulative"
+  assert buckets[-1] == 5  # +Inf bucket holds every observation
+  assert "igneous_pipeline_download_s_seconds_count 5" in lines
+
+
+def test_prometheus_http_endpoint():
+  import urllib.request
+
+  telemetry.incr("endpoint.test")
+  port = prom.start_http_server(0)  # 0: grab a free port
+  try:
+    assert port
+    with urllib.request.urlopen(
+      f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as resp:
+      body = resp.read().decode("utf8")
+      assert resp.headers["Content-Type"].startswith("text/plain")
+    assert "igneous_endpoint_test_total 1" in body
+  finally:
+    prom.stop_http_server()
+
+
+def test_prometheus_textfile_atomic(tmp_path):
+  telemetry.incr("textfile.test")
+  out = tmp_path / "igneous.prom"
+  assert prom.write_textfile(str(out)) == str(out)
+  assert "igneous_textfile_test_total 1" in out.read_text()
+  assert not list(tmp_path.glob("*.tmp.*"))  # no turds
+
+
+# -- perfetto ----------------------------------------------------------------
+
+
+def test_perfetto_export_valid_json(tmp_path):
+  ctx = trace.SpanContext("a" * 16, None, True)
+  with trace.activate(ctx):
+    with trace.span("task", task="DownsampleTask"):
+      with trace.span("storage.get"):
+        pass
+  records = [dict(r, kind="span", worker="w1") for r in trace.drain_spans()]
+  out = tmp_path / "trace.json"
+  n = perfetto.dump(records, str(out))
+  assert n == 3  # 2 spans + 1 process_name metadata event
+  doc = json.loads(out.read_text())
+  events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+  assert len(events) == 2
+  for e in events:
+    assert e["ts"] >= 0 and e["dur"] >= 0 and isinstance(e["pid"], int)
+    assert e["args"]["trace_id"] == "a" * 16
+  meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+  assert meta and meta[0]["args"]["name"] == "worker w1"
+  # filtering by trace id excludes foreign spans
+  assert perfetto.chrome_trace(records, trace_id="nope")["traceEvents"] == []
+
+
+# -- journal -----------------------------------------------------------------
+
+
+def test_journal_flush_on_drain_request(tmp_path):
+  """The in-process form of the SIGTERM drain: a task flips the
+  StopFlag mid-poll (exactly what install_signal_handlers does), and the
+  poll loop's exit flush leaves the final batch in the journal — the
+  contract tools/chaos_soak.py --scenario preemption re-proves with real
+  SIGTERMed subprocesses."""
+  from igneous_tpu.lifecycle import StopFlag
+
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(3)]
+           + [DrainingTask()])
+  jr = journal_mod.Journal(
+    journal_mod.journal_path_for(q), worker_id="w-drain",
+    flush_interval=1e9,  # interval never fires; only the drain path can
+  )
+  journal_mod.set_active(jr)
+  flag = StopFlag()
+  DrainingTask.flag = flag
+  try:
+    q.poll(lease_seconds=30, stop_fn=lambda executed, empty: empty,
+           drain_flag=flag)
+  finally:
+    DrainingTask.flag = None
+    journal_mod.set_active(None)
+  assert flag.is_set()
+  records = list(journal_mod.read_records(f"file://{tmp_path}/q/journal"))
+  assert records, "drain left no journal segment"
+  drains = [r for r in records
+            if r["kind"] == "counters" and r["event"] == "drain"]
+  assert drains and drains[0]["worker"] == "w-drain"
+  assert any(r["kind"] == "span" and r["name"] == "task" for r in records)
+
+
+def test_journal_flush_interval_and_dirty(tmp_path):
+  jr = journal_mod.Journal(f"file://{tmp_path}/j", worker_id="w",
+                           flush_interval=1e9)
+  trace.record_root("x", time.time(), 0.1)
+  assert jr.maybe_flush() is False  # interval not elapsed, not dirty
+  jr.mark_dirty()
+  assert jr.maybe_flush() is True   # drain request forces the write
+  assert jr.segments_written == 1
+  # nothing pending + no event: no empty segment written
+  assert jr.flush() is False
+  assert jr.flush(event="drain") is True  # lifecycle flush always lands
+
+
+def test_journal_last_will_emits_once(tmp_path, capsys):
+  jr = journal_mod.Journal(f"file://{tmp_path}/j", worker_id="w")
+  journal_mod.set_active(jr)
+  journal_mod._LAST_WILL["fired"] = False
+  telemetry.incr("will.test")
+  journal_mod.fire_last_will("crash", {"queue": "fq://x"})
+  journal_mod.fire_last_will("crash", {"queue": "fq://x"})  # idempotent
+  out = capsys.readouterr().out.strip().splitlines()
+  wills = [json.loads(ln) for ln in out if "will.test" in ln]
+  assert len(wills) == 1
+  assert wills[0]["event"] == "crash" and wills[0]["queue"] == "fq://x"
+  records = list(journal_mod.read_records(f"file://{tmp_path}/j"))
+  assert any(r["event"] == "crash" for r in records
+             if r["kind"] == "counters")
+
+
+# -- fleet aggregation -------------------------------------------------------
+
+
+def _mk_span(worker, name, ts, dur, trace_id="t1", **kw):
+  return dict(kind="span", worker=worker, trace=trace_id,
+              span=trace.new_id(), parent=None, name=name, ts=ts,
+              dur=dur, **kw)
+
+
+def test_fleet_status_merges_workers():
+  now = time.time()
+  records = [
+    _mk_span("w1", "task", now, 2.0, task="DownsampleTask"),
+    _mk_span("w2", "task", now + 1, 4.0, trace_id="t2",
+             task="DownsampleTask"),
+    _mk_span("w1", "pipeline.download.s", now, 1.0),
+    _mk_span("w2", "pipeline.download.s", now + 1, 3.0, trace_id="t2"),
+    _mk_span("w1", "pipeline.prefetch.producer_stall_s", now, 1.0),
+    # per-worker cumulative counters: LAST snapshot each, summed across
+    {"kind": "counters", "worker": "w1", "ts": now,
+     "counters": {"zombie.delete": 1}},
+    {"kind": "counters", "worker": "w1", "ts": now + 5,
+     "counters": {"zombie.delete": 2, "dlq.promoted": 1}},
+    {"kind": "counters", "worker": "w2", "ts": now,
+     "counters": {"zombie.renew": 3}},
+  ]
+  st = fleet.status(records)
+  assert st["workers"] == ["w1", "w2"]
+  assert st["tasks"] == 2 and st["tasks_failed"] == 0
+  assert st["zombie_fences"] == 5  # 2 (w1 latest) + 3 (w2)
+  assert st["dlq_promoted"] == 1
+  dl = st["stages"]["pipeline.download.s"]
+  assert dl["count"] == 2 and dl["p95_ms"] == 3000.0
+  assert 0 < st["stall_ratio"] < 1
+  top = fleet.slowest_tasks(records, n=1)
+  assert top[0]["trace_id"] == "t2" and top[0]["dur_s"] == 4.0
+
+
+def test_queue_eta_journal_derived_no_sleep(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  jr = journal_mod.Journal(journal_mod.journal_path_for(q), worker_id="w")
+  now = time.time()
+  for i in range(10):
+    trace.record_root("task", now - 5 + i * 0.5, 0.4)
+  journal_mod.set_active(jr)
+  try:
+    jr.flush(event="test")
+  finally:
+    journal_mod.set_active(None)
+  t0 = time.monotonic()
+  stats = telemetry.queue_eta(
+    q, sample_seconds=30.0,
+    journal_path=journal_mod.journal_path_for(q),
+  )
+  assert time.monotonic() - t0 < 5.0, "journal path must not sleep"
+  assert stats["source"] == "journal"
+  assert stats["tasks_per_sec"] > 0
+  assert stats["eta_sec"] == 0.0  # queue is empty
+
+
+def test_queue_eta_falls_back_to_sampling_without_segments(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  stats = telemetry.queue_eta(
+    q, sample_seconds=0.05,
+    journal_path=journal_mod.journal_path_for(q),
+  )
+  assert stats["source"] == "sampled"
+
+
+# -- acceptance: end-to-end lineage ------------------------------------------
+
+
+@pytest.fixture
+def _pipeline_env(monkeypatch):
+  # staged pipeline through the solo poll loop (tier-A), threads forced
+  # on ("1" = force; numbers >1 are not widths) so the 1-core CI host
+  # still exercises the pool paths
+  monkeypatch.setenv("IGNEOUS_PIPELINE", "1")
+  monkeypatch.setenv("IGNEOUS_PIPELINE_THREADS", "1")
+
+
+def test_lineage_enqueue_retry_pipeline_one_trace(tmp_path, _pipeline_env):
+  """ISSUE 5 acceptance: a factory-minted task, leased, chaos-retried
+  once (dropped ack), executed through the staged pipeline — ONE merged
+  trace holding the enqueue wait, both deliveries, and the pipeline
+  stage spans, surfaced by `igneous fleet trace <trace_id>`."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.volume import Volume
+
+  img = np.random.default_rng(1).integers(0, 255, (64, 64, 32))
+  layer = f"file://{tmp_path}/layer"
+  Volume.from_numpy(img.astype(np.uint8), layer,
+                    chunk_size=(32, 32, 32), compress="gzip")
+  tasks = list(tc.create_downsampling_tasks(
+    layer, mip=0, num_mips=1, memory_target=int(6e5),
+  ))
+  assert tasks, "factory produced no tasks"
+  tid = tasks[0]._trace["trace_id"]
+
+  spec = f"fq://{tmp_path}/q"
+  q = FileQueue(spec)
+  q.insert(tasks)
+  # every task's FIRST delete is dropped: the delivery succeeds but the
+  # ack is lost, so each task redelivers exactly once (chaos-retry)
+  cq = ChaosQueue(q, ChaosConfig(seed=3, drop_delete=1.0,
+                                 max_faults_per_key=1))
+  journal_mod.set_active(journal_mod.Journal(
+    journal_mod.journal_path_for(q, spec), worker_id="w-lineage",
+  ))
+  try:
+    cq.poll(
+      lease_seconds=0.5,
+      stop_fn=lambda executed, empty: empty and q.enqueued == 0,
+      max_backoff_window=0.2,
+    )
+  finally:
+    journal_mod.set_active(None)
+
+  records = fleet.load(f"file://{tmp_path}/q/journal")
+  spans = fleet.trace_records(records, tid)
+  assert spans, "lineage trace has no spans"
+  assert {s["trace"] for s in spans} == {tid}, "lineage split across traces"
+  task_spans = [s for s in spans if s["name"] == "task"]
+  attempts = sorted(s.get("attempt") for s in task_spans)
+  assert attempts == [1, 2], f"expected the chaos retry: {attempts}"
+  names = {s["name"] for s in spans}
+  assert "queue.wait" in names
+  # staged pipeline stage spans inside the same trace
+  assert {"pipeline.download.s", "pipeline.compute.s",
+          "pipeline.upload_submit.s"} <= names, names
+
+  # the CLI surface: `igneous fleet trace <trace_id>` renders the tree,
+  # `fleet status` merges the whole journal
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main as cli_main
+
+  runner = CliRunner()
+  res = runner.invoke(cli_main, ["fleet", "trace", tid, "-q", spec])
+  assert res.exit_code == 0, res.output
+  assert "queue.wait" in res.output and "pipeline.download.s" in res.output
+  assert "attempt=2" in res.output
+  out_json = tmp_path / "lineage.json"
+  res = runner.invoke(cli_main, [
+    "fleet", "trace", tid, "-q", spec, "-o", str(out_json),
+  ])
+  assert res.exit_code == 0, res.output
+  assert json.loads(out_json.read_text())["traceEvents"]
+  res = runner.invoke(cli_main, ["fleet", "status", "-q", spec, "--json"])
+  assert res.exit_code == 0, res.output
+  st = json.loads(res.output)
+  assert st["workers"] == ["w-lineage"]
+  assert st["tasks"] >= 2 * len(tasks)  # both deliveries of every task

@@ -212,6 +212,7 @@ import os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import igneous_tpu.tasks  # register task classes
 from igneous_tpu import lifecycle
+from igneous_tpu.observability import journal as journal_mod
 from igneous_tpu.queues import FileQueue
 
 spec, lease_sec, task_delay, hb_sec, ready_path = (
@@ -221,6 +222,11 @@ spec, lease_sec, task_delay, hb_sec, ready_path = (
 flag = lifecycle.StopFlag()
 lifecycle.install_signal_handlers(flag)
 q = FileQueue(spec)
+# ISSUE 5 acceptance: each storm worker journals its spans; the SIGTERMed
+# one's drain flush must leave its last batch behind for the merge
+journal_mod.set_active(
+  journal_mod.Journal(journal_mod.journal_path_for(q, spec))
+)
 with open(ready_path, "w") as f:
   f.write(str(os.getpid()))
 q.poll(
@@ -236,7 +242,7 @@ sys.exit(lifecycle.EXIT_PREEMPTED if flag.is_set() else 0)
 """
 
 
-def run_preemption_storm(scratch, img, seed):
+def run_preemption_storm(scratch, img, seed, trace_out=None):
   """ISSUE 2 acceptance: SIGTERM/SIGKILL workers at seeded random points
   plus one stalled-then-resumed zombie; output byte-identical to a clean
   run, zero duplicate task completions in the tally."""
@@ -342,6 +348,33 @@ def run_preemption_storm(scratch, img, seed):
   diff = [k for k in clean if clean[k] != storm[k]]
   assert not diff, f"{len(diff)} objects differ byte-wise: {diff[:5]}"
 
+  # ISSUE 5 acceptance: journal segments survive the preemption storm
+  # (incl. the SIGTERMed worker's drain batch) and merge into one fleet
+  # view with every executed task's span
+  from igneous_tpu.observability import fleet, perfetto
+
+  jpath = f"file://{workdir}/q/journal"
+  records = fleet.load(jpath)
+  assert records, "no journal segments survived the storm"
+  journal_workers = {
+    r.get("worker") for r in records if r.get("kind") == "span"
+  }
+  assert journal_workers, "journal has no span records"
+  drain_batches = [
+    r for r in records
+    if r.get("kind") == "counters" and r.get("event") == "drain"
+  ]
+  # exit 83 means the SIGTERM landed mid-poll: its drain flush must have
+  # left a final batch (exit 0 = queue drained first; no drain batch due)
+  assert drain_batches or exit_codes[0] == 0, (
+    "SIGTERMed worker exited 83 but left no drain journal batch"
+  )
+  merged = fleet.status(records)
+  assert merged["tasks"] >= 1, merged
+  if trace_out:
+    n_events = perfetto.dump(records, trace_out)
+    assert n_events > 0, "perfetto export produced no events"
+
   return {
     "tasks": n_tasks,
     "clean_executed": n_clean,
@@ -350,6 +383,10 @@ def run_preemption_storm(scratch, img, seed):
     "zombie_delete_fenced": zombie_fences,
     "objects_compared": len(clean),
     "byte_identical": True,
+    "journal_segments": len({r.get("segment") for r in records}),
+    "journal_workers": sorted(w for w in journal_workers if w),
+    "journal_drain_batches": len(drain_batches),
+    "fleet_tasks_merged": merged["tasks"],
   }
 
 
@@ -365,6 +402,10 @@ def main():
                   default="faults",
                   help="faults: ISSUE 1 storage/queue fault storm; "
                        "preemption: ISSUE 2 worker kill storm + zombie")
+  ap.add_argument("--trace-out", default=None,
+                  help="write a Perfetto/Chrome trace JSON of the "
+                       "preemption storm's merged journal here (CI "
+                       "uploads it as a browsable artifact)")
   ap.add_argument("--pipeline", action="store_true",
                   help="run the soak with the staged execution pipeline "
                        "enabled (ISSUE 3): the CLEAN reference run stays "
@@ -382,7 +423,10 @@ def main():
     os.environ["IGNEOUS_PIPELINE"] = "1"
     os.environ["IGNEOUS_PIPELINE_THREADS"] = "1"
   scratch = tempfile.mkdtemp(prefix="chaos-soak-")
-  telemetry.reset_counters()
+  # full metric reset (counters AND timers/gauges/histograms): the soak
+  # report must only reflect this storm — reset_counters() alone no
+  # longer clears the float families (ISSUE 5 split)
+  telemetry.reset_all()
   t0 = time.monotonic()
   try:
     rng = np.random.default_rng(args.seed)
@@ -393,7 +437,9 @@ def main():
     if args.scenario in ("faults", "all"):
       report["faults"] = run_faults_scenario(scratch, img, args.seed)
     if args.scenario in ("preemption", "all"):
-      report["preemption"] = run_preemption_storm(scratch, img, args.seed)
+      report["preemption"] = run_preemption_storm(
+        scratch, img, args.seed, trace_out=args.trace_out
+      )
     report["counters"] = telemetry.counters_snapshot()
     report["wall_s"] = round(time.monotonic() - t0, 2)
     print(json.dumps(report, indent=2))
