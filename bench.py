@@ -349,7 +349,8 @@ def bench_trace_overhead(img, seg):
     for name, s in sorted(by_name.items())
   }
   return (
-    round(overhead_pct, 2) if overhead_pct is not None else None,
+    round(overhead_pct, 2) if overhead_pct is not None
+    else _skip("no successful traced/untraced rate pairs"),
     summary,
   )
 
@@ -477,8 +478,8 @@ def bench_codecs(img, seg):
     out["zstd_deflate_MBps"] = rate(len(u8raw), lambda: compress_bytes(u8raw, "zstd"))
     out["zstd_inflate_MBps"] = rate(len(u8raw), lambda: decompress_bytes(zs, "zstd"))
   except ImportError:
-    out["zstd_deflate_MBps"] = None
-    out["zstd_inflate_MBps"] = None
+    out["zstd_deflate_MBps"] = _skip("zstandard not installed")
+    out["zstd_inflate_MBps"] = _skip("zstandard not installed")
   return out
 
 
@@ -756,6 +757,14 @@ def bench_forge_pipelines():
   return round(seg.size / mesh_dt, 1), round(seg.size / skel_dt, 1)
 
 
+def _skip(reason: str) -> dict:
+  """Explicit not-run marker (ISSUE 6 satellite): a gated metric records
+  WHY it has no number, so the BENCH trajectory distinguishes "skipped
+  on this platform" from "measured zero" — a silent null poisoned the
+  pool_ab/ccl_relax history for five rounds."""
+  return {"skipped": reason}
+
+
 def run_bench(platform: str):
   if platform == "tpu":
     # Never report CPU numbers as TPU: a fast axon-init failure silently
@@ -799,7 +808,12 @@ def run_bench(platform: str):
   # on platform == "tpu", so the trajectory had no number to compare when
   # a TPU round finally lands
   ccl_relax_rate = bench_ccl_kernel("relax")
-  pool_ab = bench_pool_ab() if platform == "tpu" else None
+  if platform == "tpu":
+    pool_ab = bench_pool_ab()
+    if pool_ab is None:
+      pool_ab = _skip("pallas pooling unavailable on this device")
+  else:
+    pool_ab = _skip(f"tpu-only device A/B (platform={platform})")
   edt_rate = bench_edt_kernel()
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
   codec_tbl = bench_codecs(img, seg)
@@ -831,7 +845,12 @@ def run_bench(platform: str):
       "seg_shape": list(SEG_SHAPE),
       "device_kernel_voxps": round(dev_kernel, 1),
       "host_native_kernel_voxps": (
-        round(host_kernel, 1) if host_kernel is not None else None
+        round(host_kernel, 1) if host_kernel is not None
+        else _skip(
+          "tpu platform: device pyramid is the production path"
+          if platform == "tpu"
+          else "native pooling library unavailable on this host"
+        )
       ),
       # the baseline credits the reference with 8 cores; on a smaller
       # fallback host the per-core ratio is the informative comparison
@@ -859,7 +878,8 @@ def run_bench(platform: str):
       "stage_spans": stage_spans,
       "e2e_batched_voxps": round(e2e_batched, 1),
       "e2e_batched_device_voxps": (
-        round(e2e_batched_device, 1) if e2e_batched_device else None
+        round(e2e_batched_device, 1) if e2e_batched_device
+        else _skip("no device mesh/pool available for the batched path")
       ),
       "e2e_batched_path": batched_path,
       "transfer_MBps_up_down": [up, down],
@@ -868,7 +888,8 @@ def run_bench(platform: str):
       "skeleton_forge_csa_e2e_voxps": skel_forge_rate,
       "ccl_kernel_voxps": round(ccl_rate, 1),
       "ccl_relax_kernel_voxps": (
-        round(ccl_relax_rate, 1) if ccl_relax_rate is not None else None
+        round(ccl_relax_rate, 1) if ccl_relax_rate is not None
+        else _skip("relax kernel produced no measurement")
       ),
       # ISSUE 4: compressed-domain fast paths
       "codec_MBps": codec_tbl,
@@ -876,7 +897,8 @@ def run_bench(platform: str):
       "transfer_passthrough_voxps": xfer_passthrough,
       "transfer_decode_voxps": xfer_decode,
       "transfer_passthrough_speedup": (
-        round(xfer_passthrough / xfer_decode, 2) if xfer_decode else None
+        round(xfer_passthrough / xfer_decode, 2) if xfer_decode
+        else _skip("decode-path transfer rate unavailable")
       ),
       "edt_kernel_voxps": round(edt_rate, 1),
       "pool_ab": pool_ab,

@@ -12,7 +12,8 @@ every reference command and --option has a counterpart here):
             spatial-index {create,db}}
   execute | queue {status,wait,release,rezero,purge,cp,mv,fsck,
                    dlq {ls,retry,purge}}
-  fleet {status,trace,top} | design {ds-memory, ds-shape, bounds}
+  fleet {status,trace,top,compact,gc,check,watch}
+  design {ds-memory, ds-shape, bounds}
   view | license
 
 Heavy imports (jax, task modules) happen inside commands so --help and
@@ -1487,6 +1488,10 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   if jpath:
     journal_mod.set_active(journal_mod.Journal(jpath))
   journal_mod.install_last_will({"queue": queue_spec})
+  # worker-liveness gauge (ISSUE 6): present while this process answers
+  # scrapes; goes stale in Prometheus the moment the worker dies — the
+  # health plane's per-worker "up" signal
+  telemetry.gauge_set("worker.up", 1.0)
   bound_port = prom.start_http_server()
   if bound_port is not None and not quiet:
     click.echo(f"metrics: http://0.0.0.0:{bound_port}/metrics")
@@ -1784,8 +1789,8 @@ def fleet_group():
   aggregate them AFTER the fact — no live connection to any worker."""
 
 
-def _fleet_records(queue_spec, journal_path):
-  from .observability import fleet, journal as journal_mod
+def _journal_location(queue_spec, journal_path):
+  from .observability import journal as journal_mod
   from .queues import TaskQueue
 
   path = journal_path or os.environ.get("IGNEOUS_JOURNAL")
@@ -1796,10 +1801,38 @@ def _fleet_records(queue_spec, journal_path):
       "no journal location: pass --journal, set $IGNEOUS_JOURNAL, or give "
       "an fq:// queue spec (whose journal/ sidecar is implied)"
     )
-  records = fleet.load(path)
+  return path
+
+
+def _fleet_records(queue_spec, journal_path, effective=True):
+  """Journal records for the fleet commands. ``effective`` reads rollup
+  compactions + uncovered raw segments (O(windows) — status/top/check);
+  ``effective=False`` reads every raw segment (`fleet trace` needs the
+  per-span detail rollups summarize away). Byte-compatible: with no
+  rollups present the two views are identical."""
+  from .observability import fleet
+
+  path = _journal_location(queue_spec, journal_path)
+  records = fleet.load_effective(path) if effective else fleet.load(path)
   if not records:
     raise click.ClickException(f"no journal segments under {path}")
   return records
+
+
+def _queue_depth_stats(queue_spec):
+  """Best-effort depth snapshot for the health engine (None without a
+  queue spec — health still runs, minus backlog-driven detectors)."""
+  if not queue_spec:
+    return None
+  from .queues import TaskQueue
+
+  try:
+    tq = TaskQueue(queue_spec)
+  except Exception as e:
+    raise click.UsageError(f"cannot open queue {queue_spec}: {e}")
+  if hasattr(tq, "depth_snapshot"):
+    return tq.depth_snapshot()
+  return {"backlog": getattr(tq, "backlog", None) or tq.enqueued}
 
 
 def _journal_opts(fn):
@@ -1864,7 +1897,8 @@ def fleet_trace(trace_id, queue_spec, journal_path, out_path):
   from . import secrets
   from .observability import fleet, perfetto
 
-  records = _fleet_records(queue_spec or secrets.queue_url(), journal_path)
+  records = _fleet_records(queue_spec or secrets.queue_url(), journal_path,
+                           effective=False)
   spans = fleet.trace_records(records, trace_id)
   if not spans:
     raise click.ClickException(f"no spans recorded for trace {trace_id}")
@@ -1894,6 +1928,187 @@ def fleet_top(queue_spec, journal_path, top_n):
       f"{r['dur_s']:>8.3f}  {r['task']:<25} {str(r['attempt'] or '-'):>7}"
       f"  {r['trace_id']}  @{r['worker']}{err}"
     )
+
+
+@fleet_group.command("compact")
+@_journal_opts
+@click.option("--window-sec", "window", default=None, type=float,
+              help="Rollup window width [default: $IGNEOUS_ROLLUP_WINDOW_SEC "
+                   "or 60].")
+@click.option("--min-segments", default=2, show_default=True, type=int,
+              help="Skip when fewer uncovered raw segments exist.")
+def fleet_compact(queue_spec, journal_path, window, min_segments):
+  """Fold raw journal segments into windowed rollups (ISSUE 6).
+
+  After compaction, `fleet status|top|check|watch` and `queue status
+  --eta` read O(windows) instead of O(all segments), and the covered raw
+  segments become GC-able via `fleet gc`. Workers self-compact their own
+  segments every $IGNEOUS_ROLLUP_EVERY flushes; this command is the
+  admin/cron sweep for whatever they left behind."""
+  import json as json_mod
+
+  from . import secrets
+  from .observability import rollup
+
+  path = _journal_location(queue_spec or secrets.queue_url(), journal_path)
+  res = rollup.compact(path, window=window, min_segments=min_segments)
+  click.echo(json_mod.dumps(res))
+
+
+@fleet_group.command("gc")
+@_journal_opts
+@click.option("--retain-sec", default=None, type=float,
+              help="Keep covered raw segments at least this long "
+                   "[default: $IGNEOUS_JOURNAL_RETAIN or 3600]. This is "
+                   "the `fleet trace` debuggability horizon: rollups keep "
+                   "aggregates forever, per-span detail only lives in "
+                   "raw segments.")
+def fleet_gc(queue_spec, journal_path, retain_sec):
+  """Delete raw journal segments already folded into rollups."""
+  import json as json_mod
+
+  from . import secrets
+  from .observability import rollup
+
+  path = _journal_location(queue_spec or secrets.queue_url(), journal_path)
+  click.echo(json_mod.dumps(rollup.gc(path, retain=retain_sec)))
+
+
+def _health_opts(fn):
+  for opt in (
+    click.option("--window-sec", "window_sec", default=None, type=float,
+                 help="Analysis window [default: $IGNEOUS_HEALTH_WINDOW_SEC "
+                      "or 600]."),
+    click.option("--stall-sec", "stall_sec", default=None, type=float,
+                 help="Flag a worker whose journal went silent this long "
+                      "with backlog remaining [default: "
+                      "$IGNEOUS_HEALTH_STALL_SEC or 120]."),
+    click.option("--straggler-ratio", "straggler_ratio", default=None,
+                 type=float,
+                 help="Flag a worker at p95 >= ratio x fleet median "
+                      "[default: $IGNEOUS_HEALTH_STRAGGLER_RATIO or 3]."),
+    click.option("--horizon-sec", "horizon_sec", default=None, type=float,
+                 help="Autoscaler target: drain the backlog within this "
+                      "many seconds [default: $IGNEOUS_AUTOSCALE_HORIZON_SEC "
+                      "or 600]."),
+  ):
+    fn = opt(fn)
+  return fn
+
+
+def _evaluate_health(queue_spec, journal_path, window_sec, stall_sec,
+                     straggler_ratio, horizon_sec):
+  from .observability import fleet, health
+
+  path = _journal_location(queue_spec, journal_path)
+  records = fleet.load_effective(path)
+  if not records:
+    raise click.ClickException(f"no journal segments under {path}")
+  cfg = health.HealthConfig.from_env(
+    window_sec=window_sec, stall_sec=stall_sec,
+    straggler_ratio=straggler_ratio, horizon_sec=horizon_sec,
+  )
+  queue_stats = _queue_depth_stats(queue_spec)
+  report = health.HealthEngine(cfg).evaluate(records, queue_stats)
+  return path, report, queue_stats
+
+
+@fleet_group.command("check")
+@_journal_opts
+@_health_opts
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable.")
+@click.option("--out", "out_path", default=None,
+              help="Also write the full report JSON here (CI artifact).")
+@click.option("--emit-events/--no-emit-events", default=True,
+              show_default=True,
+              help="Append structured health.* events to the journal.")
+@click.option("--flags/--no-flags", "write_flags", default=True,
+              show_default=True,
+              help="Publish <journal>/health/flags.json so flagged "
+                   "workers surrender pre-leases (LeaseBatcher polls it).")
+@click.option("--textfile", default=None,
+              help="Write the Prometheus textfile (incl. "
+                   "igneous_fleet_desired_workers / igneous_slo_burn / "
+                   "igneous_fleet_stragglers) here for the node-exporter "
+                   "collector [default: $IGNEOUS_METRICS_TEXTFILE].")
+def fleet_check(queue_spec, journal_path, window_sec, stall_sec,
+                straggler_ratio, horizon_sec, as_json, out_path,
+                emit_events, write_flags, textfile):
+  """One health evaluation, exit-code-bearing (CI/cron gate).
+
+  Exit 0 = healthy; exit 2 = stragglers/anomalies/SLO burn detected —
+  the output names each one. Also publishes the autoscaler signal and
+  straggler flags unless told otherwise."""
+  import json as json_mod
+  import sys as sys_mod
+
+  from . import secrets
+  from .observability import health, journal as journal_mod, prom
+
+  path, report, _ = _evaluate_health(
+    queue_spec or secrets.queue_url(), journal_path,
+    window_sec, stall_sec, straggler_ratio, horizon_sec,
+  )
+  health.publish_gauges(report)
+  if textfile or os.environ.get(prom.TEXTFILE_ENV):
+    prom.write_textfile(textfile)
+  if emit_events:
+    health.emit_events(
+      report,
+      journal_mod.Journal(path, worker_id=health.default_checker_id()),
+    )
+  if write_flags:
+    health.write_flags(path, report)
+  if out_path:
+    with open(out_path, "w") as f:
+      f.write(health.report_json(report))
+  if as_json:
+    click.echo(health.report_json(report))
+  else:
+    for line in health.check_lines(report):
+      click.echo(line)
+  if not report["healthy"]:
+    sys_mod.exit(2)
+
+
+@fleet_group.command("watch")
+@_journal_opts
+@_health_opts
+@click.option("--interval", default=5.0, show_default=True,
+              help="Seconds between refreshes.")
+@click.option("--iterations", default=None, type=int,
+              help="Render N frames then exit [default: until Ctrl-C].")
+@click.option("--no-clear", is_flag=True,
+              help="Append frames instead of redrawing in place.")
+def fleet_watch(queue_spec, journal_path, window_sec, stall_sec,
+                straggler_ratio, horizon_sec, interval, iterations,
+                no_clear):
+  """Live fleet dashboard over the journal rollups: status, per-worker
+  table, stragglers, alerts, autoscale — refreshed in place."""
+  import time as time_mod
+
+  from . import secrets
+  from .observability import health
+
+  queue_spec = queue_spec or secrets.queue_url()
+  n = 0
+  while True:
+    try:
+      _path, report, queue_stats = _evaluate_health(
+        queue_spec, journal_path,
+        window_sec, stall_sec, straggler_ratio, horizon_sec,
+      )
+      lines = health.render_dashboard(report, queue_stats)
+    except click.ClickException as e:
+      lines = [f"fleet watch: {e.message} (waiting...)"]
+    if not no_clear:
+      click.echo("\x1b[2J\x1b[H", nl=False)
+    for line in lines:
+      click.echo(line)
+    n += 1
+    if iterations is not None and n >= iterations:
+      return
+    time_mod.sleep(max(interval, 0.0))
 
 
 @main.group()

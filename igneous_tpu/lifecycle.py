@@ -44,9 +44,13 @@ class StopFlag:
     # next maybe_flush writes the final span batch BEFORE the pod dies
     # (signal-handler safe: only sets an event, no IO here)
     try:
-      from .observability import journal
+      from .observability import journal, metrics
 
       journal.request_flush()
+      # the health plane distinguishes "draining" from "stalled": a
+      # draining worker's silence is expected, a stalled one's is not
+      # (lock-free write: this can run inside a signal handler)
+      metrics.gauge_set_async_safe("worker.draining", 1.0)
     except Exception:
       pass
 

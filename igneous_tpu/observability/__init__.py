@@ -10,20 +10,28 @@ The subsystem in one picture::
               storage ops, lease rounds) → per-thread span buffers
                                   │
               Journal.flush ──> <queue>/journal/*.jsonl segments
-                                  │
-         igneous fleet status|trace|top   Prometheus /metrics   Perfetto
+                                  │            │
+         igneous fleet status|trace|top        │ rollup.compact (ISSUE 6)
+         Prometheus /metrics        Perfetto   ▼
+                                    <journal>/rollup/ windowed records
+                                               │
+              HealthEngine (stragglers, anomalies, SLO burn, autoscale)
+                 │               │                    │
+         fleet check|watch   health.* events   health/flags.json
+         (exit codes, CI)    + Prom gauges     (LeaseBatcher backs off)
 
 ``igneous_tpu.telemetry`` remains as a compat shim over
 :mod:`.metrics`; new code should import from here.
 """
 
-from . import fleet, journal, perfetto, prom, trace
+from . import fleet, health, journal, perfetto, prom, rollup, trace
 from .metrics import (
   StageTimes,
   counters_snapshot,
   device_trace,
   emit_counters,
   gauge_max,
+  gauge_set,
   gauges_snapshot,
   histograms_snapshot,
   incr,
@@ -38,9 +46,9 @@ from .metrics import (
 )
 
 __all__ = [
-  "fleet", "journal", "perfetto", "prom", "trace",
+  "fleet", "health", "journal", "perfetto", "prom", "rollup", "trace",
   "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
-  "gauge_max", "gauges_snapshot", "histograms_snapshot", "incr", "observe",
-  "queue_eta", "reset_all", "reset_counters", "stage", "task_timing",
-  "timed_poll_hooks", "timers_snapshot",
+  "gauge_max", "gauge_set", "gauges_snapshot", "histograms_snapshot",
+  "incr", "observe", "queue_eta", "reset_all", "reset_counters", "stage",
+  "task_timing", "timed_poll_hooks", "timers_snapshot",
 ]

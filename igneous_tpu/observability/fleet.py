@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from collections import defaultdict
-from typing import Iterable, List, Optional
+from typing import Iterable, Iterator, List, Optional
 
 from . import journal as journal_mod
 
@@ -21,7 +21,18 @@ STALL_MARKERS = ("stall_s", "queue.wait")
 
 
 def load(journal_path: str) -> List[dict]:
+  """Every RAW record (all segments, rollup coverage ignored) — the
+  per-span detail path (`fleet trace`, Perfetto export)."""
   return list(journal_mod.read_records(journal_path))
+
+
+def load_effective(journal_path: str) -> List[dict]:
+  """Rollup records + raw records from uncovered segments — the
+  O(windows) aggregate path (`fleet status|top|check|watch`,
+  ``queue_eta``). Identical to :func:`load` when no rollups exist."""
+  from . import rollup
+
+  return rollup.load_effective(journal_path)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -37,15 +48,55 @@ def _is_stall(name: str) -> bool:
 
 def status(records: Iterable[dict]) -> dict:
   """Merged fleet aggregates: per-stage p50/p95/total, stall ratio,
-  counter totals (zombie/DLQ/retries), workers seen, task throughput."""
-  stage_durs: dict = defaultdict(list)
+  counter totals (zombie/DLQ/retries), workers seen, task throughput.
+
+  Accepts raw span/counters records AND ``rollup`` records (windowed
+  compactions) interchangeably: rollups carry exact per-stage count/sum
+  plus capped duration samples, so totals/counts match the raw view
+  exactly and percentiles match whenever the sample cap wasn't hit."""
+  # per stage: [count, sum, samples] — raw spans contribute 1/dur/dur,
+  # rollup stages contribute their exact count/sum + capped samples
+  stage_stats: dict = defaultdict(lambda: [0, 0.0, []])
   task_spans = []
   workers = set()
   counters_by_worker: dict = {}
   ts_min, ts_max = None, None
 
+  def _take_span_times(ts, dur):
+    nonlocal ts_min, ts_max
+    ts_min = ts if ts_min is None else min(ts_min, ts)
+    ts_max = max(ts_max or 0.0, ts + dur)
+
+  def _take_task(rec):
+    ts, dur = rec.get("ts"), rec.get("dur")
+    if ts is None or dur is None:
+      return
+    if rec.get("worker"):
+      workers.add(rec["worker"])
+    _take_span_times(ts, dur)
+    st = stage_stats["task"]
+    st[0] += 1
+    st[1] += float(dur)
+    st[2].append(float(dur))
+    task_spans.append(rec)
+
   for rec in records:
     kind = rec.get("kind")
+    if kind == "rollup":
+      if rec.get("ts_min") is not None:
+        _take_span_times(rec["ts_min"], 0.0)
+      if rec.get("ts_max") is not None:
+        _take_span_times(rec["ts_max"], 0.0)
+      for wid in (rec.get("workers") or {}):
+        workers.add(wid)
+      for name, s in (rec.get("stages") or {}).items():
+        st = stage_stats[name]
+        st[0] += int(s.get("count", 0))
+        st[1] += float(s.get("sum", 0.0))
+        st[2].extend(float(d) for d in s.get("durs", ()))
+      for t in rec.get("tasks") or ():
+        _take_task(t)
+      continue
     worker = rec.get("worker", "local")
     workers.add(worker)
     if kind == "counters":
@@ -59,23 +110,29 @@ def status(records: Iterable[dict]) -> dict:
     ts, dur = rec.get("ts"), rec.get("dur")
     if ts is None or dur is None:
       continue
-    ts_min = ts if ts_min is None else min(ts_min, ts)
-    ts_max = max(ts_max or 0.0, ts + dur)
     name = rec.get("name", "span")
-    stage_durs[name].append(float(dur))
     if name == "task":
-      task_spans.append(rec)
+      _take_task(rec)
+      continue
+    _take_span_times(ts, dur)
+    st = stage_stats[name]
+    st[0] += 1
+    st[1] += float(dur)
+    st[2].append(float(dur))
 
   stages = {}
   stall_total = work_total = 0.0
-  for name, durs in stage_durs.items():
-    durs.sort()
-    total = sum(durs)
+  for name, (count, total, samples) in stage_stats.items():
+    samples.sort()
+    if count == len(samples):
+      # no sample cap bit: recompute from the sorted list so the output
+      # is bit-identical whether the spans arrived raw or via rollups
+      total = sum(samples)
     stages[name] = {
-      "count": len(durs),
+      "count": count,
       "total_s": round(total, 3),
-      "p50_ms": round(_percentile(durs, 0.50) * 1e3, 2),
-      "p95_ms": round(_percentile(durs, 0.95) * 1e3, 2),
+      "p50_ms": round(_percentile(samples, 0.50) * 1e3, 2),
+      "p95_ms": round(_percentile(samples, 0.95) * 1e3, 2),
     }
     if _is_stall(name):
       stall_total += total
@@ -109,13 +166,21 @@ def status(records: Iterable[dict]) -> dict:
   }
 
 
+def iter_task_spans(records: Iterable[dict]) -> Iterator[dict]:
+  """Task span records from raw segments AND rollup windows (rollups
+  keep task spans verbatim, so both views yield identical records)."""
+  for r in records:
+    kind = r.get("kind")
+    if kind == "rollup":
+      for t in r.get("tasks") or ():
+        yield t
+    elif kind == "span" and r.get("name") == "task":
+      yield r
+
+
 def slowest_tasks(records: Iterable[dict], n: int = 10) -> List[dict]:
   """``igneous fleet top``: the n slowest task executions, by trace."""
-  tasks = [
-    r for r in records
-    if r.get("kind") == "span" and r.get("name") == "task"
-    and r.get("dur") is not None
-  ]
+  tasks = [r for r in iter_task_spans(records) if r.get("dur") is not None]
   tasks.sort(key=lambda r: -r["dur"])
   out = []
   for rec in tasks[:n]:
@@ -174,29 +239,40 @@ def render_trace(spans: List[dict]) -> List[str]:
   return lines
 
 
-def journal_throughput(journal_path: str,
-                       window_sec: float = 600.0) -> Optional[dict]:
+# a segment timestamped further than this into the future is a skewed
+# worker clock, not data: counting it would stretch the throughput
+# window to a time that hasn't happened yet
+CLOCK_SKEW_TOLERANCE_SEC = 300.0
+
+
+def journal_throughput(journal_path: str, window_sec: float = 600.0,
+                       now: Optional[float] = None) -> Optional[dict]:
   """Fleet tasks/sec derived from recent journal task spans (the
-  ``queue status --eta`` journal path). None when no segments or no task
-  spans exist — callers fall back to live sampling."""
-  now = time.time()
+  ``queue status --eta`` journal path), reading rollups + uncovered raw
+  segments (O(windows), not O(all segments)). None when no segments
+  exist, when no task span falls inside the window (empty or expired —
+  the fleet stopped more than ``window_sec`` ago), or when every
+  in-window span is clock-skewed into the future — callers fall back to
+  live sampling in each case."""
+  now = time.time() if now is None else now
   durs = []
   ts_min = ts_max = None
-  found = False
-  for rec in journal_mod.read_records(journal_path):
-    found = True
-    if rec.get("kind") != "span" or rec.get("name") != "task":
-      continue
+  records = load_effective(journal_path)
+  if not records:
+    return None
+  for rec in iter_task_spans(records):
     if rec.get("error"):
       continue
     ts = rec.get("ts")
     if ts is None or ts < now - window_sec:
-      continue
+      continue  # expired: finished before the window opened
+    if ts > now + CLOCK_SKEW_TOLERANCE_SEC:
+      continue  # skewed worker clock: a "future" task proves nothing
     durs.append(rec)
     end = ts + (rec.get("dur") or 0.0)
     ts_min = ts if ts_min is None else min(ts_min, ts)
     ts_max = end if ts_max is None else max(ts_max, end)
-  if not found or not durs or ts_max is None or ts_max <= ts_min:
+  if not durs or ts_max is None or ts_max <= ts_min:
     return None
   window = ts_max - ts_min
   return {

@@ -1,0 +1,589 @@
+"""Closed-loop fleet health: detectors, SLO burn, autoscaler signal.
+
+PR 5 made the fleet visible; this module makes the telemetry
+*actionable*. A :class:`HealthEngine` evaluates journal rollups + live
+segments (``rollup.load_effective``) against a queue depth snapshot and
+produces one structured report:
+
+* **stragglers** — workers whose p95 task latency is a configurable
+  multiple of the fleet median, and workers whose journal went silent
+  (no flush for ``stall_sec``) while the queue still has backlog — the
+  stalled workers chaos soaks deliberately inject;
+* **anomalies** — DLQ/retry/zombie rates out of band, stall-ratio
+  regressions, and a fully stalled journal (every writer silent with
+  work remaining: the dead-journal-writer alert);
+* **SLO burn** — task success rate (and optionally p95 latency) against
+  a target, expressed as error-budget burn rate;
+* **autoscale** — a desired-worker recommendation from backlog vs
+  journal-derived per-worker throughput, hysteresis-damped so an HPA or
+  cron consuming it doesn't flap.
+
+The report fans out to every consumer the loop needs: Prometheus gauges
+(``igneous_fleet_stragglers``, ``igneous_fleet_desired_workers``,
+``igneous_slo_burn``) via :func:`publish_gauges`, structured ``health.*``
+events appended to the journal via :func:`emit_events`, a
+``health/flags.json`` straggler report that LeaseBatcher polls to
+surrender pre-leases early, an exit-code-bearing ``igneous fleet check``
+for CI/cron, and the live ``igneous fleet watch`` dashboard rendered by
+:func:`render_dashboard`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import socket
+import time
+from collections import defaultdict
+from dataclasses import dataclass, fields
+from typing import Iterable, List, Optional
+
+from . import fleet, metrics
+
+FLAGS_KEY = "health/flags.json"
+
+
+def _env_float(name: str, default):
+  raw = os.environ.get(name)
+  if raw is None or raw == "":
+    return default
+  try:
+    return float(raw)
+  except ValueError:
+    return default
+
+
+@dataclass
+class HealthConfig:
+  """Detector thresholds; every field has an ``IGNEOUS_*`` env override
+  (see :meth:`from_env`) so deployments tune without code."""
+
+  # analysis window for latency/throughput/SLO (seconds of recent history)
+  window_sec: float = 600.0
+  # latency straggler: worker p95 >= ratio x fleet median, given at least
+  # min_tasks samples on both sides
+  straggler_ratio: float = 3.0
+  straggler_min_tasks: int = 3
+  # liveness straggler: no journal record from the worker for this long
+  # while the queue still has backlog (clean drain/exit records exempt)
+  stall_sec: float = 120.0
+  # workers silent longer than this are forgotten entirely (a pod
+  # replaced hours ago is history, not a straggler)
+  forget_sec: float = 3600.0
+  # anomaly rate ceilings, as fractions of observed task executions
+  dlq_rate_max: float = 0.05
+  retry_rate_max: float = 1.0
+  zombie_rate_max: float = 0.5
+  stall_ratio_max: float = 0.9
+  # SLO: task success-rate target and optional p95 latency target
+  slo_success: float = 0.99
+  slo_p95_ms: Optional[float] = None
+  # autoscaler: drain the backlog within horizon_sec at the observed
+  # per-worker rate; recommendations within the hysteresis band of the
+  # current worker count collapse to "no change"
+  horizon_sec: float = 600.0
+  hysteresis: float = 0.2
+  min_workers: int = 1
+  max_workers: int = 1000
+
+  _ENV = {
+    "window_sec": "IGNEOUS_HEALTH_WINDOW_SEC",
+    "straggler_ratio": "IGNEOUS_HEALTH_STRAGGLER_RATIO",
+    "straggler_min_tasks": "IGNEOUS_HEALTH_STRAGGLER_MIN_TASKS",
+    "stall_sec": "IGNEOUS_HEALTH_STALL_SEC",
+    "forget_sec": "IGNEOUS_HEALTH_FORGET_SEC",
+    "dlq_rate_max": "IGNEOUS_HEALTH_DLQ_RATE",
+    "retry_rate_max": "IGNEOUS_HEALTH_RETRY_RATE",
+    "zombie_rate_max": "IGNEOUS_HEALTH_ZOMBIE_RATE",
+    "stall_ratio_max": "IGNEOUS_HEALTH_STALL_RATIO",
+    "slo_success": "IGNEOUS_SLO_SUCCESS",
+    "slo_p95_ms": "IGNEOUS_SLO_P95_MS",
+    "horizon_sec": "IGNEOUS_AUTOSCALE_HORIZON_SEC",
+    "hysteresis": "IGNEOUS_AUTOSCALE_HYSTERESIS",
+    "min_workers": "IGNEOUS_AUTOSCALE_MIN",
+    "max_workers": "IGNEOUS_AUTOSCALE_MAX",
+  }
+
+  @classmethod
+  def from_env(cls, **overrides) -> "HealthConfig":
+    """Env-derived config; keyword overrides (CLI flags) win. ``None``
+    overrides mean "not given" and fall through to env/default."""
+    kw = {}
+    for f in fields(cls):
+      if f.name.startswith("_"):
+        continue
+      env_name = cls._ENV.get(f.name)
+      val = overrides.get(f.name)
+      if val is None and env_name:
+        val = _env_float(env_name, None)
+      if val is not None:
+        if f.type in ("int",):
+          val = int(val)
+        kw[f.name] = val
+    cfg = cls(**kw)
+    cfg.straggler_min_tasks = int(cfg.straggler_min_tasks)
+    cfg.min_workers = int(cfg.min_workers)
+    cfg.max_workers = int(cfg.max_workers)
+    return cfg
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+  if not sorted_vals:
+    return 0.0
+  idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+  return sorted_vals[idx]
+
+
+class HealthEngine:
+  """Evaluates journal-derived records into one health report dict."""
+
+  def __init__(self, config: Optional[HealthConfig] = None):
+    self.config = config or HealthConfig.from_env()
+
+  # -- record scan ----------------------------------------------------------
+
+  def _scan(self, records: Iterable[dict], now: float) -> dict:
+    cfg = self.config
+    per = {}  # worker -> view
+
+    def view(worker: str) -> dict:
+      v = per.get(worker)
+      if v is None:
+        v = per[worker] = {
+          "last_seen": 0.0, "clean_exit": False,
+          "task_durs": [], "tasks_failed": 0,
+          "task_starts": [], "task_ends": [],
+        }
+      return v
+
+    counters_by_worker: dict = {}
+    stall_total = work_total = 0.0
+
+    def seen(worker, ts):
+      # "health-*" actors are check/cron processes appending health.*
+      # events, not fleet workers — never liveness targets
+      if worker and ts and not worker.startswith("health-"):
+        v = view(worker)
+        v["last_seen"] = max(v["last_seen"], float(ts))
+
+    def take_task(rec):
+      worker = rec.get("worker", "local")
+      ts, dur = rec.get("ts"), rec.get("dur")
+      if ts is None or dur is None:
+        return
+      end = float(ts) + float(dur)
+      seen(worker, end)
+      if end < now - cfg.window_sec or float(ts) > now + fleet.CLOCK_SKEW_TOLERANCE_SEC:
+        return
+      v = view(worker)
+      if rec.get("error"):
+        v["tasks_failed"] += 1
+      else:
+        v["task_durs"].append(float(dur))
+        v["task_starts"].append(float(ts))
+        v["task_ends"].append(end)
+
+    def take_stage(name, total):
+      # unlike fleet.status's informational ratio, this one feeds an
+      # exit-code-bearing anomaly — so "queue.wait" (time tasks sat
+      # ENQUEUED: that's backlog, the autoscaler's job) must not count
+      # as stall, or every backlogged-but-healthy fleet alerts. Only
+      # worker-side pipeline stalls (buffer starvation) are regressions.
+      nonlocal stall_total, work_total
+      if "queue.wait" in name:
+        return
+      if any(m in name for m in fleet.STALL_MARKERS):
+        stall_total += total
+      elif name != "task" and not name.startswith("health."):
+        work_total += total
+
+    for rec in records:
+      kind = rec.get("kind")
+      if kind == "rollup":
+        for wid, last in (rec.get("workers") or {}).items():
+          seen(wid, last)
+        for name, s in (rec.get("stages") or {}).items():
+          take_stage(name, float(s.get("sum", 0.0)))
+        for t in rec.get("tasks") or ():
+          take_task(t)
+      elif kind == "counters":
+        worker = rec.get("worker", "local")
+        seen(worker, rec.get("ts"))
+        prev = counters_by_worker.get(worker)
+        if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+          counters_by_worker[worker] = rec
+        if rec.get("event") in ("drain", "exit"):
+          view(worker)["clean_exit"] = True
+      elif kind == "span":
+        worker = rec.get("worker", "local")
+        ts, dur = rec.get("ts"), rec.get("dur")
+        if ts is None or dur is None:
+          continue
+        if rec.get("name") == "task":
+          take_task(rec)
+        else:
+          seen(worker, float(ts) + float(dur))
+          take_stage(rec.get("name", "span"), float(dur))
+
+    # a worker silent past forget_sec is history, not a detector target
+    per = {
+      w: v for w, v in per.items()
+      if v["last_seen"] >= now - self.config.forget_sec
+    }
+    counters: dict = defaultdict(int)
+    for rec in counters_by_worker.values():
+      for k, val in (rec.get("counters") or {}).items():
+        counters[k] += val
+    return {
+      "per_worker": per,
+      "counters": dict(counters),
+      "stall_total": stall_total,
+      "work_total": work_total,
+    }
+
+  # -- evaluation -----------------------------------------------------------
+
+  def evaluate(self, records: Iterable[dict],
+               queue_stats: Optional[dict] = None,
+               now: Optional[float] = None) -> dict:
+    cfg = self.config
+    now = time.time() if now is None else now
+    scan = self._scan(records, now)
+    per = scan["per_worker"]
+    counters = scan["counters"]
+    backlog = int((queue_stats or {}).get("backlog") or 0)
+
+    all_durs = sorted(d for v in per.values() for d in v["task_durs"])
+    tasks_ok = len(all_durs)
+    tasks_failed = sum(v["tasks_failed"] for v in per.values())
+    tasks_total = tasks_ok + tasks_failed
+    fleet_median = _percentile(all_durs, 0.50)
+    fleet_p95 = _percentile(all_durs, 0.95)
+
+    # throughput over the observed in-window task extent
+    starts = [t for v in per.values() for t in v["task_starts"]]
+    ends = [t for v in per.values() for t in v["task_ends"]]
+    elapsed = max(max(ends) - min(starts), 1.0) if starts else 0.0
+    tasks_per_sec = (tasks_ok / elapsed) if elapsed > 0 else 0.0
+
+    stragglers = []
+    for worker in sorted(per):
+      v = per[worker]
+      durs = sorted(v["task_durs"])
+      if (
+        len(durs) >= cfg.straggler_min_tasks
+        and len(all_durs) >= cfg.straggler_min_tasks
+        and fleet_median > 0
+      ):
+        p95 = _percentile(durs, 0.95)
+        if p95 >= cfg.straggler_ratio * fleet_median:
+          stragglers.append({
+            "worker": worker, "kind": "latency",
+            "p95_ms": round(p95 * 1e3, 1),
+            "fleet_median_ms": round(fleet_median * 1e3, 1),
+            "ratio": round(p95 / fleet_median, 2),
+            "tasks": len(durs),
+          })
+          continue
+      age = now - v["last_seen"]
+      if backlog > 0 and not v["clean_exit"] and age >= cfg.stall_sec:
+        stragglers.append({
+          "worker": worker, "kind": "stalled",
+          "last_seen_age_sec": round(age, 1),
+          "stall_sec": cfg.stall_sec,
+        })
+
+    anomalies = []
+    denom = max(tasks_total, 1)
+    dlq = counters.get("dlq.promoted", 0)
+    if dlq and dlq / denom > cfg.dlq_rate_max:
+      anomalies.append({
+        "kind": "dlq_rate", "dlq_promoted": dlq,
+        "rate": round(dlq / denom, 3), "max": cfg.dlq_rate_max,
+      })
+    retries = sum(v for k, v in counters.items() if k.startswith("retries."))
+    if retries and retries / denom > cfg.retry_rate_max:
+      anomalies.append({
+        "kind": "retry_rate", "retries": retries,
+        "rate": round(retries / denom, 3), "max": cfg.retry_rate_max,
+      })
+    zombies = sum(v for k, v in counters.items() if k.startswith("zombie."))
+    if zombies and zombies / denom > cfg.zombie_rate_max:
+      anomalies.append({
+        "kind": "zombie_rate", "zombie_fences": zombies,
+        "rate": round(zombies / denom, 3), "max": cfg.zombie_rate_max,
+      })
+    stall_total, work_total = scan["stall_total"], scan["work_total"]
+    stall_ratio = (
+      stall_total / (stall_total + work_total)
+      if stall_total + work_total > 0 else None
+    )
+    if stall_ratio is not None and stall_ratio > cfg.stall_ratio_max:
+      anomalies.append({
+        "kind": "stall_ratio", "stall_ratio": round(stall_ratio, 3),
+        "max": cfg.stall_ratio_max,
+      })
+    if per and backlog > 0 and all(
+      now - v["last_seen"] >= cfg.stall_sec and not v["clean_exit"]
+      for v in per.values()
+    ):
+      # every journal writer silent with work remaining: the journal
+      # itself (or the whole fleet) is dead — alert even though no
+      # single worker stands out
+      anomalies.append({
+        "kind": "journal_stalled",
+        "workers": len(per), "backlog": backlog,
+        "stall_sec": cfg.stall_sec,
+      })
+
+    # SLO burn: error-budget consumption rate (1.0 = burning exactly at
+    # budget; >1 = on track to violate the SLO)
+    success_rate = (tasks_ok / tasks_total) if tasks_total else None
+    err_budget = max(1.0 - cfg.slo_success, 1e-9)
+    burn = 0.0
+    if success_rate is not None:
+      burn = (1.0 - success_rate) / err_budget
+    if cfg.slo_p95_ms and fleet_p95 > 0:
+      burn = max(burn, (fleet_p95 * 1e3) / cfg.slo_p95_ms)
+    burn = round(burn, 3)
+
+    # autoscale: workers active now vs workers needed to drain the
+    # backlog within the horizon at the observed per-worker rate
+    active = [
+      w for w, v in per.items()
+      if not v["clean_exit"] and now - v["last_seen"] < cfg.stall_sec
+    ]
+    contributing = [w for w, v in per.items() if v["task_durs"]]
+    current = len(active)
+    per_worker_rate = tasks_per_sec / max(len(contributing), 1)
+    if backlog <= 0:
+      desired = cfg.min_workers
+    elif per_worker_rate <= 0:
+      desired = max(current, cfg.min_workers)
+    else:
+      desired = int(math.ceil(backlog / (per_worker_rate * cfg.horizon_sec)))
+    desired = max(cfg.min_workers, min(cfg.max_workers, desired))
+    damped = False
+    if (
+      backlog > 0 and current > 0
+      and abs(desired - current) / current <= cfg.hysteresis
+    ):
+      desired, damped = current, True
+
+    workers_report = {
+      w: {
+        "tasks": len(v["task_durs"]),
+        "tasks_failed": v["tasks_failed"],
+        "p95_ms": round(_percentile(sorted(v["task_durs"]), 0.95) * 1e3, 1),
+        "last_seen_age_sec": round(now - v["last_seen"], 1),
+        "clean_exit": v["clean_exit"],
+      }
+      for w, v in sorted(per.items())
+    }
+    flagged = sorted({s["worker"] for s in stragglers})
+    report = {
+      "ts": now,
+      "window_sec": cfg.window_sec,
+      "healthy": not stragglers and not anomalies and burn <= 1.0,
+      "stragglers": stragglers,
+      "anomalies": anomalies,
+      "flagged_workers": flagged,
+      "fleet": {
+        "workers_seen": len(per),
+        "workers_active": current,
+        "tasks": tasks_total,
+        "tasks_failed": tasks_failed,
+        "tasks_per_sec": round(tasks_per_sec, 3),
+        "median_task_ms": round(fleet_median * 1e3, 1),
+        "p95_task_ms": round(fleet_p95 * 1e3, 1),
+        "stall_ratio": (
+          round(stall_ratio, 3) if stall_ratio is not None else None
+        ),
+      },
+      "slo": {
+        "success_rate": (
+          round(success_rate, 4) if success_rate is not None else None
+        ),
+        "target": cfg.slo_success,
+        "p95_target_ms": cfg.slo_p95_ms,
+        "burn": burn,
+      },
+      "autoscale": {
+        "backlog": backlog,
+        "current_workers": current,
+        "desired_workers": desired,
+        "per_worker_tasks_per_sec": round(per_worker_rate, 3),
+        "horizon_sec": cfg.horizon_sec,
+        "hysteresis_damped": damped,
+      },
+      "workers": workers_report,
+    }
+    return report
+
+
+# -- consumers ----------------------------------------------------------------
+
+
+def publish_gauges(report: dict) -> None:
+  """Report → Prometheus gauges (rendered by observability.prom):
+  ``igneous_fleet_stragglers``, ``igneous_fleet_desired_workers``,
+  ``igneous_fleet_backlog``, ``igneous_slo_burn``,
+  ``igneous_fleet_anomalies``."""
+  metrics.gauge_set("fleet.stragglers", len(report["stragglers"]))
+  metrics.gauge_set("fleet.anomalies", len(report["anomalies"]))
+  metrics.gauge_set("fleet.desired_workers",
+                    report["autoscale"]["desired_workers"])
+  metrics.gauge_set("fleet.backlog", report["autoscale"]["backlog"])
+  metrics.gauge_set("slo.burn", report["slo"]["burn"])
+
+
+def health_events(report: dict) -> List[dict]:
+  """Structured ``health.*`` journal records for one report (zero-dur
+  span records, so ``fleet status|trace`` surface them natively)."""
+  now = report["ts"]
+  events = []
+
+  def ev(name, **attrs):
+    events.append({
+      "kind": "span", "name": name, "ts": now, "dur": 0.0, **attrs,
+    })
+
+  for s in report["stragglers"]:
+    ev("health.straggler", flagged=s["worker"], straggler_kind=s["kind"],
+       detail={k: v for k, v in s.items() if k not in ("worker", "kind")})
+  for a in report["anomalies"]:
+    ev("health.anomaly", anomaly_kind=a["kind"],
+       detail={k: v for k, v in a.items() if k != "kind"})
+  if report["slo"]["burn"] > 1.0:
+    ev("health.slo_burn", burn=report["slo"]["burn"],
+       success_rate=report["slo"]["success_rate"],
+       target=report["slo"]["target"])
+  ev("health.autoscale", **report["autoscale"])
+  return events
+
+
+def emit_events(report: dict, journal) -> Optional[str]:
+  """Append the report's ``health.*`` events to the journal as one
+  segment (``journal`` is an ``observability.journal.Journal``)."""
+  return journal.write_records(health_events(report), event="health")
+
+
+def write_flags(cloudpath: str, report: dict) -> None:
+  """Publish the straggler report where workers can see it
+  (``<journal>/health/flags.json``): LeaseBatcher polls this and a
+  flagged worker stops pre-leasing round i+1 — it surrenders queue
+  depth to healthy workers instead of hoarding leases it will be slow
+  to serve."""
+  from ..storage import CloudFiles
+
+  CloudFiles(cloudpath).put_json(FLAGS_KEY, {
+    "ts": report["ts"],
+    "stragglers": report["flagged_workers"],
+    "desired_workers": report["autoscale"]["desired_workers"],
+  })
+
+
+def flagged_workers(cloudpath: str, max_age_sec: float = 600.0) -> set:
+  """Workers the last health evaluation flagged (empty when no flags
+  file exists or it is older than ``max_age_sec`` — stale verdicts must
+  not dampen a worker forever)."""
+  from ..storage import CloudFiles
+
+  try:
+    flags = CloudFiles(cloudpath).get_json(FLAGS_KEY)
+  except Exception:
+    return set()
+  if not flags:
+    return set()
+  if time.time() - float(flags.get("ts") or 0) > max_age_sec:
+    return set()
+  return set(flags.get("stragglers") or ())
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def check_lines(report: dict) -> List[str]:
+  """Human summary for ``igneous fleet check`` (and each ``watch``
+  frame): verdict first, then every straggler/anomaly by name."""
+  f, a = report["fleet"], report["autoscale"]
+  lines = [
+    ("HEALTHY" if report["healthy"] else "UNHEALTHY")
+    + f" — {f['workers_active']} active / {f['workers_seen']} seen workers, "
+      f"{f['tasks']} tasks in window ({f['tasks_failed']} failed)",
+    f"throughput: {f['tasks_per_sec']} tasks/s  "
+    f"p50 {f['median_task_ms']}ms p95 {f['p95_task_ms']}ms"
+    + (f"  stall {f['stall_ratio']}" if f["stall_ratio"] is not None else ""),
+    f"slo: success {report['slo']['success_rate']} "
+    f"(target {report['slo']['target']}) burn {report['slo']['burn']}",
+    f"autoscale: current {a['current_workers']} -> desired "
+    f"{a['desired_workers']} (backlog {a['backlog']}, "
+    f"{a['per_worker_tasks_per_sec']} tasks/s/worker"
+    + (", damped)" if a["hysteresis_damped"] else ")"),
+  ]
+  for s in report["stragglers"]:
+    if s["kind"] == "stalled":
+      lines.append(
+        f"STRAGGLER {s['worker']}: stalled — no journal record for "
+        f"{s['last_seen_age_sec']}s (threshold {s['stall_sec']}s)"
+      )
+    else:
+      lines.append(
+        f"STRAGGLER {s['worker']}: p95 {s['p95_ms']}ms = "
+        f"{s['ratio']}x fleet median {s['fleet_median_ms']}ms"
+      )
+  for an in report["anomalies"]:
+    detail = " ".join(
+      f"{k}={v}" for k, v in an.items() if k != "kind"
+    )
+    lines.append(f"ANOMALY {an['kind']}: {detail}")
+  return lines
+
+
+def render_dashboard(report: dict, queue_stats: Optional[dict] = None,
+                     title: str = "igneous fleet") -> List[str]:
+  """One ``fleet watch`` frame: status header, per-worker table,
+  alerts, autoscale line."""
+  ts = time.strftime("%H:%M:%S", time.localtime(report["ts"]))
+  lines = [f"{title} — {ts}  (window {int(report['window_sec'])}s)"]
+  if queue_stats:
+    q = queue_stats
+    lines.append(
+      "queue: "
+      + "  ".join(
+        f"{k} {q[k]}" for k in
+        ("backlog", "leased", "completed", "dlq", "stale_leases")
+        if q.get(k) is not None
+      )
+    )
+  lines.extend(check_lines(report)[:4])
+  lines.append("")
+  lines.append(f"{'worker':<28}{'tasks':>6}{'fail':>6}{'p95_ms':>9}"
+               f"{'seen_ago':>10}  state")
+  flagged = set(report["flagged_workers"])
+  for w, v in report["workers"].items():
+    if v["clean_exit"]:
+      state = "drained"
+    elif w in flagged:
+      state = "STRAGGLER"
+    else:
+      state = "ok"
+    lines.append(
+      f"{w:<28}{v['tasks']:>6}{v['tasks_failed']:>6}{v['p95_ms']:>9}"
+      f"{v['last_seen_age_sec']:>9.1f}s  {state}"
+    )
+  alerts = check_lines(report)[4:]
+  if alerts:
+    lines.append("")
+    lines.extend(alerts)
+  return lines
+
+
+def default_checker_id() -> str:
+  host = socket.gethostname().split(".")[0] or "health"
+  return f"health-{host}-{os.getpid()}"
+
+
+def report_json(report: dict) -> str:
+  return json.dumps(report, indent=2, sort_keys=False)

@@ -28,7 +28,7 @@ import os
 import socket
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterable, Iterator, List, Optional
 
 from . import metrics, trace
 
@@ -80,6 +80,17 @@ class Journal:
     self._last_flush = time.monotonic()
     self._dirty = threading.Event()  # drain requested: flush ASAP
     self.segments_written = 0
+    # register the self-health keys so the Prometheus exposition carries
+    # igneous_journal_segments_total/..._flush_failed_total from the
+    # moment a journal exists — a writer that NEVER lands a segment is
+    # exactly the dead-journal case the fleet health plane must see
+    metrics.incr("journal.segments", 0)
+    metrics.incr("journal.flush_failed", 0)
+
+  def last_flush_age(self) -> float:
+    """Seconds since the last flush attempt (Prometheus self-health:
+    ``igneous_journal_last_flush_age_seconds``)."""
+    return time.monotonic() - self._last_flush
 
   # -- write side -----------------------------------------------------------
 
@@ -138,7 +149,44 @@ class Journal:
       return False
     self.segments_written += 1
     metrics.incr("journal.segments")
+    # rollup maintenance rides the flush cadence: every N segments the
+    # worker folds its OWN raw segments (worker-unique names, so no
+    # coordination) into <journal>/rollup/ — `fleet status` stays
+    # O(windows) even on long campaigns
+    from . import rollup
+
+    rollup.maybe_self_compact(self)
     return True
+
+  def write_records(self, records: Iterable[dict],
+                    event: Optional[str] = None) -> Optional[str]:
+    """Write one segment holding ``records`` verbatim (plus worker/kind
+    defaults) — the health engine's emission path for ``health.*``
+    events. Returns the segment name, or None when the put failed."""
+    with self._lock:
+      lines = []
+      for rec in records:
+        rec = dict(rec)
+        rec.setdefault("kind", "span")
+        rec.setdefault("worker", self.worker_id)
+        if event is not None:
+          rec.setdefault("event", event)
+        lines.append(json.dumps(rec))
+      if not lines:
+        return None
+      name = f"{self.worker_id}-{self._seq:06d}.jsonl"
+      self._seq += 1
+      data = ("\n".join(lines) + "\n").encode("utf8")
+    try:
+      from ..storage import CloudFiles
+
+      CloudFiles(self.cloudpath).put(name, data, compress=None)
+    except Exception:
+      metrics.incr("journal.flush_failed")
+      return None
+    self.segments_written += 1
+    metrics.incr("journal.segments")
+    return name
 
 
 # -- process-wide active journal ---------------------------------------------
@@ -212,16 +260,34 @@ def disarm_last_will(flush: bool = True) -> None:
 # -- read side ----------------------------------------------------------------
 
 
-def read_records(cloudpath: str) -> Iterator[dict]:
-  """Iterate every record of every segment under a journal path (order:
-  segment name, then line order — i.e. per-worker chronological)."""
+def is_raw_segment(key: str) -> bool:
+  """Top-level ``*.jsonl`` objects are raw worker segments; everything
+  in a subdirectory (``rollup/`` compactions, ``health/`` flag files)
+  belongs to other subsystems and must not merge as span records."""
+  return "/" not in key and key.endswith(".jsonl")
+
+
+def list_segments(cloudpath: str) -> List[str]:
+  """Sorted raw segment names under a journal path."""
+  from ..storage import CloudFiles
+
+  try:
+    return sorted(k for k in CloudFiles(cloudpath).list() if is_raw_segment(k))
+  except Exception:
+    return []
+
+
+def read_records(cloudpath: str,
+                 keys: Optional[Iterable[str]] = None) -> Iterator[dict]:
+  """Iterate every record of every raw segment under a journal path
+  (order: segment name, then line order — i.e. per-worker
+  chronological). ``keys`` restricts to specific segments (the rollup
+  merge path reads only uncovered ones)."""
   from ..storage import CloudFiles
 
   cf = CloudFiles(cloudpath)
-  try:
-    keys = sorted(cf.list())
-  except Exception:
-    return
+  if keys is None:
+    keys = list_segments(cloudpath)
   for key in keys:
     data = cf.get(key)
     if data is None:
@@ -239,9 +305,4 @@ def read_records(cloudpath: str) -> Iterator[dict]:
 
 
 def segment_count(cloudpath: str) -> int:
-  from ..storage import CloudFiles
-
-  try:
-    return sum(1 for _ in CloudFiles(cloudpath).list())
-  except Exception:
-    return 0
+  return len(list_segments(cloudpath))

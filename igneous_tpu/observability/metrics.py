@@ -105,6 +105,21 @@ def gauge_max(name: str, value: float) -> None:
       _GAUGES[name] = float(value)
 
 
+def gauge_set(name: str, value: float) -> None:
+  """Overwrite a gauge (health/autoscale signals: the CURRENT value is
+  the point, unlike gauge_max's high-water marks)."""
+  with _COUNTERS_LOCK:
+    _GAUGES[name] = float(value)
+
+
+def gauge_set_async_safe(name: str, value: float) -> None:
+  """Signal-handler-safe gauge write: skips the metrics lock (a handler
+  interrupting this thread while it holds the lock would deadlock). A
+  dict setitem is atomic under the GIL — a concurrent snapshot may miss
+  the newest value, but state can never corrupt."""
+  _GAUGES[name] = float(value)
+
+
 def timers_snapshot() -> Dict[str, dict]:
   with _COUNTERS_LOCK:
     out = {

@@ -77,7 +77,34 @@ def render() -> str:
     lines.append(f"# TYPE {metric} gauge")
     lines.append(f"{metric} {_fmt(value)}")
 
+  for name, value in sorted(_self_health_gauges().items()):
+    metric = f"igneous_{_sanitize(name)}"
+    lines.append(f"# TYPE {metric} gauge")
+    lines.append(f"{metric} {_fmt(value)}")
+
   return "\n".join(lines) + "\n"
+
+
+def _self_health_gauges() -> dict:
+  """Journal/worker self-health, computed at scrape time: a dead journal
+  writer must itself be alertable, so the exposition carries the live
+  flush age and span backlog whenever a journal is active (the
+  companion counters — igneous_journal_segments_total,
+  igneous_journal_flush_failed_total — register at journal creation).
+  ``igneous_worker_up`` doubles as the liveness gauge: present while
+  the worker process answers scrapes, absent (stale in Prometheus)
+  once it stops."""
+  from . import journal as journal_mod
+  from . import trace
+
+  j = journal_mod.get_active()
+  if j is None:
+    return {}
+  return {
+    "journal_last_flush_age_seconds": round(j.last_flush_age(), 3),
+    "journal_pending_spans": float(trace.pending_spans()),
+    "worker_up": 1.0,
+  }
 
 
 def write_textfile(path: Optional[str] = None) -> Optional[str]:

@@ -146,6 +146,14 @@ def dropped_spans() -> int:
   return _DROPPED[0]
 
 
+def pending_spans() -> int:
+  """Spans buffered but not yet journaled (Prometheus self-health:
+  a growing backlog means the flush path is stuck)."""
+  with _BUFFERS_LOCK:
+    bufs = list(_BUFFERS)
+  return sum(len(b.items) for b in bufs)
+
+
 def reset() -> None:
   """Testing hook: drop all pending spans and the drop tally."""
   drain_spans()

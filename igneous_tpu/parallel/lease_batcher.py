@@ -176,8 +176,13 @@ class LeaseBatcher:
       "executed": 0, "batched": 0, "solo": 0, "failed": 0,
       "group_fallbacks": 0, "released": 0, "prefetched_rounds": 0,
       "prefetched_cutouts": 0,
+      # ISSUE 6: rounds where the health plane's straggler flag made
+      # this worker surrender/skip round-(i+1) pre-leasing
+      "straggler_surrenders": 0, "straggler_prefetch_skips": 0,
       "dispatches": defaultdict(int),
     }
+    # straggler-flag poll cache: (checked_at_monotonic, flagged)
+    self._flag_cache = (0.0, False)
     self._completed_in_group = set()
     self._hb = None
     # next-round pipelining (ISSUE 3): while round i's device dispatch
@@ -190,6 +195,33 @@ class LeaseBatcher:
 
   def _draining(self) -> bool:
     return self.drain_flag is not None and self.drain_flag.is_set()
+
+  # how often a worker re-reads <journal>/health/flags.json (one small
+  # object GET; anything the health checker wrote since last poll takes
+  # effect within this many seconds)
+  FLAG_POLL_SEC = 15.0
+
+  def _straggler_flagged(self) -> bool:
+    """True when the fleet health plane flagged THIS worker (ISSUE 6):
+    `igneous fleet check` publishes a straggler report next to the
+    journal, and a flagged worker stops pre-leasing round i+1 — queue
+    depth goes to healthy workers instead of a lease this worker will
+    be slow (or too dead) to serve."""
+    j = journal_mod.get_active()
+    if j is None:
+      return False
+    now = time.monotonic()
+    checked_at, flagged = self._flag_cache
+    if now - checked_at < self.FLAG_POLL_SEC:
+      return flagged
+    try:
+      from ..observability import health
+
+      flagged = j.worker_id in health.flagged_workers(j.cloudpath)
+    except Exception:
+      flagged = False
+    self._flag_cache = (now, flagged)
+    return flagged
 
   def _current_id(self, lease_id):
     """The member's CURRENT lease token (heartbeat renewals re-timestamp
@@ -258,6 +290,11 @@ class LeaseBatcher:
         if cap <= 0:
           self._surrender_prefetch()
           return self.stats["executed"]
+      if self._next_round is not None and self._straggler_flagged():
+        # flagged mid-flight: round i+1's pre-leased members go straight
+        # back to the queue instead of waiting on this slow worker
+        self._surrender_prefetch()
+        self.stats["straggler_surrenders"] += 1
       members = self._take_prefetched()
       if len(members) > cap:
         # the budget shrank between prefetch and now: surplus goes back
@@ -290,17 +327,22 @@ class LeaseBatcher:
         task_budget is None
         or task_budget - self.stats["executed"] - len(members) > 0
       ):
-        next_cap = self.batch_size
-        if task_budget is not None:
-          next_cap = min(
-            next_cap, task_budget - self.stats["executed"] - len(members)
-          )
-        from ..pipeline import shared_prefetch_pool
+        if self._straggler_flagged():
+          # health plane flagged this worker: run what we hold, but
+          # don't pre-lease more — healthy workers take round i+1
+          self.stats["straggler_prefetch_skips"] += 1
+        else:
+          next_cap = self.batch_size
+          if task_budget is not None:
+            next_cap = min(
+              next_cap, task_budget - self.stats["executed"] - len(members)
+            )
+          from ..pipeline import shared_prefetch_pool
 
-        self._next_round = shared_prefetch_pool().submit(
-          self._prelease_and_prefetch, next_cap,
-          self._round_write_set(members),
-        )
+          self._next_round = shared_prefetch_pool().submit(
+            self._prelease_and_prefetch, next_cap,
+            self._round_write_set(members),
+          )
       if self.timing:
         import json
 

@@ -414,6 +414,26 @@ class FileQueue:
     heartbeating (`igneous queue status` surfaces this)."""
     return sum(1 for age in self.lease_ages() if age < 0)
 
+  @property
+  def backlog(self) -> int:
+    """Work remaining (queued + leased, DLQ excluded) — the autoscaler's
+    demand signal (ISSUE 6)."""
+    return self.enqueued
+
+  def depth_snapshot(self) -> dict:
+    """One consistent-ish read of every depth the health plane consumes
+    (listing races are possible; each field is individually truthful)."""
+    leased = self.leased
+    return {
+      "inserted": self.inserted,
+      "enqueued": self.enqueued,
+      "leased": leased,
+      "completed": self.completed,
+      "backlog": self.backlog,
+      "dlq": self.dlq_count,
+      "stale_leases": self.stale_leases,
+    }
+
   def reset_deliveries(self) -> int:
     """Zero the delivery count of every task still in rotation (queued or
     leased) so a ``max_deliveries`` budget starts fresh — the operator

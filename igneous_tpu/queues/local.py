@@ -87,6 +87,23 @@ class LocalTaskQueue:
       self.drained = True
     return self.drained
 
+  @property
+  def backlog(self) -> int:
+    """Tasks inserted but not completed or dead-lettered (insert()
+    executes inline, so this is nonzero only mid-insert — kept for
+    backend-uniform health plumbing, ISSUE 6)."""
+    return max(self.inserted - self.completed - len(self.dead_letters), 0)
+
+  def depth_snapshot(self) -> dict:
+    return {
+      "inserted": self.inserted,
+      "enqueued": self.backlog,
+      "leased": 0,
+      "completed": self.completed,
+      "backlog": self.backlog,
+      "dlq": len(self.dead_letters),
+    }
+
   def renew(self, lease_id, seconds: float = 600):
     """No-op: local tasks execute in-process with no visibility timeout;
     exists so the shared heartbeat/lifecycle plumbing is backend-uniform."""

@@ -236,6 +236,22 @@ class SQSQueue:
   def leased(self) -> int:
     return self.transport.approximate_counts()[1]
 
+  @property
+  def backlog(self) -> int:
+    """Work remaining (visible + in flight) — the autoscaler's demand
+    signal (ISSUE 6). Approximate, like every SQS count."""
+    return self.enqueued
+
+  def depth_snapshot(self) -> dict:
+    visible, in_flight = self.transport.approximate_counts()
+    return {
+      "inserted": self.inserted,
+      "enqueued": visible + in_flight,
+      "leased": in_flight,
+      "completed": self.completed,
+      "backlog": visible + in_flight,
+    }
+
   def __len__(self) -> int:
     return self.enqueued
 

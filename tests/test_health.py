@@ -1,0 +1,681 @@
+"""ISSUE 6: closed-loop fleet health — rollup compaction, straggler and
+anomaly detection, SLO burn, the autoscaler signal, `fleet
+check|watch|compact|gc`, and the LeaseBatcher straggler-flag consumer.
+
+The acceptance scenario lives in TestAcceptance: a seeded chaos-style
+run with one injected stalled worker and a backlogged queue must make
+`igneous fleet check` exit non-zero naming the straggler, `fleet
+status` over compacted rollups must match the raw-segment output, and
+the Prometheus exposition must carry a desired-workers recommendation
+above the current worker count.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.observability import (
+  fleet,
+  health,
+  journal as journal_mod,
+  prom,
+  rollup,
+  trace,
+)
+from igneous_tpu.queues import FileQueue
+from igneous_tpu.storage import CloudFiles
+from igneous_tpu.tasks import TouchFileTask
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+  telemetry.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+  yield
+  telemetry.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+
+
+def _span(worker, name, ts, dur, **extra):
+  rec = {
+    "kind": "span", "worker": worker, "trace": f"t-{worker}",
+    "span": f"s{ts}", "parent": None, "name": name, "ts": ts, "dur": dur,
+  }
+  rec.update(extra)
+  return rec
+
+
+def _write_segment(path, worker, records, event="interval", ts=None):
+  """One raw journal segment holding ``records`` + a counters snapshot,
+  exactly like Journal.flush would lay it out."""
+  j = journal_mod.Journal(path, worker_id=worker)
+  lines = [json.dumps({
+    "kind": "counters", "worker": worker, "ts": ts or time.time(),
+    "event": event, "counters": {}, "timers": {}, "gauges": {},
+  })]
+  for rec in records:
+    rec = dict(rec)
+    rec["kind"] = "span"
+    rec["worker"] = worker
+    lines.append(json.dumps(rec))
+  name = f"{worker}-{j._seq:06d}.jsonl"
+  CloudFiles(path).put(name, ("\n".join(lines) + "\n").encode("utf8"),
+                       compress=None)
+  return name
+
+
+# -- rollup compaction --------------------------------------------------------
+
+
+class TestRollup:
+  def _seed_journal(self, path, now, n_workers=3, tasks_each=4):
+    for w in range(n_workers):
+      worker = f"w{w}"
+      j = journal_mod.Journal(path, worker_id=worker)
+      journal_mod.set_active(j)
+      for i in range(tasks_each):
+        # exact binary fractions: float sums stay bit-identical across
+        # the raw and rollup aggregation orders
+        trace.record_root("task", now - 40 + i, 0.25 * (w + 1),
+                          worker=worker, task="TouchFileTask")
+        trace.record_root("pipeline.download.s", now - 40 + i, 0.125)
+        trace.record_root("queue.wait", now - 40 + i, 0.0625)
+      j.flush(event="interval")
+      journal_mod.set_active(None)
+
+  def test_status_and_top_agree_raw_vs_rollup(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    self._seed_journal(path, now)
+    raw = fleet.load(path)
+    st_raw = fleet.status(raw)
+    top_raw = fleet.slowest_tasks(raw, 5)
+
+    res = rollup.compact(path)
+    assert res["segments_compacted"] == 3
+    assert res["windows"] >= 1
+    # every raw segment is now covered (raw files persist until gc)
+    _, covered = rollup.load_rollups(path)
+    assert set(covered) == set(journal_mod.list_segments(path))
+    eff = fleet.load_effective(path)
+    assert fleet.status(eff) == st_raw
+    assert fleet.slowest_tasks(eff, 5) == top_raw
+
+  def test_mixed_rollup_plus_uncovered_raw(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    self._seed_journal(path, now, n_workers=2)
+    rollup.compact(path)
+    # a NEW worker flushes after compaction: its raw segment must merge
+    # with the rollups seamlessly
+    j = journal_mod.Journal(path, worker_id="late")
+    journal_mod.set_active(j)
+    trace.record_root("task", now - 5, 0.5, worker="late")
+    j.flush(event="interval")
+    journal_mod.set_active(None)
+    st = fleet.status(fleet.load_effective(path))
+    assert "late" in st["workers"] and "w0" in st["workers"]
+    assert st["tasks"] == 2 * 4 + 1
+
+  def test_double_coverage_resolves_to_one_winner(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    self._seed_journal(path, now, n_workers=1)
+    st_raw = fleet.status(fleet.load(path))
+    # two racing compactions over the same segments (the read side must
+    # pick exactly one, not double count)
+    r1 = rollup.compact(path)
+    cf = CloudFiles(path)
+    data = cf.get(r1["rollup_key"])
+    cf.put("rollup/zzz-racer.jsonl", data, compress=None)
+    assert fleet.status(fleet.load_effective(path)) == st_raw
+    assert telemetry.counters_snapshot().get("rollup.overlap_skipped", 0) >= 1
+
+  def test_gc_deletes_covered_segments_after_retention(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    self._seed_journal(path, now, n_workers=2)
+    uncovered = _write_segment(path, "fresh", [
+      _span("fresh", "task", now, 0.25)
+    ])
+    before = journal_mod.list_segments(path)
+    rollup.compact(path, only_worker="w0")
+    # covered-but-young survives, covered-and-old dies, uncovered stays
+    res = rollup.gc(path, retain=10_000)
+    assert res["deleted"] == 0
+    res = rollup.gc(path, retain=0)
+    assert res["deleted"] == 1  # only w0's segment was covered
+    after = journal_mod.list_segments(path)
+    assert uncovered in after and len(after) == len(before) - 1
+    # the fleet view still includes w0 via its rollup
+    st = fleet.status(fleet.load_effective(path))
+    assert "w0" in st["workers"]
+
+  def test_worker_self_compaction_trigger(self, tmp_path, monkeypatch):
+    monkeypatch.setenv("IGNEOUS_ROLLUP_EVERY", "2")
+    path = f"file://{tmp_path}/journal"
+    j = journal_mod.Journal(path, worker_id="w0")
+    journal_mod.set_active(j)
+    for i in range(4):
+      trace.record_root("task", time.time(), 0.25, worker="w0")
+      assert j.flush(event="interval")
+    journal_mod.set_active(None)
+    _, covered = rollup.load_rollups(path)
+    assert len(covered) >= 2  # at least one self-compaction fired
+    st = fleet.status(fleet.load_effective(path))
+    assert st["tasks"] == 4
+
+  def test_sample_cap_keeps_counts_exact(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    _write_segment(path, "w0", [
+      _span("w0", "pipeline.download.s", now + i * 0.001, 0.25)
+      for i in range(50)
+    ])
+    rollup.compact(path, samples_cap=8)
+    st = fleet.status(fleet.load_effective(path))
+    dl = st["stages"]["pipeline.download.s"]
+    assert dl["count"] == 50
+    assert dl["total_s"] == 12.5  # count/sum exact past the cap
+    assert dl["p50_ms"] == 250.0  # uniform durs: percentile still right
+
+
+# -- health detectors ---------------------------------------------------------
+
+
+def _cfg(**kw):
+  base = dict(
+    window_sec=600.0, straggler_ratio=3.0, straggler_min_tasks=3,
+    stall_sec=60.0, forget_sec=3600.0, horizon_sec=600.0,
+    hysteresis=0.2, min_workers=1, max_workers=1000,
+  )
+  base.update(kw)
+  return health.HealthConfig(**base)
+
+
+class TestDetectors:
+  def test_latency_straggler_flagged(self):
+    now = time.time()
+    records = []
+    for w in ("fast1", "fast2", "fast3"):
+      records += [_span(w, "task", now - 30 + i, 0.1) for i in range(5)]
+    records += [_span("slow", "task", now - 30 + i, 2.0) for i in range(5)]
+    rep = health.HealthEngine(_cfg()).evaluate(records, now=now)
+    assert rep["flagged_workers"] == ["slow"]
+    (s,) = rep["stragglers"]
+    assert s["kind"] == "latency" and s["ratio"] >= 3.0
+    assert not rep["healthy"]
+
+  def test_stalled_straggler_requires_backlog(self):
+    now = time.time()
+    records = (
+      [_span("live", "task", now - 5 + i, 0.1) for i in range(4)]
+      + [_span("stuck", "task", now - 500, 0.1)]
+    )
+    eng = health.HealthEngine(_cfg(stall_sec=120.0))
+    # no backlog: a silent worker after the campaign ended is fine
+    rep = eng.evaluate(records, queue_stats={"backlog": 0}, now=now)
+    assert rep["stragglers"] == []
+    # with backlog the silence is a stall
+    rep = eng.evaluate(records, queue_stats={"backlog": 7}, now=now)
+    assert [s["worker"] for s in rep["stragglers"]] == ["stuck"]
+    assert rep["stragglers"][0]["kind"] == "stalled"
+
+  def test_clean_drain_is_not_a_straggler(self):
+    now = time.time()
+    records = [
+      _span("live", "task", now - 5, 0.1),
+      _span("gone", "task", now - 500, 0.1),
+      {"kind": "counters", "worker": "gone", "ts": now - 480,
+       "event": "drain", "counters": {}},
+    ]
+    rep = health.HealthEngine(_cfg(stall_sec=120.0)).evaluate(
+      records, queue_stats={"backlog": 9}, now=now
+    )
+    assert rep["stragglers"] == []
+    assert rep["workers"]["gone"]["clean_exit"] is True
+
+  def test_forgotten_workers_drop_out(self):
+    now = time.time()
+    records = [
+      _span("ancient", "task", now - 7200, 0.1),
+      _span("live", "task", now - 5, 0.1),
+    ]
+    rep = health.HealthEngine(_cfg()).evaluate(
+      records, queue_stats={"backlog": 5}, now=now
+    )
+    assert "ancient" not in rep["workers"]
+
+  def test_dlq_rate_anomaly_and_journal_stalled(self):
+    now = time.time()
+    records = [
+      _span("w0", "task", now - 300, 0.1),
+      {"kind": "counters", "worker": "w0", "ts": now - 300,
+       "event": "interval", "counters": {"dlq.promoted": 5}},
+    ]
+    rep = health.HealthEngine(_cfg(stall_sec=120.0)).evaluate(
+      records, queue_stats={"backlog": 11}, now=now
+    )
+    kinds = {a["kind"] for a in rep["anomalies"]}
+    assert "dlq_rate" in kinds
+    assert "journal_stalled" in kinds  # every writer silent + backlog
+
+  def test_slo_burn(self):
+    now = time.time()
+    records = [_span("w", "task", now - 30 + i, 0.1) for i in range(8)]
+    records += [
+      _span("w", "task", now - 20 + i, 0.1, error="Boom") for i in range(2)
+    ]
+    rep = health.HealthEngine(_cfg(slo_success=0.99)).evaluate(
+      records, now=now
+    )
+    # 20% failures against a 1% budget: burning at 20x
+    assert rep["slo"]["burn"] == pytest.approx(20.0, rel=0.01)
+    assert not rep["healthy"]
+
+  def test_health_events_shapes(self):
+    now = time.time()
+    records = (
+      [_span(w, "task", now - 30 + i, 0.1)
+       for w in ("a", "b", "c") for i in range(4)]
+      + [_span("slow", "task", now - 30 + i, 5.0) for i in range(4)]
+    )
+    rep = health.HealthEngine(_cfg()).evaluate(records, now=now)
+    events = health.health_events(rep)
+    names = [e["name"] for e in events]
+    assert "health.straggler" in names and "health.autoscale" in names
+    stragglers = [e for e in events if e["name"] == "health.straggler"]
+    assert stragglers[0]["flagged"] == "slow"
+
+
+class TestAutoscaler:
+  def _records(self, now, workers=2, rate_per_worker=1.0, span=100.0):
+    # each worker completes span*rate tasks evenly across [now-span, now]
+    records = []
+    for w in range(workers):
+      n = int(span * rate_per_worker)
+      for i in range(n):
+        records.append(_span(
+          f"w{w}", "task", now - span + i / rate_per_worker, 0.01
+        ))
+    return records
+
+  def test_desired_scales_with_backlog(self):
+    now = time.time()
+    records = self._records(now, workers=2, rate_per_worker=1.0)
+    rep = health.HealthEngine(_cfg(horizon_sec=100.0)).evaluate(
+      records, queue_stats={"backlog": 1000}, now=now
+    )
+    a = rep["autoscale"]
+    # ~1 task/s/worker, 1000 backlog, 100s horizon -> ~10 workers
+    assert 8 <= a["desired_workers"] <= 12
+    assert a["desired_workers"] > a["current_workers"] == 2
+
+  def test_hysteresis_damps_small_deltas(self):
+    now = time.time()
+    records = self._records(now, workers=5, rate_per_worker=1.0)
+    # backlog sized so raw desired (6) is within 20% of current (5)
+    rep = health.HealthEngine(_cfg(horizon_sec=100.0)).evaluate(
+      records, queue_stats={"backlog": 550}, now=now
+    )
+    a = rep["autoscale"]
+    assert a["desired_workers"] == 5 and a["hysteresis_damped"]
+
+  def test_empty_backlog_scales_to_min(self):
+    now = time.time()
+    records = self._records(now, workers=3)
+    rep = health.HealthEngine(_cfg(min_workers=1)).evaluate(
+      records, queue_stats={"backlog": 0}, now=now
+    )
+    assert rep["autoscale"]["desired_workers"] == 1
+
+  def test_publish_gauges_renders_in_prom(self):
+    now = time.time()
+    records = self._records(now, workers=2)
+    rep = health.HealthEngine(_cfg(horizon_sec=50.0)).evaluate(
+      records, queue_stats={"backlog": 500}, now=now
+    )
+    health.publish_gauges(rep)
+    text = prom.render()
+    assert "igneous_fleet_desired_workers" in text
+    assert "igneous_slo_burn" in text
+    assert "igneous_fleet_stragglers" in text
+    assert "igneous_fleet_backlog 500" in text
+
+
+# -- straggler flags + LeaseBatcher consumption -------------------------------
+
+
+class TestFlags:
+  def test_flags_roundtrip_and_staleness(self, tmp_path):
+    path = f"file://{tmp_path}/journal"
+    now = time.time()
+    report = {
+      "ts": now, "flagged_workers": ["w-slow"],
+      "autoscale": {"desired_workers": 5, "backlog": 10},
+    }
+    health.write_flags(path, report)
+    assert health.flagged_workers(path) == {"w-slow"}
+    # the flags file must never be parsed as a journal segment
+    assert journal_mod.list_segments(path) == []
+    stale = dict(report, ts=now - 10_000)
+    health.write_flags(path, stale)
+    assert health.flagged_workers(path) == set()
+
+  def test_lease_batcher_skips_prefetch_when_flagged(self, tmp_path):
+    from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+
+    q = FileQueue(f"fq://{tmp_path}/q")
+    q.insert([
+      TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(6)
+    ])
+    jpath = journal_mod.journal_path_for(q)
+    j = journal_mod.Journal(jpath)
+    journal_mod.set_active(j)
+    health.write_flags(jpath, {
+      "ts": time.time(), "flagged_workers": [j.worker_id],
+      "autoscale": {"desired_workers": 1, "backlog": 6},
+    })
+    try:
+      batcher = LeaseBatcher(q, batch_size=2, lease_seconds=30,
+                             heartbeat_seconds=0)
+      executed = batcher.poll(
+        stop_fn=lambda executed, empty: empty, max_backoff_window=0.2
+      )
+    finally:
+      journal_mod.set_active(None)
+    assert executed == 6
+    # flagged: every full round refused to pre-lease round i+1
+    assert batcher.stats["straggler_prefetch_skips"] >= 1
+    assert batcher.stats["prefetched_rounds"] == 0
+
+  def test_lease_batcher_prefetches_when_not_flagged(self, tmp_path):
+    from igneous_tpu.parallel.lease_batcher import LeaseBatcher
+
+    q = FileQueue(f"fq://{tmp_path}/q")
+    q.insert([
+      TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(6)
+    ])
+    jpath = journal_mod.journal_path_for(q)
+    journal_mod.set_active(journal_mod.Journal(jpath))
+    try:
+      batcher = LeaseBatcher(q, batch_size=2, lease_seconds=30,
+                             heartbeat_seconds=0)
+      executed = batcher.poll(
+        stop_fn=lambda executed, empty: empty, max_backoff_window=0.2
+      )
+    finally:
+      journal_mod.set_active(None)
+    assert executed == 6
+    assert batcher.stats["straggler_prefetch_skips"] == 0
+    assert batcher.stats["prefetched_rounds"] >= 1
+
+
+# -- journal self-health (prom satellite) -------------------------------------
+
+
+class TestSelfHealth:
+  def test_journal_metrics_registered_at_creation(self, tmp_path):
+    journal_mod.Journal(f"file://{tmp_path}/journal")
+    text = prom.render()
+    assert "igneous_journal_segments_total 0" in text
+    assert "igneous_journal_flush_failed_total 0" in text
+
+  def test_scrape_time_gauges_present_when_active(self, tmp_path):
+    j = journal_mod.Journal(f"file://{tmp_path}/journal")
+    journal_mod.set_active(j)
+    try:
+      text = prom.render()
+      assert "igneous_journal_last_flush_age_seconds" in text
+      assert "igneous_journal_pending_spans" in text
+      assert "igneous_worker_up 1" in text
+    finally:
+      journal_mod.set_active(None)
+    assert "igneous_worker_up" not in prom.render()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+@pytest.fixture
+def runner():
+  from click.testing import CliRunner
+
+  return CliRunner()
+
+
+def _seed_stall_fixture(tmp_path, stall_age=300.0):
+  """A backlogged fq:// queue + journal with one healthy recent worker
+  and one long-silent worker holding a lease."""
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert([TouchFileTask(path=str(tmp_path / f"t{i}")) for i in range(10)])
+  jpath = journal_mod.journal_path_for(q)
+  now = time.time()
+  _write_segment(jpath, "healthy", [
+    _span("healthy", "task", now - 20 + i, 0.25) for i in range(5)
+  ], ts=now - 15)
+  got = q.lease(600)
+  assert got is not None
+  _write_segment(jpath, "stalled-w", [
+    _span("stalled-w", "task", now - stall_age, 0.25)
+  ], ts=now - stall_age)
+  return q, jpath
+
+
+class TestCLI:
+  def test_fleet_check_exit_codes_and_events(self, tmp_path, runner):
+    from igneous_tpu.cli import main
+
+    q, jpath = _seed_stall_fixture(tmp_path)
+    res = runner.invoke(main, [
+      "fleet", "check", "-q", f"fq://{tmp_path}/q",
+      "--stall-sec", "120", "--horizon-sec", "1",
+    ])
+    assert res.exit_code == 2, res.output
+    assert "stalled-w" in res.output
+    # structured event landed in the journal
+    events = [
+      r for r in fleet.load(jpath)
+      if r.get("kind") == "span" and r.get("name") == "health.straggler"
+    ]
+    assert any(e.get("flagged") == "stalled-w" for e in events)
+    # straggler flags published for LeaseBatcher
+    assert health.flagged_workers(jpath) == {"stalled-w"}
+
+  def test_fleet_check_healthy_exit_zero(self, tmp_path, runner):
+    from igneous_tpu.cli import main
+
+    jpath = f"file://{tmp_path}/journal"
+    now = time.time()
+    _write_segment(jpath, "w0", [
+      _span("w0", "task", now - 20 + i, 0.25) for i in range(5)
+    ], ts=now - 15)
+    res = runner.invoke(main, ["fleet", "check", "--journal", jpath])
+    assert res.exit_code == 0, res.output
+    assert "HEALTHY" in res.output
+
+  def test_fleet_check_json_and_out(self, tmp_path, runner):
+    from igneous_tpu.cli import main
+
+    q, _ = _seed_stall_fixture(tmp_path)
+    out = tmp_path / "report.json"
+    res = runner.invoke(main, [
+      "fleet", "check", "-q", f"fq://{tmp_path}/q",
+      "--stall-sec", "120", "--json", "--out", str(out),
+    ])
+    assert res.exit_code == 2
+    report = json.loads(res.output)
+    assert report["autoscale"]["backlog"] == 10
+    assert json.loads(out.read_text()) == report
+
+  def test_fleet_watch_renders_one_frame(self, tmp_path, runner):
+    from igneous_tpu.cli import main
+
+    _seed_stall_fixture(tmp_path)
+    res = runner.invoke(main, [
+      "fleet", "watch", "-q", f"fq://{tmp_path}/q",
+      "--iterations", "1", "--no-clear", "--stall-sec", "120",
+    ])
+    assert res.exit_code == 0, res.output
+    assert "STRAGGLER" in res.output
+    assert "backlog 10" in res.output
+    assert "healthy" in res.output  # the healthy worker's table row
+
+  def test_fleet_compact_and_gc_cli(self, tmp_path, runner):
+    from igneous_tpu.cli import main
+
+    jpath = f"file://{tmp_path}/journal"
+    now = time.time()
+    for w in ("a", "b"):
+      _write_segment(jpath, w, [
+        _span(w, "task", now - 30, 0.25)
+      ], ts=now - 30)
+    res = runner.invoke(main, ["fleet", "compact", "--journal", jpath])
+    assert res.exit_code == 0, res.output
+    assert json.loads(res.output)["segments_compacted"] == 2
+    res = runner.invoke(main, [
+      "fleet", "gc", "--journal", jpath, "--retain-sec", "0",
+    ])
+    assert res.exit_code == 0
+    assert json.loads(res.output)["deleted"] == 2
+
+  def test_fleet_status_over_rollups_cli_output_stable(self, tmp_path,
+                                                       runner):
+    from igneous_tpu.cli import main
+
+    jpath = f"file://{tmp_path}/journal"
+    now = time.time()
+    for w in ("a", "b"):
+      _write_segment(jpath, w, [
+        _span(w, "task", now - 30 + i, 0.25) for i in range(4)
+      ] + [
+        _span(w, "pipeline.download.s", now - 30 + i, 0.125)
+        for i in range(4)
+      ], ts=now - 25)
+    before = runner.invoke(main, ["fleet", "status", "--journal", jpath])
+    assert before.exit_code == 0, before.output
+    rollup.compact(jpath)
+    after = runner.invoke(main, ["fleet", "status", "--journal", jpath])
+    assert after.exit_code == 0
+    assert after.output == before.output  # satellite: no CLI format break
+
+
+# -- acceptance ---------------------------------------------------------------
+
+
+class TestAcceptance:
+  def test_stalled_worker_backlog_end_to_end(self, tmp_path, runner=None):
+    """ISSUE 6 acceptance: stalled worker + backlogged queue -> check
+    exits non-zero naming it, rollup status == raw status, Prometheus
+    reports desired_workers > current workers."""
+    from click.testing import CliRunner
+
+    from igneous_tpu.cli import main
+
+    q, jpath = _seed_stall_fixture(tmp_path)
+    st_raw = fleet.status(fleet.load(jpath))
+
+    runner = CliRunner()
+    res = runner.invoke(main, [
+      "fleet", "check", "-q", f"fq://{tmp_path}/q",
+      "--stall-sec", "120", "--horizon-sec", "1", "--json",
+    ])
+    assert res.exit_code == 2, res.output
+    report = json.loads(res.output)
+    assert "stalled-w" in report["flagged_workers"]
+    a = report["autoscale"]
+    assert a["desired_workers"] > a["current_workers"]
+
+    # Prometheus endpoint view: gauges published by the check
+    text = prom.render()
+    desired = next(
+      line for line in text.splitlines()
+      if line.startswith("igneous_fleet_desired_workers ")
+    )
+    assert float(desired.split()[1]) > a["current_workers"]
+
+    # rollup agreement AFTER the check wrote its health events
+    res2 = rollup.compact(jpath)
+    assert res2["segments_compacted"] >= 2
+    st_raw2 = fleet.status(fleet.load(jpath))
+    st_eff = fleet.status(fleet.load_effective(jpath))
+    assert st_raw2 == st_eff
+    # and the pre-check aggregates are still inside the merged view
+    assert st_eff["tasks"] >= st_raw["tasks"]
+
+
+# -- queue_eta edge cases (satellite) -----------------------------------------
+
+
+class TestQueueEtaEdges:
+  def _journal_with_tasks(self, tmp_path, ts_list):
+    q = FileQueue(f"fq://{tmp_path}/q")
+    jpath = journal_mod.journal_path_for(q)
+    _write_segment(jpath, "w0", [
+      _span("w0", "task", ts, 0.4) for ts in ts_list
+    ])
+    return q, jpath
+
+  def test_expired_window_falls_back_to_sampling(self, tmp_path):
+    # segments exist but every task span predates the 10-min window:
+    # the journal path must decline, not divide by a stale window
+    now = time.time()
+    q, jpath = self._journal_with_tasks(
+      tmp_path, [now - 3600 + i for i in range(5)]
+    )
+    assert fleet.journal_throughput(jpath) is None
+    stats = telemetry.queue_eta(q, sample_seconds=0.05, journal_path=jpath)
+    assert stats["source"] == "sampled"
+
+  def test_empty_journal_dir_falls_back(self, tmp_path):
+    q = FileQueue(f"fq://{tmp_path}/q")
+    jpath = journal_mod.journal_path_for(q)
+    assert fleet.journal_throughput(jpath) is None
+
+  def test_counters_only_segments_fall_back(self, tmp_path):
+    q = FileQueue(f"fq://{tmp_path}/q")
+    jpath = journal_mod.journal_path_for(q)
+    _write_segment(jpath, "w0", [])  # counters snapshot, no spans
+    assert fleet.journal_throughput(jpath) is None
+
+  def test_clock_skewed_future_spans_excluded(self, tmp_path):
+    now = time.time()
+    q, jpath = self._journal_with_tasks(
+      tmp_path,
+      # 5 sane recent spans + 3 from a worker whose clock is 1h ahead
+      [now - 50 + i * 10 for i in range(5)] + [now + 3600 + i for i in range(3)],
+    )
+    stats = fleet.journal_throughput(jpath)
+    assert stats is not None
+    assert stats["tasks"] == 5
+    # window derived from the sane spans only — not stretched to +1h
+    assert stats["window_sec"] < 120
+
+  def test_all_future_spans_fall_back(self, tmp_path):
+    now = time.time()
+    q, jpath = self._journal_with_tasks(
+      tmp_path, [now + 3600 + i for i in range(4)]
+    )
+    assert fleet.journal_throughput(jpath) is None
+
+  def test_rollup_vs_raw_eta_agreement(self, tmp_path):
+    now = time.time()
+    q, jpath = self._journal_with_tasks(
+      tmp_path, [now - 100 + i * 10 for i in range(8)]
+    )
+    raw = fleet.journal_throughput(jpath, now=now)
+    assert raw is not None
+    rollup.compact(jpath)
+    _, covered = rollup.load_rollups(jpath)
+    assert set(covered) == set(journal_mod.list_segments(jpath))
+    eff = fleet.journal_throughput(jpath, now=now)
+    assert eff == raw
+    # and the eta survives GC of the covered raw segments
+    rollup.gc(jpath, retain=0)
+    assert journal_mod.list_segments(jpath) == []
+    assert fleet.journal_throughput(jpath, now=now) == raw

@@ -390,6 +390,135 @@ def run_preemption_storm(scratch, img, seed, trace_out=None):
   }
 
 
+# a worker that executes a slice of the queue then exits, journaling
+# aggressively — the HEALTHY half of the stall scenario's fleet
+_STALL_WORKER_SRC = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["IGNEOUS_JOURNAL_FLUSH_SEC"] = "0.2"
+import igneous_tpu.tasks  # register task classes
+from igneous_tpu.observability import journal as journal_mod
+from igneous_tpu.queues import FileQueue
+
+spec, num_tasks, task_delay = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+q = FileQueue(spec)
+journal_mod.set_active(
+  journal_mod.Journal(journal_mod.journal_path_for(q, spec))
+)
+q.poll(
+  lease_seconds=30,
+  verbose=False,
+  stop_fn=lambda executed, empty: empty or executed >= num_tasks,
+  max_backoff_window=0.2,
+  before_fn=lambda task: time.sleep(task_delay),
+)
+journal_mod.disarm_last_will()  # clean exit: drain batch, no stall flag
+"""
+
+
+def run_stall_health_scenario(scratch, seed, health_out=None):
+  """ISSUE 6 acceptance: one injected stalled worker + a backlogged
+  queue. ``igneous fleet check`` must exit non-zero NAMING the stalled
+  worker, leave a ``health.straggler`` event in the journal, recommend
+  desired_workers > current workers, and ``fleet status`` over compacted
+  rollups must match the raw-segment view."""
+  import subprocess
+
+  from igneous_tpu.observability import fleet, journal as journal_mod, rollup
+  from igneous_tpu.observability import trace
+
+  rng_img = np.random.default_rng(seed)
+  img = rng_img.integers(0, 255, (160, 160, 64)).astype(np.uint8)
+  workdir = os.path.join(scratch, "stall")
+  layer = f"file://{workdir}/layer"
+  Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
+  tasks = list(tc.create_downsampling_tasks(
+    layer, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
+  ))
+  spec = f"fq://{workdir}/q"
+  q = FileQueue(spec)
+  n_tasks = q.insert(tasks)
+  assert n_tasks >= 8, f"stall scenario needs a task grid, got {n_tasks}"
+  jpath = journal_mod.journal_path_for(q, spec)
+
+  # the INJECTED STALLED WORKER: leases a task, journals once (so the
+  # health plane knows it exists), then goes silent holding the lease —
+  # the exact shape of a wedged pod whose heartbeat thread died
+  stalled_id = f"stalled-{os.getpid()}"
+  zombie = q.lease(600)
+  assert zombie is not None
+  stalled_journal = journal_mod.Journal(jpath, worker_id=stalled_id)
+  journal_mod.set_active(stalled_journal)
+  trace.record_root("task", time.time() - 1.0, 0.9, worker=stalled_id)
+  stalled_journal.flush(event="interval")
+  journal_mod.set_active(None)
+  stalled_at = time.monotonic()
+
+  # the healthy worker drains HALF the queue then exits cleanly — the
+  # check below must see throughput AND remaining backlog
+  env = dict(os.environ, JAX_PLATFORMS="cpu")
+  env["PYTHONPATH"] = (
+    REPO_ROOT + os.pathsep + env["PYTHONPATH"]
+    if env.get("PYTHONPATH") else REPO_ROOT
+  )
+  live = subprocess.run(
+    [sys.executable, "-c", _STALL_WORKER_SRC,
+     spec, str(max(n_tasks // 2, 2)), "0.3"],
+    env=env, timeout=300,
+  )
+  assert live.returncode == 0, f"healthy worker failed: {live.returncode}"
+  backlog = q.backlog
+  assert backlog > 0, "stall scenario needs remaining backlog"
+
+  # let the stalled worker age past the detector threshold
+  stall_sec = 2.0
+  time.sleep(max(0.0, stall_sec + 0.5 - (time.monotonic() - stalled_at)))
+
+  report_path = health_out or os.path.join(scratch, "health-report.json")
+  check = subprocess.run(
+    [sys.executable, "-m", "igneous_tpu", "fleet", "check",
+     "-q", spec, "--stall-sec", str(stall_sec), "--horizon-sec", "1",
+     "--json", "--out", report_path],
+    env=env, capture_output=True, text=True, timeout=120,
+  )
+  assert check.returncode == 2, (
+    f"fleet check must exit 2 on a stalled worker, got {check.returncode}: "
+    f"{check.stdout}\n{check.stderr}"
+  )
+  report = json.loads(check.stdout)
+  flagged = {s["worker"] for s in report["stragglers"]}
+  assert stalled_id in flagged, (stalled_id, report["stragglers"])
+  auto = report["autoscale"]
+  assert auto["desired_workers"] > auto["current_workers"], auto
+  events = [
+    r for r in fleet.load(jpath)
+    if r.get("kind") == "span" and r.get("name") == "health.straggler"
+  ]
+  assert any(e.get("flagged") == stalled_id for e in events), events
+
+  # rollup agreement: compacted view must match the raw-segment view
+  st_raw = fleet.status(fleet.load(jpath))
+  res = rollup.compact(jpath)
+  assert res["segments_compacted"] > 0, res
+  st_eff = fleet.status(fleet.load_effective(jpath))
+  assert st_raw == st_eff, {
+    k: (st_raw.get(k), st_eff.get(k))
+    for k in set(st_raw) | set(st_eff) if st_raw.get(k) != st_eff.get(k)
+  }
+
+  return {
+    "tasks": n_tasks,
+    "backlog_at_check": backlog,
+    "stalled_worker": stalled_id,
+    "flagged": sorted(flagged),
+    "desired_workers": auto["desired_workers"],
+    "current_workers": auto["current_workers"],
+    "health_report": report_path,
+    "rollup_segments_compacted": res["segments_compacted"],
+    "rollup_status_matches_raw": True,
+  }
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--seed", type=int, default=0,
@@ -398,14 +527,20 @@ def main():
                   help="cube edge of the synthetic volume")
   ap.add_argument("--keep", action="store_true",
                   help="keep the scratch dir for inspection")
-  ap.add_argument("--scenario", choices=("faults", "preemption", "all"),
+  ap.add_argument("--scenario",
+                  choices=("faults", "preemption", "stall", "all"),
                   default="faults",
                   help="faults: ISSUE 1 storage/queue fault storm; "
-                       "preemption: ISSUE 2 worker kill storm + zombie")
+                       "preemption: ISSUE 2 worker kill storm + zombie; "
+                       "stall: ISSUE 6 stalled worker + backlog -> "
+                       "`fleet check` must flag it")
   ap.add_argument("--trace-out", default=None,
                   help="write a Perfetto/Chrome trace JSON of the "
                        "preemption storm's merged journal here (CI "
                        "uploads it as a browsable artifact)")
+  ap.add_argument("--health-out", default=None,
+                  help="write the stall scenario's `fleet check` health "
+                       "report JSON here (CI uploads it as an artifact)")
   ap.add_argument("--pipeline", action="store_true",
                   help="run the soak with the staged execution pipeline "
                        "enabled (ISSUE 3): the CLEAN reference run stays "
@@ -439,6 +574,10 @@ def main():
     if args.scenario in ("preemption", "all"):
       report["preemption"] = run_preemption_storm(
         scratch, img, args.seed, trace_out=args.trace_out
+      )
+    if args.scenario in ("stall", "all"):
+      report["stall"] = run_stall_health_scenario(
+        scratch, args.seed, health_out=args.health_out
       )
     report["counters"] = telemetry.counters_snapshot()
     report["wall_s"] = round(time.monotonic() - t0, 2)
