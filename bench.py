@@ -677,6 +677,56 @@ def bench_pool_ab():
   return out
 
 
+def bench_pool_ab_cpu(img):
+  """CPU-device A/B of the 2x2x1 average-pool step (ISSUE 7 satellite):
+  the batched XLA device path (ChunkExecutor over every virtual device)
+  vs the native threaded host path, same voxels each side. Replaces the
+  perpetual {"skipped": "tpu-only"} entry whenever >=2 (virtual) devices
+  exist — the number behind the IGNEOUS_POOL_HOST=auto dispatch policy."""
+  import jax
+
+  from igneous_tpu.ops import oracle, pooling
+  from igneous_tpu.parallel.executor import cached_chunk_executor, make_mesh
+
+  n = jax.device_count()
+  if n < 2:
+    return None
+  chunk = np.ascontiguousarray(img[:256, :256, :64])
+  mesh = make_mesh()
+  ex = cached_chunk_executor(mesh, factors=((2, 2, 1),), method="average")
+  batch = np.stack([pooling._to_device_layout(chunk)] * n)
+  iters = 2 if QUICK else 5
+
+  ex(batch)  # compile + settle
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    ex(batch)
+  device_rate = batch.size * iters / (time.perf_counter() - t0)
+
+  host_fn = lambda: pooling.host_downsample(  # noqa: E731
+    chunk, (2, 2, 1), 1, method="average", parallel=0
+  )
+  label = "native-threaded host pooling"
+  if host_fn() is None:
+    host_fn = lambda: oracle.np_downsample_with_averaging(  # noqa: E731
+      chunk, (2, 2, 1), 1
+    )
+    label = "numpy-oracle host pooling"
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    for _k in range(n):  # same voxel count as the n-chunk device batch
+      host_fn()
+  host_rate = chunk.size * n * iters / (time.perf_counter() - t0)
+  return {
+    "device_voxps": round(device_rate, 1),
+    "host_voxps": round(host_rate, 1),
+    "device_vs_host": round(device_rate / host_rate, 3),
+    "devices": n,
+    "mode": f"cpu-device A/B: sharded XLA pyramid over {n} virtual "
+            f"device(s) vs {label}",
+  }
+
+
 def bench_edt_kernel():
   """BASELINE config 5's device core: multilabel anisotropic EDT,
   BATCHED — K cutouts per shard_map dispatch."""
@@ -811,9 +861,15 @@ def run_bench(platform: str):
   if platform == "tpu":
     pool_ab = bench_pool_ab()
     if pool_ab is None:
-      pool_ab = _skip("pallas pooling unavailable on this device")
+      # no pallas on this device: fall back to the generic device-vs-host
+      # A/B so TPU rounds stop recording a skip here too
+      pool_ab = bench_pool_ab_cpu(img)
+    if pool_ab is None:
+      pool_ab = _skip("pallas unavailable and <2 devices for the A/B")
   else:
-    pool_ab = _skip(f"tpu-only device A/B (platform={platform})")
+    pool_ab = bench_pool_ab_cpu(img)
+    if pool_ab is None:
+      pool_ab = _skip("single-device host: no device path to A/B")
   edt_rate = bench_edt_kernel()
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
   codec_tbl = bench_codecs(img, seg)
@@ -902,12 +958,23 @@ def run_bench(platform: str):
       ),
       "edt_kernel_voxps": round(edt_rate, 1),
       "pool_ab": pool_ab,
+      # ISSUE 7: the device telemetry plane's own view of this bench run
+      # — per-kernel compile/execute seconds + vox/s, per-device busy
+      # seconds, recompile count, transfer bytes, utilization ratio
+      "device_telemetry": _device_telemetry(),
       "baseline": baseline_kind + " (reference stack not installed here)",
       "platform": platform,
       "device": _device_name(),
     },
   }
   print(json.dumps(result))
+
+
+def _device_telemetry():
+  from igneous_tpu.observability import device as device_mod
+
+  snap = device_mod.LEDGER.snapshot()
+  return snap if snap is not None else _skip("no device dispatches ran")
 
 
 def _device_name():

@@ -12,7 +12,8 @@ every reference command and --option has a counterpart here):
             spatial-index {create,db}}
   execute | queue {status,wait,release,rezero,purge,cp,mv,fsck,
                    dlq {ls,retry,purge}}
-  fleet {status,trace,top,compact,gc,check,watch}
+  fleet {status,trace,top,devices,compact,gc,check,watch}
+  profile {capture,ls}
   design {ds-memory, ds-shape, bounds}
   view | license
 
@@ -1487,6 +1488,12 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   jpath = journal_mod.journal_path_for(tq, queue_spec)
   if jpath:
     journal_mod.set_active(journal_mod.Journal(jpath))
+    # device telemetry plane (ISSUE 7): the utilization ledger rides
+    # every journal flush and the profiler trigger poll rides the
+    # between-tasks maybe_flush cadence
+    from .observability import device as device_mod
+
+    device_mod.install()
   journal_mod.install_last_will({"queue": queue_spec})
   # worker-liveness gauge (ISSUE 6): present while this process answers
   # scrapes; goes stale in Prometheus the moment the worker dies — the
@@ -1930,6 +1937,26 @@ def fleet_top(queue_spec, journal_path, top_n):
     )
 
 
+@fleet_group.command("devices")
+@_journal_opts
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable.")
+def fleet_devices(queue_spec, journal_path, as_json):
+  """Merged per-device utilization table (ISSUE 7): busy seconds/ratio,
+  dispatches, recompiles, HBM peak per worker x device, per-kernel
+  vox/s, and the batched-vs-host fast-path tally — from the cumulative
+  device ledgers each worker flushes into the journal."""
+  from . import secrets
+  from .observability import device as device_mod
+
+  records = _fleet_records(queue_spec or secrets.queue_url(), journal_path)
+  ledgers = device_mod.device_ledgers(records)
+  if as_json:
+    click.echo(device_mod.report_json(ledgers))
+    return
+  for line in device_mod.render_devices(ledgers):
+    click.echo(line)
+
+
 @fleet_group.command("compact")
 @_journal_opts
 @click.option("--window-sec", "window", default=None, type=float,
@@ -2109,6 +2136,91 @@ def fleet_watch(queue_spec, journal_path, window_sec, stall_sec,
     if iterations is not None and n >= iterations:
       return
     time_mod.sleep(max(interval, 0.0))
+
+
+# on-demand profiler capture (ISSUE 7)
+
+
+@main.group("profile")
+def profile_group():
+  """On-demand ``jax.profiler`` capture across the fleet.
+
+  ``capture`` publishes <journal>/profile/request.json; every worker
+  polls it on the journal cadence (the PR 6 straggler-flag pattern) and
+  runs one bounded profiler trace, uploading the TensorBoard-format
+  artifacts under <journal>/profiles/. No worker restart, no always-on
+  profiling cost."""
+
+
+@profile_group.command("capture")
+@_journal_opts
+@click.option("--duration", default=5.0, show_default=True, type=float,
+              help="Seconds of device activity to capture.")
+@click.option("--worker", "workers", multiple=True,
+              help="Restrict the trigger to these worker ids "
+                   "[default: every worker captures once].")
+@click.option("--wait", default=0.0, show_default=True, type=float,
+              help="Poll up to this many seconds for artifacts to land "
+                   "before returning (0 = fire and forget).")
+@click.option("--local", is_flag=True,
+              help="Capture in THIS process instead of publishing a "
+                   "worker trigger (debugging a driver-side workload).")
+def profile_capture(queue_spec, journal_path, duration, workers, wait,
+                    local):
+  """Trigger a bounded profiler capture on fleet workers."""
+  import time as time_mod
+
+  from . import secrets
+  from .observability import device as device_mod
+  from .observability import journal as journal_mod
+
+  path = _journal_location(queue_spec or secrets.queue_url(), journal_path)
+  if local:
+    j = journal_mod.Journal(path, worker_id=f"profile-cli-{os.getpid()}")
+    device_mod._capture_blocking(duration, j, "manual", None)
+    for key in device_mod.list_profiles(path):
+      click.echo(key)
+    return
+  req = device_mod.write_profile_request(
+    path, duration_sec=duration, workers=list(workers) or None,
+  )
+  click.echo(f"published capture request {req['id']} "
+             f"({duration}s) at {path}/{device_mod.PROFILE_REQUEST_KEY}")
+  if wait <= 0:
+    return
+  deadline = time_mod.monotonic() + wait
+  prefix = f"{device_mod.PROFILE_ARTIFACT_PREFIX}"
+  while time_mod.monotonic() < deadline:
+    found = [
+      k for k in device_mod.list_profiles(path) if req["id"] in k
+    ]
+    if found:
+      click.echo(f"{len(found)} artifact file(s):")
+      for key in found:
+        click.echo(f"  {prefix}{key}" if not key.startswith(prefix) else
+                   f"  {key}")
+      return
+    time_mod.sleep(1.0)
+  raise click.ClickException(
+    f"no artifacts for request {req['id']} within {wait}s (are workers "
+    "running with a journal?)"
+  )
+
+
+@profile_group.command("ls")
+@_journal_opts
+def profile_ls(queue_spec, journal_path):
+  """List captured profile artifacts under <journal>/profiles/."""
+  from . import secrets
+  from .observability import device as device_mod
+
+  path = _journal_location(queue_spec or secrets.queue_url(), journal_path)
+  keys = device_mod.list_profiles(path)
+  if not keys:
+    click.echo("no profile artifacts")
+    return
+  for key in keys:
+    click.echo(key)
 
 
 @main.group()

@@ -20,11 +20,18 @@ The subsystem in one picture::
          fleet check|watch   health.* events   health/flags.json
          (exit codes, CI)    + Prom gauges     (LeaseBatcher backs off)
 
+    device plane (ISSUE 7, device.py): executors/pooling emit
+    device.compile|execute|h2d|d2h spans + a cumulative utilization
+    ledger (recompiles, HBM, busy ratio, per-kernel vox/s) → journal
+    "device" records → igneous_device_* gauges, `fleet devices`, watch
+    dashboard, recompile-storm/HBM/idle anomalies; profile/request.json
+    triggers on-demand jax.profiler captures → <journal>/profiles/
+
 ``igneous_tpu.telemetry`` remains as a compat shim over
 :mod:`.metrics`; new code should import from here.
 """
 
-from . import fleet, health, journal, perfetto, prom, rollup, trace
+from . import device, fleet, health, journal, perfetto, prom, rollup, trace
 from .metrics import (
   StageTimes,
   counters_snapshot,
@@ -36,6 +43,7 @@ from .metrics import (
   histograms_snapshot,
   incr,
   observe,
+  observe_quiet,
   queue_eta,
   reset_all,
   reset_counters,
@@ -46,9 +54,11 @@ from .metrics import (
 )
 
 __all__ = [
-  "fleet", "health", "journal", "perfetto", "prom", "rollup", "trace",
+  "device", "fleet", "health", "journal", "perfetto", "prom", "rollup",
+  "trace",
   "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
   "gauge_max", "gauge_set", "gauges_snapshot", "histograms_snapshot",
-  "incr", "observe", "queue_eta", "reset_all", "reset_counters", "stage",
+  "incr", "observe", "observe_quiet", "queue_eta", "reset_all",
+  "reset_counters", "stage",
   "task_timing", "timed_poll_hooks", "timers_snapshot",
 ]

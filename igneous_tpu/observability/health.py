@@ -43,6 +43,11 @@ from . import fleet, metrics
 
 FLAGS_KEY = "health/flags.json"
 
+# a worker's first compile of each kernel is churn-free startup, not a
+# storm: the recompile-storm anomaly needs at least this many recompiles
+# in the measured interval before the per-minute rate means anything
+DEVICE_RECOMPILE_STORM_MIN = 10
+
 
 def _env_float(name: str, default):
   raw = os.environ.get(name)
@@ -86,6 +91,13 @@ class HealthConfig:
   hysteresis: float = 0.2
   min_workers: int = 1
   max_workers: int = 1000
+  # device plane (ISSUE 7): recompile storm = sustained XLA recompiles
+  # per minute above this (shape churn eating the compile cache); HBM
+  # high-water = peak bytes over this fraction of the device limit;
+  # device idle = busy ratio below this while the queue has backlog
+  recompiles_per_min_max: float = 10.0
+  hbm_highwater_frac: float = 0.9
+  device_idle_ratio: float = 0.05
 
   _ENV = {
     "window_sec": "IGNEOUS_HEALTH_WINDOW_SEC",
@@ -103,6 +115,9 @@ class HealthConfig:
     "hysteresis": "IGNEOUS_AUTOSCALE_HYSTERESIS",
     "min_workers": "IGNEOUS_AUTOSCALE_MIN",
     "max_workers": "IGNEOUS_AUTOSCALE_MAX",
+    "recompiles_per_min_max": "IGNEOUS_HEALTH_RECOMPILES_PER_MIN",
+    "hbm_highwater_frac": "IGNEOUS_HEALTH_HBM_FRAC",
+    "device_idle_ratio": "IGNEOUS_HEALTH_DEVICE_IDLE_RATIO",
   }
 
   @classmethod
@@ -158,6 +173,8 @@ class HealthEngine:
       return v
 
     counters_by_worker: dict = {}
+    device_latest: dict = {}    # worker -> newest cumulative device ledger
+    device_earliest: dict = {}  # worker -> oldest in-window ledger (rates)
     stall_total = work_total = 0.0
 
     def seen(worker, ts):
@@ -215,6 +232,17 @@ class HealthEngine:
           counters_by_worker[worker] = rec
         if rec.get("event") in ("drain", "exit"):
           view(worker)["clean_exit"] = True
+      elif kind == "device":
+        worker = rec.get("worker", "local")
+        ts = rec.get("ts")
+        seen(worker, ts)
+        prev = device_latest.get(worker)
+        if prev is None or (ts or 0) >= prev.get("ts", 0):
+          device_latest[worker] = rec
+        if ts is not None and ts >= now - cfg.window_sec:
+          early = device_earliest.get(worker)
+          if early is None or ts < early.get("ts", float("inf")):
+            device_earliest[worker] = rec
       elif kind == "span":
         worker = rec.get("worker", "local")
         ts, dur = rec.get("ts"), rec.get("dur")
@@ -240,6 +268,8 @@ class HealthEngine:
       "counters": dict(counters),
       "stall_total": stall_total,
       "work_total": work_total,
+      "device_latest": device_latest,
+      "device_earliest": device_earliest,
     }
 
   # -- evaluation -----------------------------------------------------------
@@ -337,6 +367,64 @@ class HealthEngine:
         "stall_sec": cfg.stall_sec,
       })
 
+    # device-plane anomalies (ISSUE 7): recompile storms, HBM pressure,
+    # and the "TPU idles while work waits" condition the ROADMAP only
+    # asserted — all from the cumulative per-worker device ledgers
+    device_ledgers = scan["device_latest"]
+    for worker in sorted(device_ledgers):
+      rec = device_ledgers[worker]
+      early = scan["device_earliest"].get(worker)
+      d_rec = rec.get("recompiles", 0)
+      dt = float(rec.get("ts", now)) - float(
+        rec.get("t_start", rec.get("ts", now))
+      )
+      if (
+        early is not None and early is not rec
+        and rec.get("ts", 0) > early.get("ts", 0)
+      ):
+        # two in-window snapshots: rate over their delta, not since boot
+        d_rec = rec.get("recompiles", 0) - early.get("recompiles", 0)
+        dt = float(rec["ts"]) - float(early["ts"])
+      rate_per_min = d_rec / max(dt, 1.0) * 60.0
+      if (
+        d_rec >= DEVICE_RECOMPILE_STORM_MIN
+        and rate_per_min > cfg.recompiles_per_min_max
+      ):
+        anomalies.append({
+          "kind": "recompile_storm", "worker": worker,
+          "recompiles": d_rec, "per_min": round(rate_per_min, 2),
+          "max_per_min": cfg.recompiles_per_min_max,
+        })
+      for dev, dstats in sorted((rec.get("hbm") or {}).items()):
+        limit = dstats.get("bytes_limit")
+        if not limit:
+          continue
+        frac = dstats.get("peak_bytes_in_use", 0) / limit
+        if frac >= cfg.hbm_highwater_frac:
+          anomalies.append({
+            "kind": "hbm_high_water", "worker": worker, "device": dev,
+            "peak_frac": round(frac, 3),
+            "max_frac": cfg.hbm_highwater_frac,
+            "peak_bytes": dstats.get("peak_bytes_in_use", 0),
+            "limit_bytes": limit,
+          })
+      busy = rec.get("busy_ratio")
+      v = per.get(worker)
+      worker_live = (
+        v is not None and not v["clean_exit"]
+        and now - v["last_seen"] < cfg.stall_sec
+      )
+      if (
+        backlog > 0 and worker_live and busy is not None
+        and rec.get("dispatches", 0) > 0
+        and busy <= cfg.device_idle_ratio
+      ):
+        anomalies.append({
+          "kind": "device_idle", "worker": worker,
+          "busy_ratio": busy, "min_busy_ratio": cfg.device_idle_ratio,
+          "backlog": backlog,
+        })
+
     # SLO burn: error-budget consumption rate (1.0 = burning exactly at
     # budget; >1 = on track to violate the SLO)
     success_rate = (tasks_ok / tasks_total) if tasks_total else None
@@ -419,6 +507,9 @@ class HealthEngine:
       },
       "workers": workers_report,
     }
+    from . import device as device_mod
+
+    report["devices"] = device_mod.fleet_summary(device_ledgers)
     return report
 
 
@@ -436,6 +527,14 @@ def publish_gauges(report: dict) -> None:
                     report["autoscale"]["desired_workers"])
   metrics.gauge_set("fleet.backlog", report["autoscale"]["backlog"])
   metrics.gauge_set("slo.burn", report["slo"]["burn"])
+  dev = report.get("devices")
+  if dev:
+    if dev.get("busy_ratio") is not None:
+      metrics.gauge_set("fleet.device_busy_ratio", dev["busy_ratio"])
+    metrics.gauge_set("fleet.device_recompiles", dev["recompiles"])
+    metrics.gauge_set("fleet.device_dispatches", dev["dispatches"])
+    if dev.get("hbm_peak_frac") is not None:
+      metrics.gauge_set("fleet.device_hbm_peak_frac", dev["hbm_peak_frac"])
 
 
 def health_events(report: dict) -> List[dict]:
@@ -558,6 +657,26 @@ def render_dashboard(report: dict, queue_stats: Optional[dict] = None,
       )
     )
   lines.extend(check_lines(report)[:4])
+  dev = report.get("devices")
+  if dev:
+    fp = dev.get("fastpath") or {}
+    fp_total = fp.get("batched", 0) + fp.get("host", 0)
+    lines.append(
+      "devices: "
+      + (
+        f"busy {dev['busy_ratio'] * 100:.1f}%  "
+        if dev.get("busy_ratio") is not None else ""
+      )
+      + f"dispatches {dev['dispatches']}  recompiles {dev['recompiles']}"
+      + (
+        f"  hbm peak {dev['hbm_peak_frac'] * 100:.0f}%"
+        if dev.get("hbm_peak_frac") is not None else ""
+      )
+      + (
+        f"  fastpath {fp.get('batched', 0)}/{fp_total} batched"
+        if fp_total else ""
+      )
+    )
   lines.append("")
   lines.append(f"{'worker':<28}{'tasks':>6}{'fail':>6}{'p95_ms':>9}"
                f"{'seen_ago':>10}  state")

@@ -36,6 +36,25 @@ FLUSH_SEC_ENV = "IGNEOUS_JOURNAL_FLUSH_SEC"
 PATH_ENV = "IGNEOUS_JOURNAL"
 DEFAULT_FLUSH_SEC = 30.0
 
+# extra-record providers: callables returning a list of record dicts to
+# append to every flushed segment (the device plane's utilization ledger
+# rides along this way — journal.py stays ignorant of who contributes)
+_RECORD_PROVIDERS: list = []
+# poll hooks: cheap callables invoked from maybe_flush_active (the
+# between-tasks cadence every worker loop already has) — the profiler
+# trigger poll lives here so solo AND batched workers both see it
+_POLL_HOOKS: list = []
+
+
+def register_record_provider(fn) -> None:
+  if fn not in _RECORD_PROVIDERS:
+    _RECORD_PROVIDERS.append(fn)
+
+
+def register_poll_hook(fn) -> None:
+  if fn not in _POLL_HOOKS:
+    _POLL_HOOKS.append(fn)
+
 
 def default_worker_id() -> str:
   host = socket.gethostname().split(".")[0] or "worker"
@@ -112,11 +131,17 @@ class Journal:
     """Write one segment with all pending spans + a metrics snapshot.
     Skips the write when there is nothing new and no ``event`` to record.
     Returns True when a segment landed."""
+    extra_records = []
+    for provider in list(_RECORD_PROVIDERS):
+      try:
+        extra_records.extend(provider() or ())
+      except Exception:
+        metrics.incr("journal.provider_failed")
     with self._lock:
       self._dirty.clear()
       spans = trace.drain_spans()
       self._last_flush = time.monotonic()
-      if not spans and event is None:
+      if not spans and not extra_records and event is None:
         return False
       lines = []
       snap = {
@@ -133,6 +158,11 @@ class Journal:
       for rec in spans:
         rec = dict(rec)
         rec["kind"] = "span"
+        rec["worker"] = self.worker_id
+        lines.append(json.dumps(rec))
+      for rec in extra_records:
+        rec = dict(rec)
+        rec.setdefault("kind", "span")
         rec["worker"] = self.worker_id
         lines.append(json.dumps(rec))
       name = f"{self.worker_id}-{self._seq:06d}.jsonl"
@@ -207,6 +237,11 @@ def get_active() -> Optional[Journal]:
 def maybe_flush_active(event: Optional[str] = None) -> None:
   j = _ACTIVE
   if j is not None:
+    for hook in list(_POLL_HOOKS):
+      try:
+        hook(j)
+      except Exception:
+        metrics.incr("journal.poll_hook_failed")
     j.maybe_flush(event=event)
 
 

@@ -80,6 +80,18 @@ _HISTOGRAMS: Dict[str, list] = {}
 
 def observe(name: str, seconds: float) -> None:
   """Accumulate a float span (e.g. "pipeline.download.stall_s")."""
+  observe_quiet(name, seconds)
+  # observe sites double as span emitters when the calling thread runs
+  # inside a sampled trace (pipeline stages, buffer stalls)
+  trace.record_span(name, seconds)
+
+
+def observe_quiet(name: str, seconds: float) -> None:
+  """``observe`` without the trace-span side channel — for callers that
+  emit their own richer span for the same interval (the device plane
+  records ``device.execute`` spans with kernel/device/byte attrs; a
+  second bare span from observe() would double every interval in the
+  Perfetto view)."""
   seconds = float(seconds)
   with _COUNTERS_LOCK:
     _TIMERS[name] += seconds
@@ -93,9 +105,6 @@ def observe(name: str, seconds: float) -> None:
         break
     else:
       buckets[-1] += 1
-  # observe sites double as span emitters when the calling thread runs
-  # inside a sampled trace (pipeline stages, buffer stalls)
-  trace.record_span(name, seconds)
 
 
 def gauge_max(name: str, value: float) -> None:
@@ -222,20 +231,44 @@ def stage(name: str):
 def device_trace(logdir: Optional[str] = None):
   """jax.profiler trace around a device-heavy region.
 
-  Enabled when ``logdir`` is given or IGNEOUS_TPU_PROFILE_DIR is set;
-  otherwise a no-op (safe in workers without profiling infrastructure).
-  """
-  logdir = logdir or os.environ.get("IGNEOUS_TPU_PROFILE_DIR")
+  Gated on ``IGNEOUS_PROFILE_DIR`` (legacy ``IGNEOUS_TPU_PROFILE_DIR``
+  still honored) so it is INERT by default — workers without profiling
+  infrastructure pay one env read. Logdirs are namespaced per worker
+  process (hostname-pid): concurrent workers sharing one profile dir
+  must not interleave their TensorBoard event files. ``stop_trace`` is
+  exception-safe twice over: it runs from a ``finally`` so the region's
+  exception still stops the profiler, and a stop failure (profiler
+  already torn down, backend gone mid-drain) never masks — or adds to —
+  the region's own outcome."""
+  logdir = (
+    logdir
+    or os.environ.get("IGNEOUS_PROFILE_DIR")
+    or os.environ.get("IGNEOUS_TPU_PROFILE_DIR")
+  )
   if not logdir:
     yield
     return
+  import socket
+
   import jax
 
-  jax.profiler.start_trace(logdir)
+  host = socket.gethostname().split(".")[0] or "worker"
+  logdir = os.path.join(logdir, f"{host}-{os.getpid()}")
+  try:
+    jax.profiler.start_trace(logdir)
+  except Exception:
+    # a second start (nested regions, a concurrent triggered capture)
+    # raises inside jax; profiling is diagnostics, not correctness
+    incr("device.profile.start_failed")
+    yield
+    return
   try:
     yield
   finally:
-    jax.profiler.stop_trace()
+    try:
+      jax.profiler.stop_trace()
+    except Exception:
+      incr("device.profile.stop_failed")
 
 
 def timed_poll_hooks(verbose: bool = True):

@@ -22,6 +22,7 @@ def chrome_trace(records: Iterable[dict],
   Event Format. ``trace_id`` filters to one trace; None exports all."""
   events = []
   pids = {}  # worker -> pid
+  device_tids = {}  # (pid, device label) -> tid
   t0 = None
 
   spans = [
@@ -42,16 +43,28 @@ def chrome_trace(records: Iterable[dict],
     args["span_id"] = rec.get("span")
     if rec.get("parent"):
       args["parent_span_id"] = rec["parent"]
+    name = rec.get("name", "span")
+    if name.startswith("device.") and rec.get("device"):
+      # device telemetry (ISSUE 7): kernel/transfer spans render on one
+      # dedicated track per physical device inside the worker row, so
+      # compile/execute/h2d intervals read as a device timeline instead
+      # of vanishing into whichever task trace triggered them. tids
+      # 10000+ keep clear of the per-trace task rows below.
+      tid = device_tids.setdefault(
+        (pid, rec["device"]), 10_000 + len(device_tids)
+      )
+    else:
+      # one row per trace inside the worker keeps concurrent tasks from
+      # visually stacking into one another
+      tid = abs(hash(rec.get("trace", ""))) % 10_000
     events.append({
-      "name": rec.get("name", "span"),
+      "name": name,
       "cat": "igneous",
       "ph": "X",
       "ts": (rec["ts"] - t0) * 1e6,          # microseconds
       "dur": max(rec["dur"], 0.0) * 1e6,
       "pid": pid,
-      # one row per trace inside the worker keeps concurrent tasks from
-      # visually stacking into one another
-      "tid": abs(hash(rec.get("trace", ""))) % 10_000,
+      "tid": tid,
       "args": args,
     })
 
@@ -59,6 +72,11 @@ def chrome_trace(records: Iterable[dict],
     events.append({
       "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
       "args": {"name": f"worker {worker}"},
+    })
+  for (pid, dev), tid in device_tids.items():
+    events.append({
+      "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+      "args": {"name": f"device {dev}"},
     })
 
   return {

@@ -224,6 +224,7 @@ def compact(
 
   windows: dict = {}
   latest_counters: Dict[str, dict] = {}
+  latest_device: Dict[str, dict] = {}
   seg_last_ts: Dict[str, float] = {k: 0.0 for k in segs}
   for rec in journal_mod.read_records(cloudpath, keys=segs):
     seg = rec.get("segment")
@@ -238,6 +239,15 @@ def compact(
         c = dict(rec)
         c.pop("segment", None)
         latest_counters[worker] = c
+    elif kind == "device":
+      # device utilization ledgers are CUMULATIVE per worker (ISSUE 7),
+      # so — like counters — re-emitting only the latest loses nothing
+      worker = rec.get("worker", "local")
+      prev = latest_device.get(worker)
+      if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+        d = dict(rec)
+        d.pop("segment", None)
+        latest_device[worker] = d
     elif kind == "span":
       _fold_span(windows, rec, wsec, cap)
 
@@ -251,6 +261,8 @@ def compact(
     lines.append(json.dumps(w))
   for worker in sorted(latest_counters):
     lines.append(json.dumps(latest_counters[worker]))
+  for worker in sorted(latest_device):
+    lines.append(json.dumps(latest_device[worker]))
 
   _SEQ[0] += 1
   name = f"{ROLLUP_PREFIX}{actor}-{int(time.time() * 1000):013d}-{_SEQ[0]:04d}.jsonl"

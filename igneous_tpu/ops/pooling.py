@@ -211,9 +211,33 @@ def _pyramid_impl(x, factors: Tuple[Factor3, ...], method: str, sparse: bool):
   return tuple(outs)
 
 
-_pyramid = partial(jax.jit, static_argnames=("factors", "method", "sparse"))(
-  _pyramid_impl
-)
+_jit_pyramid = partial(
+  jax.jit, static_argnames=("factors", "method", "sparse")
+)(_pyramid_impl)
+
+
+def _pyramid(x, factors, method, sparse):
+  """The jitted pyramid behind device telemetry (ISSUE 7): the solo-task
+  device path (``downsample()``) ticks the same recompile ledger and
+  emits the same device.compile/device.execute spans as the batched
+  executors — first call on a new input signature is the compile."""
+  from ..observability import device as device_telemetry
+
+  kernel = f"pooling.pyramid[{method}]"
+  leaves = x if isinstance(x, tuple) else (x,)
+  sig = (tuple((np.shape(a), str(np.asarray(a).dtype)) for a in leaves),
+         factors, sparse)
+  fresh = device_telemetry.LEDGER.note_signature(kernel, sig)
+  elements = sum(int(np.size(a)) for a in leaves)
+  span = (
+    device_telemetry.compile_span(kernel, device_telemetry._devices_of())
+    if fresh else
+    device_telemetry.execute_span(kernel, elements=elements)
+  )
+  with span:
+    outs = _jit_pyramid(x, factors, method, sparse)
+    jax.block_until_ready(outs)
+  return outs
 
 
 def pyramid_batched(factors: Tuple[Factor3, ...], method: str, sparse: bool):

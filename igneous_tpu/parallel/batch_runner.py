@@ -126,6 +126,9 @@ def batched_downsample(
     run_stats = run_tasks_pipelined(native_tasks(), drain_flag=drain_flag)
     stats["native_cutouts"] = run_stats["executed"]
     stats["drained"] = run_stats["drained"]
+    from ..observability import device as device_telemetry
+
+    device_telemetry.LEDGER.record_fastpath(host=run_stats["executed"])
     return stats
 
   full_boxes = []
@@ -238,6 +241,14 @@ def batched_downsample(
     ).execute()
     stats["edge_cutouts"] += 1
 
+  # fast-path eligibility (ISSUE 7): the ragged-batching roadmap item's
+  # baseline number — how many cutouts rode the batched device program
+  # vs fell to the per-task path on shape grounds
+  from ..observability import device as device_telemetry
+
+  device_telemetry.LEDGER.record_fastpath(
+    batched=stats["batched_cutouts"], host=stats["edge_cutouts"]
+  )
   return stats
 
 
@@ -339,6 +350,11 @@ def batched_ccl_faces(
           cc = _offset_components(cc, task.task_num, task.shape)
           store_ccl_faces(cc, cutout, core, task.task_num, files, scratch)
           stats["batched_cutouts"] += 1
+  from ..observability import device as device_telemetry
+
+  device_telemetry.LEDGER.record_fastpath(
+    batched=stats["batched_cutouts"], host=stats["edge_cutouts"]
+  )
   return stats
 
 
@@ -407,4 +423,9 @@ def batched_skeleton_forge(
         for (task, prepared), field in zip(preps, fields):
           task.execute(_prepared=prepared, _edt_field=field)
           stats["batched_cutouts"] += 1
+  from ..observability import device as device_telemetry
+
+  device_telemetry.LEDGER.record_fastpath(
+    batched=stats["batched_cutouts"], host=stats["solo_cutouts"]
+  )
   return stats

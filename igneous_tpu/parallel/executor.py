@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import device as device_telemetry
 from ..ops.pooling import _pyramid_impl
 
 # jax.shard_map went public in newer jax; this image ships 0.4.x where it
@@ -75,8 +76,10 @@ class BatchKernelExecutor:
   per input signature.
   """
 
-  def __init__(self, kernel, mesh: Optional[Mesh] = None):
+  def __init__(self, kernel, mesh: Optional[Mesh] = None,
+               name: Optional[str] = None):
     self.kernel = kernel
+    self.name = name or getattr(kernel, "__name__", "kernel").lstrip("_")
     self.mesh = mesh if mesh is not None else make_mesh()
     self.axis = self.mesh.axis_names[0]
     self._cache = {}
@@ -128,12 +131,33 @@ class BatchKernelExecutor:
         batch,
       )
     sig = self._signature(batch)
-    if sig not in self._cache:
-      self._cache[sig] = self._build(batch)
     sharding = NamedSharding(self.mesh, P(self.axis))
-    dev = jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
-    out = self._cache[sig](dev)
-    return jax.tree.map(lambda a: np.asarray(a)[:k], out)
+    with device_telemetry.transfer_span(
+      "h2d", device_telemetry.nbytes_of(batch), kernel=self.name,
+      mesh=self.mesh,
+    ):
+      dev = jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+    if sig not in self._cache:
+      # device.compile vs device.execute split (ISSUE 7): AOT
+      # lower+compile so the compile span measures XLA work alone —
+      # jit's lazy first-call compile would fold it into the first
+      # execute and poison the utilization ledger
+      device_telemetry.LEDGER.note_signature(self.name, sig)
+      with device_telemetry.compile_span(
+        self.name, device_telemetry._devices_of(self.mesh)
+      ):
+        self._cache[sig] = self._build(batch).lower(dev).compile()
+    with device_telemetry.execute_span(
+      self.name, elements=device_telemetry.elements_of(batch),
+      nbytes=device_telemetry.nbytes_of(batch), mesh=self.mesh,
+    ):
+      out = self._cache[sig](dev)
+      jax.block_until_ready(out)
+    with device_telemetry.transfer_span(
+      "d2h", device_telemetry.nbytes_of(out), kernel=self.name,
+      mesh=self.mesh,
+    ):
+      return jax.tree.map(lambda a: np.asarray(a)[:k], out)
 
 
 class ChunkExecutor:
@@ -163,7 +187,9 @@ class ChunkExecutor:
     if self.planes == 2 and method != "mode":
       raise ValueError("plane pairs are only meaningful for mode pooling")
     self.axis = self.mesh.axis_names[0]
+    self.name = f"pooling.pyramid[{method}]"
     self._fn = self._build()
+    self._compiled = {}  # input signature -> AOT executable (ISSUE 7)
 
   def _build(self):
     factors, method, sparse = self.factors, self.method, self.sparse
@@ -218,7 +244,24 @@ class ChunkExecutor:
     )
     if len(arrs) != self.planes:
       raise ValueError(f"expected {self.planes} plane(s), got {len(arrs)}")
-    return self._fn(tuple(arrs))
+    # multihost path keeps the plain jit (AOT executables and global
+    # arrays interact badly across versions); first-call-per-signature
+    # still ticks the recompile ledger and labels as compile
+    sig = tuple((a.shape, str(a.dtype)) for a in arrs)
+    fresh = device_telemetry.LEDGER.note_signature(self.name, sig)
+    span = (
+      device_telemetry.compile_span(
+        self.name, device_telemetry._devices_of(self.mesh)
+      ) if fresh else
+      device_telemetry.execute_span(
+        self.name, elements=device_telemetry.elements_of(arrs),
+        mesh=self.mesh,
+      )
+    )
+    with span:
+      out = self._fn(tuple(arrs))
+      jax.block_until_ready(out)
+    return out
 
   def __call__(self, batch):
     """batch: (K, c, z, y, x) array (planes=1) or a (lo, hi) tuple of such
@@ -233,8 +276,31 @@ class ChunkExecutor:
       p, _ = self.pad_batch(np.asarray(a))
       padded.append(p)
     sharding = NamedSharding(self.mesh, P(self.axis))
-    xs = tuple(jax.device_put(p, sharding) for p in padded)
-    outs, nonzero = self._fn(xs)
+    with device_telemetry.transfer_span(
+      "h2d", sum(int(p.nbytes) for p in padded), kernel=self.name,
+      mesh=self.mesh,
+    ):
+      xs = tuple(jax.device_put(p, sharding) for p in padded)
+    sig = tuple((a.shape, str(a.dtype)) for a in xs)
+    if sig not in self._compiled:
+      device_telemetry.LEDGER.note_signature(self.name, sig)
+      with device_telemetry.compile_span(
+        self.name, device_telemetry._devices_of(self.mesh)
+      ):
+        self._compiled[sig] = self._fn.lower(xs).compile()
+    with device_telemetry.execute_span(
+      self.name, elements=sum(int(p.size) for p in padded),
+      nbytes=sum(int(p.nbytes) for p in padded), mesh=self.mesh,
+    ):
+      outs, nonzero = self._compiled[sig](xs)
+      jax.block_until_ready((outs, nonzero))
+    with device_telemetry.transfer_span(
+      "d2h", device_telemetry.nbytes_of(outs), kernel=self.name,
+      mesh=self.mesh,
+    ):
+      return self._finish_call(outs, nonzero, k)
+
+  def _finish_call(self, outs, nonzero, k):
     if self.planes == 2:
       result = [
         (np.asarray(ol)[:k], np.asarray(oh)[:k]) for ol, oh in outs

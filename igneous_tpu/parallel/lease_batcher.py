@@ -37,6 +37,7 @@ from typing import Optional
 import numpy as np
 
 from ..lib import Bbox
+from ..observability import device as device_telemetry
 from ..observability import journal as journal_mod
 from ..observability import trace
 from ..queues.filequeue import failure_reason, run_with_deadline
@@ -600,6 +601,11 @@ class LeaseBatcher:
       self.queue.delete(self._current_id(lease_id))
       self.stats["executed"] += 1
       self.stats["solo"] += 1
+      # per-delivery fast-path eligibility (ISSUE 7): this delivery fell
+      # off the batched device path (ragged shape, singleton group,
+      # host-pool policy) — the ledger's ratio is the ragged-batching
+      # roadmap item's baseline
+      device_telemetry.LEDGER.record_fastpath(host=1)
 
   # -- completion plumbing --------------------------------------------------
 
@@ -623,6 +629,7 @@ class LeaseBatcher:
     self.queue.delete(self._current_id(lease_id))
     self.stats["executed"] += 1
     self.stats["batched"] += 1
+    device_telemetry.LEDGER.record_fastpath(batched=1)
     # group membership tracks the ORIGINAL token (what handlers hold)
     self._completed_in_group.add(lease_id)
 

@@ -73,8 +73,49 @@ def initialize(
         f"with {kw} is not supported (jax.distributed is process-global)"
       )
     return
+  _enable_cpu_collectives(kw)
   jax.distributed.initialize(**kw)
   initialize._args = kw
+
+
+def cpu_collectives_available() -> bool:
+  """Does this jaxlib build ship gloo TCP collectives for the CPU
+  backend? Without them a multi-process CPU "pod" can form a mesh but
+  every cross-process program fails with "Multiprocess computations
+  aren't implemented on the CPU backend"."""
+  try:
+    from jax._src.lib import xla_client
+
+    return hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+  except Exception:
+    return False
+
+
+def _enable_cpu_collectives(kw: dict) -> None:
+  """Multi-process rig on the CPU backend: switch the CPU client's
+  collectives implementation to gloo BEFORE the backend initializes.
+
+  jax defaults ``jax_cpu_collectives_implementation`` to "none", under
+  which any cross-process computation dies with "Multiprocess
+  computations aren't implemented on the CPU backend" (the seed failure
+  of tests/test_multihost.py). The env var spelling of the flag is not
+  read by this jax version, so the config update must be programmatic.
+  Real TPU pods never enter here (their collectives ride ICI, not gloo).
+  """
+  import jax
+
+  if int(kw.get("num_processes") or 1) <= 1:
+    return
+  plats = os.environ.get("JAX_PLATFORMS", "")
+  if plats.split(",")[0].strip().lower() != "cpu":
+    return
+  if not cpu_collectives_available():
+    return  # jaxlib without gloo: leave the default; callers may skip
+  try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+  except Exception:
+    pass  # config option renamed/removed: the capability probe above
+          # keeps callers honest about what this build can do
 
 
 def pod_mesh(axis: str = "chunks"):

@@ -25,6 +25,7 @@ from .observability.metrics import (  # noqa: F401
   histograms_snapshot,
   incr,
   observe,
+  observe_quiet,
   queue_eta,
   reset_all,
   reset_counters,
@@ -37,7 +38,8 @@ from .observability.metrics import (  # noqa: F401
 
 __all__ = [
   "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
-  "gauge_max", "gauge_set", "gauges_snapshot", "histograms_snapshot", "incr", "observe",
+  "gauge_max", "gauge_set", "gauges_snapshot", "histograms_snapshot",
+  "incr", "observe", "observe_quiet",
   "queue_eta", "reset_all", "reset_counters", "stage", "task_timing",
   "timed_poll_hooks", "timer_totals", "timers_snapshot",
 ]
