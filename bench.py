@@ -577,6 +577,86 @@ def bench_transfer_passthrough(seg):
   return round(passthrough, 1), round(decode, 1)
 
 
+def bench_serve(seg):
+  """Serving-tier latency/throughput over a seeded mem:// layer
+  (ISSUE 9): hot-hit p50, overall p99, requests/sec over a keep-alive
+  connection, and the coalescing dedupe ratio under a 16-client
+  thundering herd on one cold chunk."""
+  import http.client
+  import threading
+
+  from igneous_tpu.observability import metrics
+  from igneous_tpu.serve import ServeApp, ServeConfig, ServeServer
+  from igneous_tpu.volume import Volume
+
+  sub = np.ascontiguousarray(seg[:128, :128, :64])
+  vol = Volume.from_numpy(
+    sub, "mem://bench/serve_layer", chunk_size=(64, 64, 32),
+    layer_type="segmentation", encoding="compressed_segmentation",
+  )
+  del vol
+  app = ServeApp(
+    {"layer": "mem://bench/serve_layer"}, default_layer="layer",
+    config=ServeConfig(ram_mb=64.0, synth_mips=False),
+  )
+  srv = ServeServer(app, host="127.0.0.1", port=0)
+  port = srv.server_address[1]
+  chunk_url = "/1_1_1/0-64_0-64_0-32"
+  try:
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    lat = []
+    n_requests = 300
+    conn.request("GET", chunk_url)  # cold: populate the RAM tier
+    conn.getresponse().read()
+    t_all = time.perf_counter()
+    for _ in range(n_requests):
+      t0 = time.perf_counter()
+      conn.request("GET", chunk_url, headers={"Accept-Encoding": "gzip"})
+      conn.getresponse().read()
+      lat.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_all
+    conn.close()
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+
+    # thundering herd on one cold chunk: dedupe ratio = clients per
+    # backend fetch the coalescer achieved
+    app._cache.invalidate("layer")
+    before = metrics.counters_snapshot()
+    herd = 16
+    barrier = threading.Barrier(herd)
+
+    def hammer():
+      c = http.client.HTTPConnection("127.0.0.1", port)
+      barrier.wait()
+      c.request("GET", chunk_url)
+      c.getresponse().read()
+      c.close()
+
+    threads = [threading.Thread(target=hammer) for _ in range(herd)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    after = metrics.counters_snapshot()
+    leaders = after.get("serve.coalesce.leaders", 0) - before.get(
+      "serve.coalesce.leaders", 0
+    )
+    waiters = after.get("serve.coalesce.waiters", 0) - before.get(
+      "serve.coalesce.waiters", 0
+    )
+    dedupe = (leaders + waiters) / max(leaders, 1)
+  finally:
+    srv.shutdown()
+  return {
+    "serve_hot_hit_p50_ms": round(p50 * 1e3, 3),
+    "serve_p99_ms": round(p99 * 1e3, 3),
+    "serve_req_per_sec": round(n_requests / wall, 1),
+    "serve_coalesce_dedupe_ratio": round(dedupe, 2),
+  }
+
+
 def measure_transfer_MBps():
   import jax
 
@@ -875,6 +955,7 @@ def run_bench(platform: str):
   codec_tbl = bench_codecs(img, seg)
   cseg_speedup = bench_cseg_speedup()
   xfer_passthrough, xfer_decode = bench_transfer_passthrough(seg)
+  serve_stats = bench_serve(seg)
 
   # Headline = the framework's production kernel path on this platform:
   # device pyramid on TPU; on the CPU fallback, the native threaded host
@@ -958,6 +1039,9 @@ def run_bench(platform: str):
       ),
       "edt_kernel_voxps": round(edt_rate, 1),
       "pool_ab": pool_ab,
+      # ISSUE 9: interactive serving tier — hot-path latency, sustained
+      # keep-alive throughput, and herd-coalescing effectiveness
+      **serve_stats,
       # ISSUE 7: the device telemetry plane's own view of this bench run
       # — per-kernel compile/execute seconds + vox/s, per-device busy
       # seconds, recompile count, transfer bytes, utilization ratio

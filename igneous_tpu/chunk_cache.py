@@ -167,6 +167,43 @@ class ChunkDecodeCache:
 _SHARED: Optional[ChunkDecodeCache] = None
 _SHARED_LOCK = threading.Lock()
 
+# Invalidation fan-out (ISSUE 9 satellite): the decode cache is no longer
+# the only consumer of "this (path, mip) was just rewritten" — the serve
+# tier's stored-bytes tiers (RAM/SSD) key entries by layer+chunk and must
+# drop them on overwrite/delete. Rather than having serve reach into
+# Volume internals, `invalidate()` below is THE shared entry point:
+# Volume.upload/delete, the pipeline runner's write joins, and serve's
+# own write-back all call it, and every registered hook hears about it.
+_INVALIDATION_HOOKS: list = []
+_HOOKS_LOCK = threading.Lock()
+
+
+def register_invalidation_hook(fn) -> None:
+  """Register ``fn(path, mip_or_None)`` to be called on every
+  ``invalidate()``/``invalidate_writes()``. Hooks must be fast and must
+  not raise (failures are counted, never propagated)."""
+  with _HOOKS_LOCK:
+    if fn not in _INVALIDATION_HOOKS:
+      _INVALIDATION_HOOKS.append(fn)
+
+
+def unregister_invalidation_hook(fn) -> None:
+  with _HOOKS_LOCK:
+    try:
+      _INVALIDATION_HOOKS.remove(fn)
+    except ValueError:
+      pass
+
+
+def _notify_hooks(path: str, mip: Optional[int]) -> None:
+  with _HOOKS_LOCK:
+    hooks = list(_INVALIDATION_HOOKS)
+  for fn in hooks:
+    try:
+      fn(path, mip)
+    except Exception:
+      telemetry.incr("chunk_cache.hook_failed")
+
 
 def shared_cache() -> ChunkDecodeCache:
   global _SHARED
@@ -189,6 +226,9 @@ def store(key: tuple, arr: np.ndarray) -> np.ndarray:
 
 
 def invalidate(path: str, mip: Optional[int] = None) -> int:
+  # hooks fire even when the decode cache was never instantiated: a
+  # serve tier may be the only cache alive in this process
+  _notify_hooks(path, mip)
   if _SHARED is None:
     return 0
   return _SHARED.invalidate(path, mip)
@@ -196,10 +236,10 @@ def invalidate(path: str, mip: Optional[int] = None) -> int:
 
 def invalidate_writes(writes: Iterable[Tuple[str, int]]) -> None:
   """Invalidate a StagePlan-style set of (layer path, mip) writes."""
-  if _SHARED is None:
-    return
   for path, mip in writes:
-    _SHARED.invalidate(path, mip)
+    _notify_hooks(path, mip)
+    if _SHARED is not None:
+      _SHARED.invalidate(path, mip)
 
 
 def clear() -> None:

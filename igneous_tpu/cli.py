@@ -2345,6 +2345,101 @@ def view_cmd(path, port, browser, ng, pos, name, indirect):
   )
 
 
+@main.command("serve")
+@click.argument("paths", nargs=-1, required=True)
+@click.option("--port", default=8080, show_default=True)
+@click.option("--host", default="0.0.0.0", show_default=True)
+@click.option("--ram-mb", default=None, type=float,
+              help="RAM cache budget (env IGNEOUS_SERVE_RAM_MB; default 256).")
+@click.option("--ssd-dir", default=None,
+              help="Local-SSD spill directory (env IGNEOUS_SERVE_SSD_DIR; "
+                   "default off). Entries survive restarts.")
+@click.option("--ssd-mb", default=None, type=float,
+              help="SSD spill budget (env IGNEOUS_SERVE_SSD_MB; default 4096).")
+@click.option("--synth/--no-synth", default=None,
+              help="Synthesize missing mips on the fly from the parent "
+                   "scale (env IGNEOUS_SERVE_SYNTH_MIPS; default on).")
+@click.option("--writeback/--no-writeback", default=None,
+              help="Write synthesized mips back to storage "
+                   "(env IGNEOUS_SERVE_WRITEBACK; default off).")
+@click.option("--cache-control", default=None,
+              help="Cache-Control header for CDN fronting "
+                   "(env IGNEOUS_SERVE_CACHE_CONTROL; "
+                   "default 'public, max-age=300').")
+@click.option("--journal", default=None,
+              help="Journal cloudpath for request traces "
+                   "(env IGNEOUS_JOURNAL).")
+@click.option("--metrics-port", default=None, type=int,
+              help="Prometheus /metrics port (also served inline at "
+                   "/metrics on the main port).")
+def serve_cmd(paths, port, host, ram_mb, ssd_dir, ssd_mb, synth, writeback,
+              cache_control, journal, metrics_port):
+  """Serve one or more Precomputed layers over HTTP (ISSUE 9).
+
+  PATHS are cloudpaths, optionally named: ``name=gs://bucket/layer``.
+  A single unnamed path also serves at the root (view parity); multiple
+  layers serve under ``/<name>/``. The hot path hands stored bytes to
+  clients without decoding (Content-Encoding negotiation), a multi-tier
+  cache (RAM -> local SSD -> CDN via strong ETags) absorbs re-reads,
+  concurrent misses for one chunk coalesce into a single backend fetch,
+  and missing mips are synthesized through the device downsample
+  kernels. SIGTERM drains gracefully and exits 0.
+  """
+  import json as json_mod
+  import os as os_mod
+  import signal as signal_mod
+  import socket as socket_mod
+
+  from .observability import journal as journal_mod
+  from .observability import prom
+  from .serve import ServeApp, ServeConfig, ServeServer
+
+  layers = {}
+  for spec in paths:
+    if "=" in spec.split("://")[0]:
+      name, _, cloudpath = spec.partition("=")
+    else:
+      cloudpath = spec
+      name = cloudpath.rstrip("/").split("/")[-1] or "layer"
+    if name in layers:
+      raise click.UsageError(f"duplicate layer name: {name!r}")
+    layers[name] = cloudpath
+  default_layer = next(iter(layers)) if len(layers) == 1 else None
+
+  jpath = journal if journal is not None else os_mod.environ.get(
+    journal_mod.PATH_ENV
+  )
+  if jpath:
+    worker_id = f"serve-{socket_mod.gethostname().split('.')[0]}-{os_mod.getpid()}"
+    journal_mod.set_active(journal_mod.Journal(jpath, worker_id=worker_id))
+  journal_mod.install_last_will({"role": "serve"})
+
+  config = ServeConfig.from_env(
+    ram_mb=ram_mb, ssd_dir=ssd_dir, ssd_mb=ssd_mb, synth_mips=synth,
+    writeback=writeback, cache_control=cache_control,
+  )
+  app = ServeApp(layers, config=config, default_layer=default_layer)
+  server = ServeServer(app, host=host, port=port,
+                       drain_timeout=config.drain_sec)
+  if metrics_port is not None:
+    bound = prom.start_http_server(metrics_port)
+    if bound is not None:
+      click.echo(f"metrics: http://0.0.0.0:{bound}/metrics")
+  # machine-parsable readiness line (the CI smoke and orchestration
+  # scripts wait on this rather than polling the port)
+  click.echo(json_mod.dumps({
+    "event": "serve.listening", "port": server.server_address[1],
+    "host": host, "layers": sorted(layers),
+  }), nl=True)
+
+  def _on_signal(_signum, _frame):
+    server.request_shutdown()
+
+  signal_mod.signal(signal_mod.SIGTERM, _on_signal)
+  signal_mod.signal(signal_mod.SIGINT, _on_signal)
+  server.join()
+
+
 @main.command("license")
 def license_cmd():
   click.echo("igneous-tpu is licensed under the BSD 3-Clause license.")

@@ -98,6 +98,13 @@ class HealthConfig:
   recompiles_per_min_max: float = 10.0
   hbm_highwater_frac: float = 0.9
   device_idle_ratio: float = 0.05
+  # serving tier (ISSUE 9): p99 request-latency SLO (ms; None = no
+  # latency SLO on serving), cold-miss storm = backend-fetch fraction of
+  # requests above this with at least min_requests in window (the cache
+  # is being bypassed or thrashed — every client hits origin)
+  serve_p99_ms: Optional[float] = None
+  serve_miss_ratio_max: float = 0.9
+  serve_min_requests: int = 50
 
   _ENV = {
     "window_sec": "IGNEOUS_HEALTH_WINDOW_SEC",
@@ -118,6 +125,9 @@ class HealthConfig:
     "recompiles_per_min_max": "IGNEOUS_HEALTH_RECOMPILES_PER_MIN",
     "hbm_highwater_frac": "IGNEOUS_HEALTH_HBM_FRAC",
     "device_idle_ratio": "IGNEOUS_HEALTH_DEVICE_IDLE_RATIO",
+    "serve_p99_ms": "IGNEOUS_SERVE_SLO_P99_MS",
+    "serve_miss_ratio_max": "IGNEOUS_SERVE_MISS_RATIO",
+    "serve_min_requests": "IGNEOUS_SERVE_MIN_REQUESTS",
   }
 
   @classmethod
@@ -140,6 +150,7 @@ class HealthConfig:
     cfg.straggler_min_tasks = int(cfg.straggler_min_tasks)
     cfg.min_workers = int(cfg.min_workers)
     cfg.max_workers = int(cfg.max_workers)
+    cfg.serve_min_requests = int(cfg.serve_min_requests)
     return cfg
 
 
@@ -176,6 +187,8 @@ class HealthEngine:
     device_latest: dict = {}    # worker -> newest cumulative device ledger
     device_earliest: dict = {}  # worker -> oldest in-window ledger (rates)
     stall_total = work_total = 0.0
+    serve_durs: list = []       # serve.request spans in window (seconds)
+    serve_fetches = 0           # serve.fetch spans in window (origin trips)
 
     def seen(worker, ts):
       # "health-*" actors are check/cron processes appending health.*
@@ -212,7 +225,12 @@ class HealthEngine:
         return
       if any(m in name for m in fleet.STALL_MARKERS):
         stall_total += total
-      elif name != "task" and not name.startswith("health."):
+      elif (
+        name != "task" and not name.startswith("health.")
+        and not name.startswith("serve.")
+      ):
+        # serve.* spans are request latency, not pipeline work — they
+        # get their own detectors below, not the stall-ratio one
         work_total += total
 
     for rec in records:
@@ -252,7 +270,13 @@ class HealthEngine:
           take_task(rec)
         else:
           seen(worker, float(ts) + float(dur))
-          take_stage(rec.get("name", "span"), float(dur))
+          name = rec.get("name", "span")
+          if float(ts) + float(dur) >= now - cfg.window_sec:
+            if name == "serve.request":
+              serve_durs.append(float(dur))
+            elif name == "serve.fetch":
+              serve_fetches += 1
+          take_stage(name, float(dur))
 
     # a worker silent past forget_sec is history, not a detector target
     per = {
@@ -270,6 +294,8 @@ class HealthEngine:
       "work_total": work_total,
       "device_latest": device_latest,
       "device_earliest": device_earliest,
+      "serve_durs": serve_durs,
+      "serve_fetches": serve_fetches,
     }
 
   # -- evaluation -----------------------------------------------------------
@@ -425,6 +451,31 @@ class HealthEngine:
           "backlog": backlog,
         })
 
+    # serving-tier detectors (ISSUE 9): request latency SLO + cold-miss
+    # storm, from the per-request spans the serve tier journals
+    serve_durs = sorted(scan["serve_durs"])
+    serve_req = len(serve_durs)
+    serve_fetches = scan["serve_fetches"]
+    serve_p50 = _percentile(serve_durs, 0.50)
+    serve_p99 = _percentile(serve_durs, 0.99)
+    serve_miss_ratio = (serve_fetches / serve_req) if serve_req else None
+    if (
+      serve_req >= cfg.serve_min_requests
+      and serve_miss_ratio is not None
+      and serve_miss_ratio > cfg.serve_miss_ratio_max
+    ):
+      anomalies.append({
+        "kind": "cold_miss_storm", "requests": serve_req,
+        "backend_fetches": serve_fetches,
+        "miss_ratio": round(serve_miss_ratio, 3),
+        "max": cfg.serve_miss_ratio_max,
+      })
+    if cfg.serve_p99_ms and serve_p99 * 1e3 > cfg.serve_p99_ms:
+      anomalies.append({
+        "kind": "serve_latency_slo", "p99_ms": round(serve_p99 * 1e3, 1),
+        "target_ms": cfg.serve_p99_ms, "requests": serve_req,
+      })
+
     # SLO burn: error-budget consumption rate (1.0 = burning exactly at
     # budget; >1 = on track to violate the SLO)
     success_rate = (tasks_ok / tasks_total) if tasks_total else None
@@ -434,6 +485,8 @@ class HealthEngine:
       burn = (1.0 - success_rate) / err_budget
     if cfg.slo_p95_ms and fleet_p95 > 0:
       burn = max(burn, (fleet_p95 * 1e3) / cfg.slo_p95_ms)
+    if cfg.serve_p99_ms and serve_p99 > 0:
+      burn = max(burn, (serve_p99 * 1e3) / cfg.serve_p99_ms)
     burn = round(burn, 3)
 
     # autoscale: workers active now vs workers needed to drain the
@@ -507,6 +560,17 @@ class HealthEngine:
       },
       "workers": workers_report,
     }
+    if serve_req > 0:
+      report["serve"] = {
+        "requests": serve_req,
+        "backend_fetches": serve_fetches,
+        "p50_ms": round(serve_p50 * 1e3, 1),
+        "p99_ms": round(serve_p99 * 1e3, 1),
+        "miss_ratio": (
+          round(serve_miss_ratio, 3) if serve_miss_ratio is not None else None
+        ),
+        "p99_target_ms": cfg.serve_p99_ms,
+      }
     from . import device as device_mod
 
     report["devices"] = device_mod.fleet_summary(device_ledgers)
@@ -535,6 +599,12 @@ def publish_gauges(report: dict) -> None:
     metrics.gauge_set("fleet.device_dispatches", dev["dispatches"])
     if dev.get("hbm_peak_frac") is not None:
       metrics.gauge_set("fleet.device_hbm_peak_frac", dev["hbm_peak_frac"])
+  srv = report.get("serve")
+  if srv:
+    metrics.gauge_set("fleet.serve_requests", srv["requests"])
+    metrics.gauge_set("fleet.serve_p99_ms", srv["p99_ms"])
+    if srv.get("miss_ratio") is not None:
+      metrics.gauge_set("fleet.serve_miss_ratio", srv["miss_ratio"])
 
 
 def health_events(report: dict) -> List[dict]:
@@ -621,6 +691,14 @@ def check_lines(report: dict) -> List[str]:
     f"{a['per_worker_tasks_per_sec']} tasks/s/worker"
     + (", damped)" if a["hysteresis_damped"] else ")"),
   ]
+  srv = report.get("serve")
+  if srv:
+    lines.insert(3, (
+      f"serve: {srv['requests']} requests  p50 {srv['p50_ms']}ms "
+      f"p99 {srv['p99_ms']}ms  miss {srv['miss_ratio']}"
+      + (f" (p99 target {srv['p99_target_ms']}ms)"
+         if srv.get("p99_target_ms") else "")
+    ))
   for s in report["stragglers"]:
     if s["kind"] == "stalled":
       lines.append(

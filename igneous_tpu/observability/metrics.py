@@ -156,6 +156,30 @@ def histograms_snapshot() -> Dict[str, dict]:
     }
 
 
+def histogram_quantile(name: str, q: float) -> Optional[float]:
+  """Approximate quantile (seconds) of a timer from its log-scale
+  histogram — the upper bound of the bucket holding the q-th
+  observation, Prometheus ``histogram_quantile`` style. The serve tier's
+  p50/p99 gauges and the bench read latency through this; None when the
+  timer has no observations. The overflow bucket reports the top bound
+  (the histogram cannot resolve beyond it)."""
+  with _COUNTERS_LOCK:
+    buckets = _HISTOGRAMS.get(name)
+    if buckets is None:
+      return None
+    buckets = list(buckets)
+  total = sum(buckets)
+  if total == 0:
+    return None
+  rank = max(1, int(q * total + 0.5))
+  cum = 0
+  for i, count in enumerate(buckets):
+    cum += count
+    if cum >= rank:
+      return HISTOGRAM_BUCKETS[min(i, len(HISTOGRAM_BUCKETS) - 1)]
+  return HISTOGRAM_BUCKETS[-1]
+
+
 def timer_totals() -> Dict[str, dict]:
   """Raw (sum, count) per timer, no gauges mixed in (Prometheus export)."""
   with _COUNTERS_LOCK:

@@ -23,6 +23,7 @@ def chrome_trace(records: Iterable[dict],
   events = []
   pids = {}  # worker -> pid
   device_tids = {}  # (pid, device label) -> tid
+  serve_tids = {}   # (pid, layer) -> tid
   t0 = None
 
   spans = [
@@ -53,6 +54,15 @@ def chrome_trace(records: Iterable[dict],
       tid = device_tids.setdefault(
         (pid, rec["device"]), 10_000 + len(device_tids)
       )
+    elif name.startswith("serve.") and rec.get("layer"):
+      # serving tier (ISSUE 9): request/fetch/decode spans render on one
+      # track per served layer — a layer's request timeline reads
+      # contiguously instead of scattering across per-trace rows (every
+      # request is its own trace). tids 20000+ stay clear of both the
+      # device tracks and the hashed task rows.
+      tid = serve_tids.setdefault(
+        (pid, rec["layer"]), 20_000 + len(serve_tids)
+      )
     else:
       # one row per trace inside the worker keeps concurrent tasks from
       # visually stacking into one another
@@ -77,6 +87,11 @@ def chrome_trace(records: Iterable[dict],
     events.append({
       "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
       "args": {"name": f"device {dev}"},
+    })
+  for (pid, layer), tid in serve_tids.items():
+    events.append({
+      "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+      "args": {"name": f"serve {layer}"},
     })
 
   return {

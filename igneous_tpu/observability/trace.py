@@ -233,6 +233,28 @@ def event(name: str, **attrs) -> None:
   record_span(name, 0.0, **attrs)
 
 
+def record_at(name: str, ts: float, dur: float, trace_id: str,
+              span_id: Optional[str] = None, parent: Optional[str] = None,
+              **attrs) -> Optional[str]:
+  """Record a span with fully explicit identity (trace, span, parent).
+
+  The serve tier needs this: its request handlers interleave on ONE
+  event-loop thread, so the thread-local context of :func:`span` cannot
+  carry per-request identity. Returns the span id recorded (minted when
+  ``span_id`` is None), or None when tracing is off."""
+  if not tracing_enabled():
+    return None
+  sid = span_id or new_id()
+  rec = {
+    "trace": trace_id, "span": sid, "parent": parent,
+    "name": name, "ts": float(ts), "dur": float(dur),
+  }
+  if attrs:
+    rec.update(attrs)
+  _record(rec)
+  return sid
+
+
 def record_root(name: str, ts: float, dur: float,
                 trace_id: Optional[str] = None, **attrs) -> None:
   """Record a span with explicit timing under an explicit trace

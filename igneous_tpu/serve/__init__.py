@@ -1,0 +1,40 @@
+"""`igneous serve` — the interactive Precomputed serving tier (ISSUE 9).
+
+An async HTTP server fronting one or many layers from any storage
+backend, with a multi-tier stored-bytes cache (RAM LRU → local-SSD spill
+→ CDN via strong ETags), request coalescing (N clients, one backend
+fetch), and on-the-fly synthesis of missing mips through the device
+pool's downsample kernels.
+
+Quick start::
+
+    from igneous_tpu.serve import start_server
+    server = start_server("gs://bucket/layer", port=8080)
+    ...
+    server.shutdown()
+
+or from the CLI: ``igneous serve gs://bucket/layer --port 8080``.
+"""
+
+from .app import LayerHandle, ServeApp, ServeConfig
+from .cache import Entry, TieredStoredCache, strong_etag
+from .server import HttpServer, Request, Response, ServeServer
+
+
+def start_server(layers, host: str = "0.0.0.0", port: int = 0,
+                 config: ServeConfig = None,
+                 default_layer: str = None) -> ServeServer:
+  """Build a :class:`ServeApp` over ``layers`` (a cloudpath string or a
+  ``{name: cloudpath}`` dict) and start serving on a background thread.
+  Returns the :class:`ServeServer` handle (``.server_address``,
+  ``.shutdown()``)."""
+  app = ServeApp(layers, config=config, default_layer=default_layer)
+  cfg = app.config
+  return ServeServer(app, host=host, port=port, drain_timeout=cfg.drain_sec)
+
+
+__all__ = [
+  "Entry", "HttpServer", "LayerHandle", "Request", "Response",
+  "ServeApp", "ServeConfig", "ServeServer", "TieredStoredCache",
+  "start_server", "strong_etag",
+]

@@ -92,6 +92,22 @@ def wire_ext(compress) -> Optional[str]:
     return None
 
 
+def method_for_ext(ext: str) -> Optional[str]:
+  """Inverse of :func:`wire_ext`: the compression method a stored
+  filename extension implies (None for "" — uncompressed). The serve
+  tier's SSD spill mirrors the CloudFiles file layout, so reading a
+  spilled ``<key>.gz`` back recovers the wire method from the name."""
+  if not ext:
+    return None
+  return _EXT_TO_COMPRESSION.get(ext)
+
+
+def stored_exts() -> Tuple[str, ...]:
+  """Every extension a stored object may carry ("" first — probe order
+  matches :meth:`CloudFiles._resolve`)."""
+  return ("",) + tuple(_EXT_TO_COMPRESSION)
+
+
 def scratch_compression(default="gzip"):
   """Compression for INTERMEDIATE artifacts (.frags containers, CCL face
   planes, transfer scratch) — objects a later merge/fixup task consumes

@@ -2,98 +2,17 @@
 
 Reference capability: `igneous view` (cli.py:1735-1850) serves a local
 layer over HTTP with CORS so the public Neuroglancer webapp can display
-it. The server maps URL paths directly onto the layer's storage keys
-(decompressing the .gz layout transparently).
+it. Since ISSUE 9 this is the single-layer mode of the serving tier
+(:mod:`igneous_tpu.serve`) rather than its own handler: the dev server
+and the production tier share one request path — CORS wildcard,
+path-traversal guard, Range/206 for sharded reads, transparent ``.gz``
+layout decompression (with gzip passthrough when the client accepts it),
+plus the serve tier's caching, coalescing, and per-request traces.
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
-from .storage import CloudFiles
-
-
-def make_handler(cf: CloudFiles):
-  class Handler(BaseHTTPRequestHandler):
-    def log_message(self, *args):
-      pass
-
-    def _cors(self):
-      self.send_header("Access-Control-Allow-Origin", "*")
-      self.send_header("Access-Control-Allow-Headers", "*")
-
-    def do_OPTIONS(self):
-      self.send_response(204)
-      self._cors()
-      self.end_headers()
-
-    def do_GET(self):
-      import posixpath
-
-      key = posixpath.normpath(self.path.split("?")[0].lstrip("/"))
-      # never allow escaping the served layer (the CORS wildcard makes
-      # any traversal remotely exploitable)
-      if key.startswith("..") or key.startswith("/") or key == ".":
-        self.send_response(403)
-        self._cors()
-        self.end_headers()
-        return
-      # HTTP Range support: Neuroglancer's sharded reader fetches the
-      # fixed index, minishard indices, and fragment payloads via
-      # `Range: bytes=a-b` — without 206 responses every shard read
-      # would pull the whole (possibly multi-GB) shard file
-      rng = self.headers.get("Range")
-      if rng and rng.startswith("bytes="):
-        try:
-          start_s, end_s = rng[len("bytes="):].split("-", 1)
-          start = int(start_s)
-          length = (int(end_s) - start + 1) if end_s else None
-        except ValueError:
-          start, length = 0, None
-        data = (
-          cf.get_range(key, start, length)
-          if length is not None else None
-        )
-        if data is None:
-          # open-ended range, or a gzip-stored key that ranged raw reads
-          # cannot serve: fall back to a full get + slice
-          full = cf.get(key)
-          if full is None:
-            self.send_response(404)
-            self._cors()
-            self.end_headers()
-            return
-          data = full[start:] if length is None else full[start:start + length]
-        self.send_response(206)
-        self._cors()
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(data)))
-        self.send_header(
-          "Content-Range", f"bytes {start}-{start + len(data) - 1}/*"
-        )
-        self.end_headers()
-        self.wfile.write(data)
-        return
-      data = cf.get(key)
-      if data is None:
-        self.send_response(404)
-        self._cors()
-        self.end_headers()
-        return
-      self.send_response(200)
-      self._cors()
-      if key.endswith("info") or key.endswith(".json"):
-        self.send_header("Content-Type", "application/json")
-      else:
-        self.send_header("Content-Type", "application/octet-stream")
-      self.send_header("Content-Length", str(len(data)))
-      self.end_headers()
-      self.wfile.write(data)
-
-  return Handler
 
 
 def neuroglancer_url(
@@ -124,18 +43,27 @@ def serve(
   ng_url: "str | None" = None,
   position=None,
   layer_name: "str | None" = None,
-) -> Optional[ThreadingHTTPServer]:
+):
   """Serve a layer for Neuroglancer; returns the server when block=False.
   ``browser`` opens the link in the system browser; ``ng_url`` swaps the
   Neuroglancer deployment; ``position`` centers the view (reference
-  `igneous view` --browser/--ng/--pos/--name, cli.py:1735-1850)."""
-  cf = CloudFiles(cloudpath)
-  httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(cf))
-  port = httpd.server_address[1]  # resolves port=0 to the bound port
-  info = cf.get_json("info") or {}
+  `igneous view` --browser/--ng/--pos/--name, cli.py:1735-1850).
+
+  The returned handle keeps the old dev-server surface:
+  ``.server_address`` is ``(host, port)`` and ``.shutdown()`` blocks
+  until the server drains."""
+  from .serve import ServeApp, ServeConfig, ServeServer
+  from .storage import CloudFiles
+
+  name = layer_name or cloudpath.rstrip("/").split("/")[-1] or "layer"
+  app = ServeApp({name: cloudpath}, default_layer=name,
+                 config=ServeConfig.from_env())
+  server = ServeServer(app, host="0.0.0.0", port=port,
+                       drain_timeout=app.config.drain_sec)
+  port = server.server_address[1]
+  info = CloudFiles(cloudpath).get_json("info") or {}
   url = neuroglancer_url(
-    port, layer_name or cloudpath.rstrip("/").split("/")[-1],
-    info.get("type", "image"), ng_url=ng_url, position=position,
+    port, name, info.get("type", "image"), ng_url=ng_url, position=position,
   )
   print(f"Serving {cloudpath} at http://localhost:{port}")
   print(f"View in Neuroglancer:\n  {url}")
@@ -145,12 +73,10 @@ def serve(
     webbrowser.open(url, new=2)
   if block:
     try:
-      httpd.serve_forever()
+      server.join()
     except KeyboardInterrupt:
       pass
     finally:
-      httpd.shutdown()
+      server.shutdown()
     return None
-  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
-  thread.start()
-  return httpd
+  return server

@@ -1,0 +1,523 @@
+"""ISSUE 9: the interactive serving tier — byte identity across stored
+encodings, strong ETags (restart-stable, overwrite-invalidated), SSD
+spill round-trips, single-flight request coalescing, on-the-fly mip
+synthesis vs the offline DownsampleTask, per-request traces in the
+journal, and the hot-path guarantee (RAM hit = zero decodes + zero
+storage round-trips)."""
+
+import gzip
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from igneous_tpu import chunk_cache, task_creation as tc
+from igneous_tpu.observability import journal as journal_mod
+from igneous_tpu.observability import metrics, trace
+from igneous_tpu.queues import LocalTaskQueue
+from igneous_tpu.serve import ServeApp, ServeConfig, ServeServer
+from igneous_tpu.storage import CloudFiles, clear_memory_storage, set_backend_wrapper
+from igneous_tpu.volume import Volume
+
+CHUNK = "1_1_1/0-64_0-64_0-64"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  clear_memory_storage()
+  chunk_cache.clear()
+  yield
+  set_backend_wrapper(None)
+  journal_mod.set_active(None)
+  clear_memory_storage()
+
+
+def _get(port, path, headers=None):
+  """(status, headers-dict, body) over a fresh connection."""
+  conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+  try:
+    conn.request("GET", path, headers=headers or {})
+    resp = conn.getresponse()
+    return resp.status, dict(resp.getheaders()), resp.read()
+  finally:
+    conn.close()
+
+
+def _serve(layers, **cfg_kw):
+  config = ServeConfig(**{"ram_mb": 64.0, "synth_mips": False, **cfg_kw})
+  default = next(iter(layers)) if len(layers) == 1 else None
+  app = ServeApp(dict(layers), config=config, default_layer=default)
+  return ServeServer(app, host="127.0.0.1", port=0)
+
+
+# ---------------------------------------------------------------------------
+# byte identity across stored encodings
+
+
+def _seed(path, rng, layer_type="image", encoding="raw", compress="gzip"):
+  dtype = np.uint8 if layer_type == "image" else np.uint32
+  data = rng.integers(0, 200, (64, 64, 64)).astype(dtype)
+  Volume.from_numpy(
+    data, path, chunk_size=(64, 64, 64), layer_type=layer_type,
+    encoding=encoding, compress=compress,
+  )
+  return data
+
+
+@pytest.mark.parametrize("layer_type,encoding,compress", [
+  ("image", "raw", None),
+  ("image", "raw", "gzip"),
+  ("segmentation", "compressed_segmentation", "gzip"),
+])
+def test_served_bytes_identity(rng, layer_type, encoding, compress):
+  path = "mem://serve/ident"
+  _seed(path, rng, layer_type, encoding, compress)
+  cf = CloudFiles(path)
+  stored, method = cf.get_stored(CHUNK)
+  logical = cf.get(CHUNK)
+  srv = _serve({"ident": path})
+  try:
+    port = srv.server_address[1]
+    # client accepts gzip: wire bytes verbatim, correct Content-Encoding
+    status, headers, body = _get(port, f"/{CHUNK}",
+                                 {"Accept-Encoding": "gzip"})
+    assert status == 200
+    if method == "gzip":
+      assert headers.get("Content-Encoding") == "gzip"
+      assert body == stored
+      assert gzip.decompress(body) == logical
+    else:
+      assert "Content-Encoding" not in headers
+      assert body == stored == logical
+    # client without gzip: transparently decompressed to the codec bytes
+    status, headers, body = _get(port, f"/{CHUNK}")
+    assert status == 200
+    assert "Content-Encoding" not in headers
+    assert body == logical
+  finally:
+    srv.shutdown()
+
+
+def test_info_content_type_and_index(rng):
+  path = "mem://serve/ct"
+  _seed(path, rng)
+  srv = _serve({"ct": path})
+  try:
+    port = srv.server_address[1]
+    status, headers, body = _get(port, "/info")
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    assert json.loads(body)["type"] == "image"
+    status, headers, body = _get(port, f"/{CHUNK}")
+    assert headers["Content-Type"] == "application/octet-stream"
+    # multi-layer routing serves under /<name>/ too
+    status, _, body2 = _get(port, f"/ct/{CHUNK}")
+    assert status == 200 and body2 == body
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ETags: stable across restarts, invalidated on overwrite
+
+
+def test_etag_restart_stability_and_overwrite(rng, tmp_path):
+  path = "mem://serve/etag"
+  _seed(path, rng)
+  ssd = str(tmp_path / "spill")
+
+  srv = _serve({"etag": path}, ssd_dir=ssd, ssd_mb=64.0)
+  try:
+    port = srv.server_address[1]
+    _, h1, b1 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    etag1 = h1["ETag"]
+    assert etag1.startswith('"') and etag1.endswith('"')
+    # conditional revalidation
+    status, h304, body = _get(port, f"/{CHUNK}", {
+      "Accept-Encoding": "gzip", "If-None-Match": etag1,
+    })
+    assert status == 304 and body == b""
+    assert h304["ETag"] == etag1
+    assert "Cache-Control" in h1 and "max-age" in h1["Cache-Control"]
+  finally:
+    srv.shutdown()
+
+  # a fresh server over the same spill dir re-derives the same ETag
+  # (strong digest of the stored bytes) and serves from the SSD tier
+  srv = _serve({"etag": path}, ssd_dir=ssd, ssd_mb=64.0)
+  try:
+    port = srv.server_address[1]
+    _, h2, b2 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h2["ETag"] == etag1
+    assert b2 == b1
+    assert h2["X-Igneous-Cache"] in ("ssd", "ram")
+
+    # overwrite through Volume.upload: the shared chunk_cache
+    # invalidation hook must drop every serving tier for the mip
+    vol = Volume(path)
+    newdata = rng.integers(0, 200, (64, 64, 64)).astype(np.uint8) + 55
+    vol.upload(vol.meta.bounds(0), newdata.astype(np.uint8), mip=0)
+    _, h3, b3 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h3["ETag"] != etag1
+    stored, _ = CloudFiles(path).get_stored(CHUNK)
+    assert b3 == stored
+  finally:
+    srv.shutdown()
+
+
+def test_ssd_spill_roundtrip_identity(rng, tmp_path):
+  path = "mem://serve/spill"
+  _seed(path, rng)
+  stored, _ = CloudFiles(path).get_stored(CHUNK)
+  # ram_mb=0: every hit must come off disk — proves the spill file is
+  # byte-identical to the origin object
+  srv = _serve({"spill": path}, ram_mb=0.0,
+               ssd_dir=str(tmp_path / "spill"), ssd_mb=64.0)
+  try:
+    port = srv.server_address[1]
+    _, h1, b1 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h1["X-Igneous-Cache"] == "origin"
+    _, h2, b2 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h2["X-Igneous-Cache"] == "ssd"
+    assert b1 == b2 == stored
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# request coalescing: N concurrent clients, exactly one backend fetch
+
+
+class _CountingBackend:
+  def __init__(self, inner, counts, delay):
+    self._inner = inner
+    self._counts = counts
+    self._delay = delay
+
+  def get(self, key):
+    with self._counts["lock"]:
+      self._counts[key] = self._counts.get(key, 0) + 1
+    import time as _t
+
+    _t.sleep(self._delay)
+    return self._inner.get(key)
+
+  def __getattr__(self, name):
+    return getattr(self._inner, name)
+
+
+def test_single_flight_coalescing(rng):
+  path = "mem://serve/herd"
+  _seed(path, rng, compress=None)  # exact-key layout: 1 fetch = 1 get
+  counts = {"lock": threading.Lock()}
+  # install BEFORE the app constructs its CloudFiles handles
+  set_backend_wrapper(lambda b, pth: _CountingBackend(b, counts, 0.25))
+  srv = _serve({"herd": path})
+  try:
+    port = srv.server_address[1]
+    n = 8
+    barrier = threading.Barrier(n)
+    bodies = [None] * n
+
+    def client(i):
+      barrier.wait()
+      _, _, bodies[i] = _get(port, f"/{CHUNK}")
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    assert counts.get(CHUNK, 0) == 1, (
+      f"expected exactly 1 backend fetch, saw {counts.get(CHUNK)}"
+    )
+    expect = CloudFiles(path).get(CHUNK)
+    assert all(b == expect for b in bodies)
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the hot-path guarantee: RAM hit = zero decodes + zero storage trips
+
+
+def test_hot_hit_zero_decode_zero_storage(rng, monkeypatch):
+  path = "mem://serve/hot"
+  _seed(path, rng)  # gzip-stored
+  srv = _serve({"hot": path})
+  try:
+    port = srv.server_address[1]
+    _, _, warm = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+
+    # poison everything below the RAM tier: any origin fetch or wire
+    # decode now blows up the request (500), so a passing assert proves
+    # the hit path touched neither
+    from igneous_tpu.serve import app as app_mod
+
+    def boom(*a, **kw):
+      raise AssertionError("hot path touched storage/codec")
+
+    monkeypatch.setattr(app_mod.ServeApp, "_fetch_blocking", boom)
+    monkeypatch.setattr(app_mod, "decompress_bytes", boom)
+
+    status, headers, body = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert status == 200
+    assert headers["X-Igneous-Cache"] == "ram"
+    assert body == warm
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# on-the-fly mips
+
+
+def _seed_with_mip1(path, rng, materialize):
+  """Layer with a mip-1 scale in the info; chunks exist only when
+  ``materialize``. Returns the mip-1 chunk keys."""
+  data = rng.integers(0, 200, (64, 64, 64)).astype(np.uint8)
+  Volume.from_numpy(data, path, chunk_size=(32, 32, 32))
+  tasks = tc.create_downsampling_tasks(
+    path, num_mips=1, memory_target=16 * 1024 * 1024
+  )
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+  vol = Volume(path)
+  keys = [
+    k for k in vol.cf.list(f"{vol.meta.key(1)}/")
+  ]
+  assert keys
+  if not materialize:
+    for k in keys:
+      vol.cf.delete(k)
+  return data, sorted(keys)
+
+
+def test_synth_mip_matches_offline_downsample(rng):
+  # reference: the offline DownsampleTask output, left in place
+  ref_path = "mem://serve/synthref"
+  data, keys = _seed_with_mip1(ref_path, rng, materialize=True)
+  ref_cf = CloudFiles(ref_path)
+
+  # served layer: identical mip0 + scale, mip1 chunks deleted
+  path = "mem://serve/synth"
+  rng2 = np.random.default_rng(seed=42)
+  data2, keys2 = _seed_with_mip1(path, rng2, materialize=False)
+  assert np.array_equal(data, data2) and keys == keys2
+
+  srv = _serve({"synth": path}, synth_mips=True)
+  try:
+    port = srv.server_address[1]
+    for key in keys:
+      want, method = ref_cf.get_stored(key)
+      status, headers, body = _get(port, f"/{key}",
+                                   {"Accept-Encoding": "gzip"})
+      assert status == 200, key
+      assert headers.get("Content-Encoding") == ("gzip" if method else None)
+      assert body == want, f"synthesized {key} != offline DownsampleTask"
+    # nothing was written back by default
+    assert not list(CloudFiles(path).list("2_2_2/"))
+  finally:
+    srv.shutdown()
+
+
+def test_synth_writeback_persists(rng):
+  path = "mem://serve/syntwb"
+  data, keys = _seed_with_mip1(path, rng, materialize=False)
+  srv = _serve({"syntwb": path}, synth_mips=True, writeback=True)
+  try:
+    port = srv.server_address[1]
+    key = keys[0]
+    status, _, body = _get(port, f"/{key}", {"Accept-Encoding": "gzip"})
+    assert status == 200
+    stored, method = CloudFiles(path).get_stored(key)
+    assert stored is not None and method == "gzip"
+    assert body == stored
+  finally:
+    srv.shutdown()
+
+
+def test_synth_off_gives_404(rng):
+  path = "mem://serve/synthoff"
+  _, keys = _seed_with_mip1(path, rng, materialize=False)
+  srv = _serve({"synthoff": path}, synth_mips=False)
+  try:
+    status, _, _ = _get(srv.server_address[1], f"/{keys[0]}")
+    assert status == 404
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP semantics
+
+
+def test_traversal_forbidden_and_missing_404(rng, tmp_path):
+  secret = tmp_path / "secret.txt"
+  secret.write_text("nope")
+  layer_dir = tmp_path / "layer"
+  data = rng.integers(0, 200, (64, 64, 64)).astype(np.uint8)
+  Volume.from_numpy(data, f"file://{layer_dir}", chunk_size=(64, 64, 64))
+  srv = _serve({"layer": f"file://{layer_dir}"})
+  try:
+    port = srv.server_address[1]
+    # raw request line so urllib can't normalize the traversal away
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.putrequest("GET", "/../secret.txt", skip_host=True)
+    conn.putheader("Host", "localhost")
+    conn.endheaders()
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    assert resp.status in (403, 404)
+    assert b"nope" not in body
+    status, _, _ = _get(port, "/1_1_1/64-128_0-64_0-64")
+    assert status == 404
+  finally:
+    srv.shutdown()
+
+
+def test_healthz_and_metrics_endpoints(rng):
+  path = "mem://serve/hz"
+  _seed(path, rng)
+  srv = _serve({"hz": path})
+  try:
+    port = srv.server_address[1]
+    _, _, body = _get(port, f"/{CHUNK}")
+    status, headers, body = _get(port, "/healthz")
+    hz = json.loads(body)
+    assert status == 200 and hz["ok"] and hz["layers"] == ["hz"]
+    status, _, body = _get(port, "/metrics")
+    text = body.decode("utf8")
+    assert "igneous_serve_requests_total" in text
+    assert "igneous_serve_request_seconds" in text
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# traces + journal + health plumbing
+
+
+def test_requests_mint_traces_into_journal(rng, tmp_path):
+  path = "mem://serve/traced"
+  _seed(path, rng)
+  trace.reset()
+  jr = journal_mod.Journal(f"file://{tmp_path}/journal", worker_id="serve-t")
+  journal_mod.set_active(jr)
+  srv = _serve({"traced": path})
+  try:
+    port = srv.server_address[1]
+    _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})  # origin
+    _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})  # ram hit
+  finally:
+    srv.shutdown()  # drain flushes the journal
+
+  records = list(journal_mod.read_records(f"file://{tmp_path}/journal"))
+  spans = [r for r in records if r.get("kind") == "span"]
+  reqs = [s for s in spans if s.get("name") == "serve.request"]
+  assert len(reqs) == 2
+  assert all(s.get("layer") == "traced" for s in reqs)
+  assert {s.get("tier") for s in reqs} == {"origin", "ram"}
+  # the origin request's fetch span shares its trace (igneous fleet
+  # trace <id> renders the request tree)
+  from igneous_tpu.observability import fleet
+
+  origin = next(s for s in reqs if s["tier"] == "origin")
+  tree = fleet.trace_records(records, origin["trace"])
+  names = {s["name"] for s in tree}
+  assert "serve.request" in names and "serve.fetch" in names
+  assert fleet.render_trace(tree)
+  # counters snapshots rode the flush: per-tier cache counters journaled
+  counters = [r for r in records if r.get("kind") == "counters"]
+  merged = {}
+  for rec in counters:
+    merged.update(rec.get("counters") or {})
+  assert merged.get("serve.requests", 0) >= 2
+  assert merged.get("serve.cache.ram.hits", 0) >= 1
+
+
+def test_health_engine_serve_detectors():
+  from igneous_tpu.observability.health import HealthConfig, HealthEngine
+
+  now = 1000.0
+  records = []
+  for i in range(60):
+    records.append({
+      "kind": "span", "name": "serve.request", "worker": "s1",
+      "ts": now - 10 - i * 0.01, "dur": 0.9, "trace": f"t{i}",
+      "layer": "l",
+    })
+    records.append({
+      "kind": "span", "name": "serve.fetch", "worker": "s1",
+      "ts": now - 10 - i * 0.01, "dur": 0.8, "trace": f"t{i}",
+      "layer": "l",
+    })
+  engine = HealthEngine(HealthConfig(
+    serve_p99_ms=100.0, serve_miss_ratio_max=0.5, serve_min_requests=10,
+  ))
+  report = engine.evaluate(records, now=now)
+  assert report["serve"]["requests"] == 60
+  assert report["serve"]["miss_ratio"] == 1.0
+  kinds = {a["kind"] for a in report["anomalies"]}
+  assert "cold_miss_storm" in kinds
+  assert "serve_latency_slo" in kinds
+  assert report["slo"]["burn"] > 1.0  # p99 900ms vs 100ms target
+  assert not report["healthy"]
+  # serve spans are request latency, not pipeline stalls
+  assert report["fleet"]["stall_ratio"] is None
+  lines = "\n".join(__import__(
+    "igneous_tpu.observability.health", fromlist=["health"]
+  ).check_lines(report))
+  assert "serve:" in lines and "cold_miss_storm" in lines
+
+
+def test_perfetto_serve_track():
+  from igneous_tpu.observability.perfetto import chrome_trace
+
+  doc = chrome_trace([
+    {"kind": "span", "name": "serve.request", "worker": "s1", "trace": "t1",
+     "span": "a", "ts": 1.0, "dur": 0.01, "layer": "mylayer"},
+    {"kind": "span", "name": "serve.fetch", "worker": "s1", "trace": "t1",
+     "span": "b", "parent": "a", "ts": 1.0, "dur": 0.005, "layer": "mylayer"},
+  ])
+  events = doc["traceEvents"]
+  rows = [e for e in events if e.get("ph") == "X"]
+  assert {e["tid"] for e in rows} == {20_000}
+  names = [
+    e for e in events
+    if e.get("ph") == "M" and e["name"] == "thread_name"
+  ]
+  assert any(e["args"]["name"] == "serve mylayer" for e in names)
+
+
+# ---------------------------------------------------------------------------
+# the shared invalidation entry point (chunk_cache hook)
+
+
+def test_invalidation_hook_fires_without_shared_cache():
+  calls = []
+  hook = lambda path, mip: calls.append((path, mip))  # noqa: E731
+  chunk_cache.register_invalidation_hook(hook)
+  try:
+    chunk_cache.invalidate("mem://x/layer", 2)
+    assert calls == [("mem://x/layer", 2)]
+  finally:
+    chunk_cache.unregister_invalidation_hook(hook)
+  chunk_cache.invalidate("mem://x/layer", 3)
+  assert len(calls) == 1  # unregistered: no further notifications
+
+
+def test_invalidation_hook_exception_contained():
+  def bad(path, mip):
+    raise RuntimeError("hook bug")
+
+  chunk_cache.register_invalidation_hook(bad)
+  try:
+    before = metrics.counters_snapshot().get("chunk_cache.hook_failed", 0)
+    chunk_cache.invalidate("mem://x/layer", 0)  # must not raise
+    after = metrics.counters_snapshot().get("chunk_cache.hook_failed", 0)
+    assert after == before + 1
+  finally:
+    chunk_cache.unregister_invalidation_hook(bad)
