@@ -694,17 +694,27 @@ def bench_mesh_kernel():
   return batch.size / dt
 
 
-def bench_ccl_kernel(algo: str = "scan"):
+def bench_ccl_kernel(algo: str = "scan", force_device: bool = False):
   """BASELINE config 4: block CCL, BATCHED — K cutouts per shard_map
   dispatch (+ host renumber per chunk). ``algo`` selects the device
   kernel variant (scan = pointer jumps, relax = gather-free) so TPU runs
-  record the ROADMAP hardware A/B."""
+  record the ROADMAP hardware A/B.
+
+  ``force_device`` (ISSUE 10 satellite): on the CPU fallback,
+  connected_components_batch short-circuits to the native per-cutout
+  union-find and silently IGNORES the algo knob — every "relax" number
+  recorded through r05 was either null or the native path remeasured.
+  Pinning IGNEOUS_CCL_BACKEND=device makes the relax kernel itself run
+  (on the XLA CPU device). It is ~100x slower than native there, so the
+  forced measurement uses the reduced block — vox/s normalizes size."""
   from igneous_tpu.ops.ccl import connected_components_batch
 
   os.environ["IGNEOUS_CCL_DEVICE_ALGO"] = algo
+  if force_device:
+    os.environ["IGNEOUS_CCL_BACKEND"] = "device"
   try:
-    n = 64 if QUICK else 128
-    K = 4 if QUICK else 8
+    n = 64 if (QUICK or force_device) else 128
+    K = 4 if (QUICK or force_device) else 8
     rng = np.random.default_rng(0)
     lab = (rng.integers(0, 3, (K, n, n, n)) * 7).astype(np.uint32)
     connected_components_batch(lab)  # compile
@@ -714,6 +724,8 @@ def bench_ccl_kernel(algo: str = "scan"):
     return lab.size / dt
   finally:
     os.environ.pop("IGNEOUS_CCL_DEVICE_ALGO", None)
+    if force_device:
+      os.environ.pop("IGNEOUS_CCL_BACKEND", None)
 
 
 def bench_pool_ab():
@@ -755,6 +767,51 @@ def bench_pool_ab():
       float(fn(arg))
     out[name + "_voxps"] = round(arg.size / ((time.perf_counter() - t0) / iters), 1)
   return out
+
+
+def bench_infer():
+  """ISSUE 10 headline: end-to-end InferenceTask campaign — halo'd
+  download → batched jitted conv apply → overlap blend → Precomputed
+  write — through the staged pipeline on mem:// storage, with a tiny
+  fixed-seed conv net so the number tracks the machinery, not the model.
+  Returns (voxels written per second, device busy ratio over the timed
+  window, engine stats) — the busy ratio is the PR 7 ledger delta, i.e.
+  the fraction of the campaign the device actually computed."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.infer import ModelSpec, init_params, save_model
+  from igneous_tpu.observability.device import LEDGER
+  from igneous_tpu.pipeline import run_tasks_pipelined
+  from igneous_tpu.volume import Volume
+
+  rng = np.random.default_rng(0)
+  n = 128 if QUICK else 256
+  nz = 32 if QUICK else 64
+  data = rng.integers(0, 255, (n, n, nz, 1)).astype(np.uint8)
+  src = "mem://bench/infer-src"
+  model_path = "mem://bench/infer-model"
+  Volume.from_numpy(data, src, chunk_size=(64, 64, 32), layer_type="image")
+  spec = ModelSpec(
+    "convnet3d", in_channels=1, out_channels=2,
+    patch_shape=(64, 64, 32), overlap=(16, 16, 8), hidden=(8,),
+  )
+  save_model(model_path, spec, init_params(spec, seed=0))
+
+  def campaign(dest):
+    return list(tc.create_inference_tasks(
+      src, dest, model_path, shape=(128, 128, 32), batch_size=4,
+    ))
+
+  # warm run: jit compile + model load land outside the timed window,
+  # matching the steady state of a long campaign
+  run_tasks_pipelined(campaign("mem://bench/infer-warm"))
+
+  busy0 = LEDGER.busy_seconds()
+  t0 = time.perf_counter()
+  run_tasks_pipelined(campaign("mem://bench/infer-out"))
+  wall = time.perf_counter() - t0
+  busy = LEDGER.busy_seconds() - busy0
+  voxels = int(np.prod(data.shape[:3]))
+  return voxels / wall, (busy / wall if wall > 0 else None)
 
 
 def bench_pool_ab_cpu(img):
@@ -933,11 +990,13 @@ def run_bench(platform: str):
   up, down = measure_transfer_MBps()
   mesh_rate = bench_mesh_kernel()
   ccl_rate = bench_ccl_kernel("scan")
-  # run the gather-free variant on the CPU fallback too (ISSUE 4
-  # satellite): every run so far recorded null here because it was gated
-  # on platform == "tpu", so the trajectory had no number to compare when
-  # a TPU round finally lands
-  ccl_relax_rate = bench_ccl_kernel("relax")
+  # ISSUE 10 satellite: on the CPU fallback the batch entry point ignores
+  # the algo knob (native short-circuit) — force the device backend so
+  # the relax kernel itself is what gets timed
+  ccl_relax_rate = bench_ccl_kernel(
+    "relax", force_device=(platform != "tpu")
+  )
+  infer_e2e_rate, infer_busy_ratio = bench_infer()
   if platform == "tpu":
     pool_ab = bench_pool_ab()
     if pool_ab is None:
@@ -1027,6 +1086,14 @@ def run_bench(platform: str):
       "ccl_relax_kernel_voxps": (
         round(ccl_relax_rate, 1) if ccl_relax_rate is not None
         else _skip("relax kernel produced no measurement")
+      ),
+      # ISSUE 10: conv-net inference as a first-class workload — e2e
+      # voxels/s through the staged pipeline and the fraction of the
+      # campaign the device spent computing (ledger busy delta / wall)
+      "infer_e2e_voxps": round(infer_e2e_rate, 1),
+      "infer_device_busy_ratio": (
+        round(infer_busy_ratio, 4) if infer_busy_ratio is not None
+        else _skip("zero-wall inference window")
       ),
       # ISSUE 4: compressed-domain fast paths
       "codec_MBps": codec_tbl,

@@ -349,6 +349,50 @@ def image_xfer(ctx, src, dest, queue, mip, chunk_size, shape, translate,
   enqueue(queue, tasks, ctx.obj["parallel"])
 
 
+@image.command("infer")
+@click.argument("src")
+@click.argument("dest")
+@click.option("--model", "model_path", required=True,
+              help="Cloudpath of a saved model (model.json + params.npz).")
+@click.option("--queue", "-q", default=None)
+@click.option("--mip", default=0, show_default=True)
+@click.option("--shape", type=TUPLE3, default=None,
+              help="Task shape in voxels (snapped up to chunk multiples).")
+@click.option("--halo", type=TUPLE3, default=None,
+              help="Context voxels per face [default: the model overlap].")
+@click.option("--batch-size", default=4, show_default=True,
+              help="Patches per device dispatch group.")
+@click.option("--postprocess",
+              type=click.Choice(["none", "quantize", "argmax"]),
+              default="none", show_default=True,
+              help="none: float32 channels; quantize: uint8 [0,1]*255; "
+                   "argmax: uint8 channel argmax (segmentation).")
+@click.option("--fill-missing", is_flag=True)
+@click.option("--compress", default="gzip", show_default=True)
+@click.option("--chunk-size", type=TUPLE3, default=None,
+              help="Destination chunk size [default: source's].")
+@range_opts
+@click.option("--bounds-mip", default=None, type=int,
+              help="Mip the ranges are specified in [default: --mip].")
+@click.pass_context
+def image_infer(ctx, src, dest, model_path, queue, mip, shape, halo,
+                batch_size, postprocess, fill_missing, compress,
+                chunk_size, xrange, yrange, zrange, bounds_mip):
+  """Run conv-net inference over SRC into DEST (halo'd cutout →
+  jitted JAX apply → overlap blend → Precomputed output)."""
+  from . import task_creation as tc
+
+  bounds_mip = mip if bounds_mip is None else bounds_mip
+  bounds = compute_cli_bounds(src, bounds_mip, xrange, yrange, zrange)
+  tasks = tc.create_inference_tasks(
+    src, dest, model_path, mip=mip, shape=shape, halo=halo,
+    bounds=bounds, bounds_mip=bounds_mip, fill_missing=fill_missing,
+    batch_size=batch_size, postprocess=postprocess, compress=compress,
+    chunk_size=chunk_size,
+  )
+  enqueue(queue, tasks, ctx.obj["parallel"])
+
+
 @image.command("create")
 @click.argument("src")
 @click.argument("dest")

@@ -1,0 +1,21 @@
+"""Conv-net inference subsystem (ISSUE 10): model registry/loader,
+patch engine, blend machinery. The task family lives in
+``tasks/inference.py`` / ``task_creation/inference.py``."""
+
+from .registry import (
+  ARCHITECTURES,
+  InferenceModel,
+  ModelSpec,
+  clear_model_cache,
+  init_params,
+  load_model,
+  register_architecture,
+  save_model,
+)
+from .engine import (
+  apply_whole,
+  blend_weight,
+  infer_cutout,
+  patch_starts,
+  weight_sum,
+)

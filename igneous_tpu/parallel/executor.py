@@ -74,6 +74,15 @@ class BatchKernelExecutor:
   this runs it for K chunks in a single compiled program with the chunk
   axis partitioned across the mesh over ICI. Compiled variants are cached
   per input signature.
+
+  ``consts`` (ISSUE 10): a non-batched pytree — model parameters — passed
+  as ``kernel(consts, chunk)`` and replicated across the mesh instead of
+  partitioned. Passing params as a runtime argument (``in_axes=(None, 0)``)
+  rather than closing over them keeps the compiled program
+  params-independent: one model reload or A/B swap does not recompile,
+  and XLA never bakes megabytes of weights into the executable as
+  literals. Pre-stage them once with :meth:`put_consts` so the h2d cost
+  is paid per model, not per dispatch.
   """
 
   def __init__(self, kernel, mesh: Optional[Mesh] = None,
@@ -83,6 +92,7 @@ class BatchKernelExecutor:
     self.mesh = mesh if mesh is not None else make_mesh()
     self.axis = self.mesh.axis_names[0]
     self._cache = {}
+    self._consts_cache = {}
 
   @property
   def n_devices(self) -> int:
@@ -92,8 +102,34 @@ class BatchKernelExecutor:
     leaves, treedef = jax.tree.flatten(batch)
     return (treedef, tuple((l.shape, str(l.dtype)) for l in leaves))
 
-  def _build(self, example):
-    out_shape = jax.eval_shape(jax.vmap(self.kernel), example)
+  def put_consts(self, key, consts):
+    """Stage a consts pytree on device, replicated over the mesh, once
+    per ``key`` (callers use a stable identity such as the model
+    cloudpath). Returns the device pytree to pass back as ``consts=``."""
+    cache_key = (key, tuple(d.id for d in self.mesh.devices.flat))
+    if cache_key not in self._consts_cache:
+      consts = jax.tree.map(np.asarray, consts)
+      replicated = NamedSharding(self.mesh, P())
+      with device_telemetry.transfer_span(
+        "h2d", device_telemetry.nbytes_of(consts), kernel=self.name,
+        mesh=self.mesh,
+      ):
+        self._consts_cache[cache_key] = jax.tree.map(
+          lambda a: jax.device_put(a, replicated), consts
+        )
+    return self._consts_cache[cache_key]
+
+  def _build(self, example, consts=None):
+    if consts is None:
+      batched = jax.vmap(self.kernel)
+      out_shape = jax.eval_shape(batched, example)
+      in_specs = P(self.axis)
+    else:
+      batched = jax.vmap(self.kernel, in_axes=(None, 0))
+      out_shape = jax.eval_shape(batched, consts, example)
+      # P() prefix: the whole consts pytree is replicated, only the
+      # chunk batch is partitioned over the mesh axis
+      in_specs = (P(), P(self.axis))
     out_specs = jax.tree.map(lambda _: P(self.axis), out_shape)
     # check_vma off: kernels here are pure per-chunk programs with no
     # collectives, but their internal scan/while carries start from
@@ -101,18 +137,20 @@ class BatchKernelExecutor:
     # shard_map (carry input unvarying vs output varying)
     try:
       fn = _shard_map(
-        jax.vmap(self.kernel), mesh=self.mesh,
-        in_specs=P(self.axis), out_specs=out_specs, check_vma=False,
+        batched, mesh=self.mesh,
+        in_specs=in_specs, out_specs=out_specs, check_vma=False,
       )
     except TypeError:  # older jax: the parameter was named check_rep
       fn = _shard_map(
-        jax.vmap(self.kernel), mesh=self.mesh,
-        in_specs=P(self.axis), out_specs=out_specs, check_rep=False,
+        batched, mesh=self.mesh,
+        in_specs=in_specs, out_specs=out_specs, check_rep=False,
       )
     return jax.jit(fn)
 
-  def __call__(self, batch):
-    """batch: pytree of (K, ...) arrays → pytree of (K, ...) numpy."""
+  def __call__(self, batch, consts=None):
+    """batch: pytree of (K, ...) arrays → pytree of (K, ...) numpy.
+    ``consts``: optional non-batched pytree (see class docstring);
+    device arrays from :meth:`put_consts` skip the per-call h2d."""
     batch = jax.tree.map(np.asarray, batch)
     leaves = jax.tree.leaves(batch)
     k = leaves[0].shape[0]
@@ -130,13 +168,22 @@ class BatchKernelExecutor:
         ),
         batch,
       )
+    if consts is not None:
+      # numpy consts are staged ad hoc (keyed by leaf identity); callers
+      # with a stable model identity use put_consts() for real reuse
+      leaves = jax.tree.leaves(consts)
+      if any(isinstance(l, np.ndarray) for l in leaves):
+        consts = self.put_consts(tuple(id(l) for l in leaves), consts)
     sig = self._signature(batch)
+    if consts is not None:
+      sig = (sig, self._signature(consts))
     sharding = NamedSharding(self.mesh, P(self.axis))
     with device_telemetry.transfer_span(
       "h2d", device_telemetry.nbytes_of(batch), kernel=self.name,
       mesh=self.mesh,
     ):
       dev = jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+    argv = (dev,) if consts is None else (consts, dev)
     if sig not in self._cache:
       # device.compile vs device.execute split (ISSUE 7): AOT
       # lower+compile so the compile span measures XLA work alone —
@@ -146,12 +193,14 @@ class BatchKernelExecutor:
       with device_telemetry.compile_span(
         self.name, device_telemetry._devices_of(self.mesh)
       ):
-        self._cache[sig] = self._build(batch).lower(dev).compile()
+        self._cache[sig] = (
+          self._build(batch, consts).lower(*argv).compile()
+        )
     with device_telemetry.execute_span(
       self.name, elements=device_telemetry.elements_of(batch),
       nbytes=device_telemetry.nbytes_of(batch), mesh=self.mesh,
     ):
-      out = self._cache[sig](dev)
+      out = self._cache[sig](*argv)
       jax.block_until_ready(out)
     with device_telemetry.transfer_span(
       "d2h", device_telemetry.nbytes_of(out), kernel=self.name,

@@ -58,4 +58,5 @@ from .image import (
   create_transfer_tasks,
   create_voxel_counting_tasks,
 )
+from .inference import create_inference_tasks
 from ..tasks.stats import accumulate_voxel_counts, load_voxel_counts

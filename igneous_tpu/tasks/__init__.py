@@ -39,9 +39,10 @@ from .mesh_multires import (
   MultiResUnshardedMeshMergeTask,
 )
 from .contrast import CLAHETask, ContrastNormalizationTask, LuminanceLevelsTask
+from .inference import InferenceTask
 from .obsolete import (
   HyperSquareConsensusTask,
-  InferenceTask,
+  LegacyInferenceTask,
   MaskAffinitymapTask,
   WatershedRemapTask,
   register_inference_model,

@@ -1,0 +1,171 @@
+"""InferenceTask: halo'd download → jitted JAX model apply → overlap
+blend → optional argmax/quantize → Precomputed output (ISSUE 10).
+
+The Chunkflow workload shape (PAPERS.md): each grid task downloads its
+core cutout EXPANDED by a halo so every output voxel sees full model
+context, runs the patch engine (infer.engine) over the halo'd cutout,
+crops the halo back off, and uploads only the core — so adjacent tasks
+never write overlapping voxels and the write set stays provably
+chunk-aligned for the staged pipeline's overlap rules.
+
+Byte determinism rides the engine's canonical accumulation order plus
+the pipeline invariant that compute stages run in task order on the
+caller thread in both pipelined and serial modes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..lib import Bbox, Vec
+from ..queues.registry import RegisteredTask
+from ..volume import Volume
+from ..pipeline import StagePlan
+from .. import telemetry
+
+POSTPROCESS_MODES = ("none", "quantize", "argmax")
+
+# empty-cutout tasks stage as no-ops (same contract as tasks/image.py)
+_NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
+
+
+class InferenceTask(RegisteredTask):
+  """Patch-wise conv-net inference over one grid cutout.
+
+  ``model_path`` names a model saved by ``infer.registry.save_model``
+  (model.json + params.npz on any storage backend); patch size and
+  overlap come from the model spec, so the wire payload stays small and
+  every worker tiles identically. ``halo`` voxels of extra context are
+  downloaded on every face (clamped reads fill background outside the
+  volume) and cropped before upload.
+
+  ``postprocess``: ``none`` (float32 channels), ``quantize`` (clip to
+  [0,1], scale to uint8), ``argmax`` (uint8 channel argmax — a
+  segmentation-style output).
+  """
+
+  def __init__(
+    self,
+    src_path: str,
+    dest_path: str,
+    model_path: str,
+    mip: int,
+    shape: Sequence[int],
+    offset: Sequence[int],
+    halo: Sequence[int] = (0, 0, 0),
+    fill_missing: bool = False,
+    batch_size: int = 4,
+    postprocess: str = "none",
+    compress="gzip",
+  ):
+    self.src_path = src_path
+    self.dest_path = dest_path
+    self.model_path = model_path
+    self.mip = int(mip)
+    self.shape = Vec(*shape)
+    self.offset = Vec(*offset)
+    self.halo = Vec(*halo)
+    self.fill_missing = fill_missing
+    self.batch_size = int(batch_size)
+    self.postprocess = postprocess
+    self.compress = compress
+    if postprocess not in POSTPROCESS_MODES:
+      raise ValueError(
+        f"postprocess must be one of {POSTPROCESS_MODES}: {postprocess!r}"
+      )
+
+  def trace_attrs(self) -> dict:
+    return {
+      "dest": self.dest_path,
+      "model": self.model_path,
+      "mip": self.mip,
+      "bbox": f"{tuple(self.offset)}+{tuple(self.shape)}",
+    }
+
+  def _volumes_and_bounds(self):
+    # bounded=False: the halo legitimately pokes outside the volume at
+    # edges; clamped regions come back background-filled, which is the
+    # halo contract (context decays to background, core is unaffected)
+    src = Volume(
+      self.src_path, mip=self.mip, bounded=False,
+      fill_missing=self.fill_missing,
+    )
+    dest = Volume(self.dest_path, mip=self.mip)
+    core = Bbox(self.offset, self.offset + self.shape)
+    core = Bbox.intersection(core, src.bounds)
+    core = Bbox.intersection(core, dest.bounds)
+    return src, dest, core
+
+  def execute(self):
+    from ..pipeline import SerialSink
+
+    plan = self.stage_plan()
+    plan.upload(plan.compute(plan.download()), SerialSink())
+
+  def stage_plan(self):
+    src, dest, core = self._volumes_and_bounds()
+    if core.empty():
+      return _NOOP_PLAN
+    halo = Vec(*[int(v) for v in self.halo])
+    halo_bounds = Bbox(core.minpt - halo, core.maxpt + halo)
+    core_size = [int(v) for v in core.size3()]
+
+    def download():
+      with telemetry.stage("download"):
+        return src.download(halo_bounds)
+
+    def compute(image):
+      from ..infer import engine as infer_engine
+      from ..infer import registry as infer_registry
+      from ..observability.device import LEDGER
+
+      model = infer_registry.load_model(self.model_path)
+      with telemetry.stage("device_infer"):
+        out, stats = infer_engine.infer_cutout(
+          model, image, batch_size=self.batch_size,
+        )
+      # fast-path tally (ISSUE 10 satellite): real patches rode the
+      # batched dispatch; zero-padded slots are the ragged-batching
+      # loss — igneous_device_fastpath_ratio now prices it
+      LEDGER.record_fastpath(
+        batched=stats["patches"], host=stats["padded_slots"]
+      )
+      hx, hy, hz = (int(v) for v in halo)
+      out = out[hx:hx + core_size[0], hy:hy + core_size[1],
+                hz:hz + core_size[2]]
+      return self._postprocess(out, dest)
+
+    def upload(out, sink):
+      with telemetry.stage("upload"):
+        dest.upload(core, out, compress=self.compress, sink=sink)
+
+    halo_size = [int(v) for v in halo_bounds.size3()]
+    nbytes = int(np.prod(halo_size)) * 4 * src.num_channels
+    nbytes += int(np.prod(core_size)) * dest.dtype.itemsize * dest.num_channels
+    return StagePlan(
+      download, compute, upload,
+      reads={(self.src_path, self.mip)},
+      writes={(self.dest_path, self.mip)},
+      nbytes_hint=nbytes,
+      aligned_writes=self._writes_chunk_aligned(dest, core),
+    )
+
+  def _postprocess(self, out: np.ndarray, dest) -> np.ndarray:
+    if self.postprocess == "quantize":
+      out = (np.clip(out, 0.0, 1.0) * 255.0).astype(np.uint8)
+    elif self.postprocess == "argmax":
+      out = np.argmax(out, axis=3).astype(np.uint8)[..., np.newaxis]
+    return out.astype(dest.dtype, copy=False)
+
+  def _writes_chunk_aligned(self, dest, core: Bbox) -> bool:
+    """Same proof as TransferTask: the single core write is aligned or
+    clipped at dataset bounds, so Volume.upload never read-modify-writes
+    and proven-aligned plans may overlap in the staged pipeline."""
+    if core.empty():
+      return True
+    expanded = core.expand_to_chunk_size(
+      dest.meta.chunk_size(self.mip), dest.meta.voxel_offset(self.mip)
+    )
+    return Bbox.intersection(expanded, dest.meta.bounds(self.mip)) == core

@@ -166,7 +166,7 @@ def register_inference_model(name: str, fn: Callable):
   _INFERENCE_MODELS[name] = fn
 
 
-class InferenceTask(RegisteredTask):
+class LegacyInferenceTask(RegisteredTask):
   """Patch-wise model inference with overlap-blend (ChunkFlow-style,
   reference obsolete.py:287+). Patches overlap by ``overlap`` voxels and
   are linearly blended."""
@@ -237,3 +237,10 @@ class InferenceTask(RegisteredTask):
           weight[sl] += 1.0
     out /= np.maximum(weight, 1e-6)
     dest.upload(bounds, out.astype(dest.dtype))
+
+
+# Superseded by tasks.inference.InferenceTask (ISSUE 10): the first-class
+# task owns the `InferenceTask` wire name now. This alias keeps the
+# in-process (register_inference_model) flavor importable under its old
+# name; the class registers on the wire as LegacyInferenceTask.
+InferenceTask = LegacyInferenceTask
