@@ -366,10 +366,13 @@ def compile_span(kernel: str, devices: List[str]) -> Iterator[None]:
 
 @contextlib.contextmanager
 def execute_span(kernel: str, elements: int = 0, nbytes: int = 0,
-                 mesh=None) -> Iterator[None]:
+                 mesh=None, **attrs) -> Iterator[None]:
   """Time one device dispatch. The caller must block on the result
   INSIDE the context (``jax.block_until_ready``) — dispatch is async and
-  an unblocked timing would measure enqueue, not execution."""
+  an unblocked timing would measure enqueue, not execution.
+
+  Extra keyword ``attrs`` ride onto the emitted ``device.execute`` span
+  verbatim (e.g. the fused pyramid kernel's ``mip_from``/``mip_to``)."""
   devices = _devices_of(mesh)
   t0 = time.perf_counter()
   try:
@@ -381,7 +384,7 @@ def execute_span(kernel: str, elements: int = 0, nbytes: int = 0,
     metrics.observe_quiet("device.execute.s", dt)
     record_span("device.execute", dt, kernel=kernel, elements=elements,
                 device=devices[0] if devices else None,
-                devices=len(devices))
+                devices=len(devices), **attrs)
     maybe_sample_profile()
 
 

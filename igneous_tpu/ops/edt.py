@@ -192,6 +192,16 @@ def _envelope_pass(val: jnp.ndarray, lab: jnp.ndarray, w: float) -> jnp.ndarray:
   return jnp.where(out >= INF / 2, INF, out)
 
 
+# lines per envelope block (device path). XLA's CPU backend cannot alias
+# the (B, S) v/h/z stack carries of the envelope scan, so every position
+# step COPIES them; running the whole volume's B lines at once makes that
+# copy ~50MB/step at 128^3 (DRAM-bound). Blocking the lines keeps each
+# block's stacks cache-resident — the same total copy volume moves at
+# L2/L3 speed instead. Per-line independence makes any blocking bitwise
+# identical (the numpy twin blocks the same way via _NP_LINE_BATCH).
+_LINE_BLOCK = 256
+
+
 def _axis_pass(
   val: jnp.ndarray, lab: jnp.ndarray, w: float, first: bool
 ) -> jnp.ndarray:
@@ -205,7 +215,18 @@ def _axis_pass(
   if not first:
     # the first pass starts from val=INF everywhere, so the same-run
     # envelope could only produce INF — the edge term alone is the answer
-    out = jnp.minimum(out, _envelope_pass(v, l, w))
+    lb = min(_LINE_BLOCK, B)
+    pad = (-B) % lb
+    if pad:
+      # padded lines are all-background (label 0, val INF): the envelope
+      # returns INF for them and they are sliced off below
+      v = jnp.pad(v, ((0, pad), (0, 0)), constant_values=INF)
+      l = jnp.pad(l, ((0, pad), (0, 0)))
+    env = jax.lax.map(
+      lambda args: _envelope_pass(args[0], args[1], w),
+      (v.reshape(-1, lb, n), l.reshape(-1, lb, n)),
+    ).reshape(-1, n)[:B]
+    out = jnp.minimum(out, env)
   return out.reshape(*lead, n)
 
 
@@ -213,20 +234,28 @@ def _axis_pass(
 def _edt_sq_kernel(
   labels: jnp.ndarray, anisotropy: Tuple[float, float, float]
 ):
-  """labels (z, y, x) int32 → squared EDT float32; three passes."""
-  wx, wy, wz = anisotropy
-  val = jnp.full(labels.shape, INF, dtype=jnp.float32)
+  """labels (z, y, x) int32 → squared EDT float32; three passes.
 
-  # pass along x (last axis)
-  val = _axis_pass(val, labels, wx, first=True)
-  # pass along y
-  val = jnp.swapaxes(_axis_pass(
-    jnp.swapaxes(val, 1, 2), jnp.swapaxes(labels, 1, 2), wy, first=False
-  ), 1, 2)
-  # pass along z
-  val = jnp.moveaxis(_axis_pass(
-    jnp.moveaxis(val, 0, 2), jnp.moveaxis(labels, 0, 2), wz, first=False
-  ), 2, 0)
+  Each pass runs along the LAST axis of a layout chosen so consecutive
+  transposes fuse into one permutation between passes (in+out transpose
+  pairs per pass collapsed: x in (z,y,x), y in (z,x,y), z in (y,x,z) —
+  two label transposes and three value transposes total instead of six).
+  Values are identical under any layout walk; the envelope itself runs
+  blocked over _LINE_BLOCK-line chunks (see above)."""
+  wx, wy, wz = anisotropy
+
+  # pass along x, native (z, y, x) layout
+  val = _axis_pass(
+    jnp.full(labels.shape, INF, dtype=jnp.float32), labels, wx, first=True
+  )
+  # (z, y, x) -> (z, x, y): pass along y
+  lab_y = jnp.swapaxes(labels, 1, 2)
+  val = _axis_pass(jnp.swapaxes(val, 1, 2), lab_y, wy, first=False)
+  # (z, x, y) -> (y, x, z): pass along z
+  lab_z = jnp.transpose(lab_y, (2, 1, 0))
+  val = _axis_pass(jnp.transpose(val, (2, 1, 0)), lab_z, wz, first=False)
+  # (y, x, z) -> (z, y, x)
+  val = jnp.transpose(val, (2, 0, 1))
 
   return jnp.where(labels == 0, 0.0, val)
 
@@ -410,7 +439,12 @@ def _host_backend() -> str:
   import os
 
   override = os.environ.get("IGNEOUS_EDT_BACKEND", "")
-  if override in ("numpy", "device", "native"):
+  if override:
+    if override not in ("native", "numpy", "device"):
+      raise ValueError(
+        "IGNEOUS_EDT_BACKEND must be 'native', 'numpy' or 'device': "
+        f"{override!r}"
+      )
     return override
   platforms = os.environ.get("JAX_PLATFORMS", "")
   if platforms:
@@ -442,7 +476,8 @@ def batch_edt_executor(anisotropy, mesh=None):
     from ..parallel.executor import BatchKernelExecutor
 
     _BATCH_EXECUTORS[key] = BatchKernelExecutor(
-      _partial(_edt_sq_kernel, anisotropy=(wx, wy, wz)), mesh=mesh
+      _partial(_edt_sq_kernel, anisotropy=(wx, wy, wz)), mesh=mesh,
+      name="edt.sq_blocked",
     )
   return _BATCH_EXECUTORS[key]
 

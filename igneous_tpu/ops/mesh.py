@@ -588,6 +588,111 @@ def _mc_emit_host(case_np, ntri_np, shape, real_cells=None) -> np.ndarray:
   return base[:, None, :] + mid
 
 
+def _mesh_emit_backend() -> str:
+  """'host' | 'device' triangle emission. The host path is numpy fancy
+  indexing (fast on CPU hosts); the device path keeps count+emit on the
+  accelerator so MeshTask's forge stage stops round-tripping cases/counts
+  through the host. Override with IGNEOUS_MESH_EMIT=host|device."""
+  import os
+
+  override = os.environ.get("IGNEOUS_MESH_EMIT", "")
+  if override:
+    if override not in ("host", "device"):
+      raise ValueError(
+        f"IGNEOUS_MESH_EMIT must be 'host' or 'device': {override!r}"
+      )
+    return override
+  platforms = os.environ.get("JAX_PLATFORMS", "")
+  if platforms:
+    return "host" if platforms.split(",")[0] == "cpu" else "device"
+  return "device" if jax.default_backend() != "cpu" else "host"
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _mc_emit_kernel(case: jnp.ndarray, ntri: jnp.ndarray, capacity: int):
+  """Device MC triangle emission as a masked gather over ``capacity``
+  static slots: exclusive-cumsum triangle offsets per cell, slot→cell via
+  searchsorted, then the same MC_TRIS/MC_EDGE_MID table gathers as the
+  host path. Slot order IS the host emission order (cells ascending in
+  flat (z, y, x) scan order, k ascending within a cell), so after the
+  host-side [:total] slice + pad-ring filter the triangle stream — and
+  therefore _weld's vertex/face numbering — is byte-identical. Slots
+  >= total hold garbage from clamped gathers and are sliced off."""
+  cz, cy, cx = ntri.shape
+  nt = ntri.reshape(-1)
+  ex = jnp.cumsum(nt, dtype=jnp.int32) - nt  # exclusive starts
+  slots = jnp.arange(capacity, dtype=jnp.int32)
+  # last cell whose start <= slot: ties (zero-tri cells share a start)
+  # resolve to the one cell whose [start, start+ntri) interval holds slot
+  cell = (
+    jnp.searchsorted(ex, slots, side="right").astype(jnp.int32) - 1
+  )
+  k = slots - jnp.take(ex, cell)
+  k = jnp.minimum(k, jnp.int32(MC_TRIS.shape[1] - 1))  # dead-slot clamp
+  cs = jnp.take(case.reshape(-1), cell)
+  edges = jnp.asarray(MC_TRIS)[cs, k]  # (capacity, 3)
+  mid = jnp.asarray(MC_EDGE_MID)[edges]  # (capacity, 3, 3)
+  base = jnp.stack(
+    [
+      (cell % cx).astype(jnp.float32),
+      ((cell // cx) % cy).astype(jnp.float32),
+      (cell // (cy * cx)).astype(jnp.float32),
+    ],
+    axis=-1,
+  )
+  return base[:, None, :] + mid, cell
+
+
+def _mc_emit_device(
+  case, ntri, total: int, shape, real_cells=None
+) -> np.ndarray:
+  """Run _mc_emit_kernel under the solo-dispatch telemetry pattern
+  (compile span on a fresh (shape, capacity-bucket) signature, execute
+  span + recompile ledger otherwise) and apply the pad-ring filter on
+  the returned per-triangle cell ids."""
+  from ..observability import device as device_telemetry
+
+  sz, sy, sx = shape
+  cz, cy, cx = sz - 1, sy - 1, sx - 1
+  capacity = 1 << max(10, int(total - 1).bit_length())
+  kernel = "mesh.mc_emit"
+  sig = ((cz, cy, cx), capacity)
+  fresh = device_telemetry.LEDGER.note_signature(kernel, sig)
+  span = (
+    device_telemetry.compile_span(kernel, device_telemetry._devices_of())
+    if fresh else
+    device_telemetry.execute_span(
+      kernel, elements=int(total),
+      nbytes=int(np.asarray(case).nbytes) + int(np.asarray(ntri).nbytes),
+    )
+  )
+  with span:
+    tris, cell = _mc_emit_kernel(
+      jnp.asarray(case), jnp.asarray(ntri), capacity
+    )
+    jax.block_until_ready((tris, cell))
+  tris = np.asarray(tris)[:total]
+  cell = np.asarray(cell)[:total]
+  if real_cells is not None and len(cell):
+    rx, ry, rz = real_cells
+    in_real = (
+      (cell % cx < rx) & ((cell // cx) % cy < ry)
+      & (cell // (cy * cx) < rz)
+    )
+    tris = tris[in_real]
+  return tris
+
+
+def _mc_emit(case, ntri, total: int, shape, real_cells=None) -> np.ndarray:
+  """Backend-dispatched MC emission; both paths produce the identical
+  triangle stream (order and bits)."""
+  if total and _mesh_emit_backend() == "device":
+    return _mc_emit_device(case, ntri, total, shape, real_cells)
+  return _mc_emit_host(
+    np.asarray(case), np.asarray(ntri), shape, real_cells
+  )
+
+
 _MC_COUNT_EXECUTOR = None
 
 
@@ -611,8 +716,8 @@ def marching_cubes(
   case, ntri, total = _mc_count_kernel(dev)
   if int(total) == 0:
     return _EMPTY_MESH
-  tris = _mc_emit_host(
-    np.asarray(case), np.asarray(ntri), dev.shape,
+  tris = _mc_emit(
+    case, ntri, int(total), dev.shape,
     real_cells=(orig[0] - 1, orig[1] - 1, orig[2] - 1),
   )
   if len(tris) == 0:
@@ -630,9 +735,9 @@ def _mc_executor():
 
 
 def _mc_emit_k(results, k, shape, real_cells):
-  case_b, ntri_b, _ = results
-  return _mc_emit_host(
-    np.asarray(case_b[k]), np.asarray(ntri_b[k]), shape,
+  case_b, ntri_b, totals = results
+  return _mc_emit(
+    case_b[k], ntri_b[k], int(np.asarray(totals[k])), shape,
     real_cells=real_cells,
   )
 

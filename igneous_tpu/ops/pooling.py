@@ -232,7 +232,42 @@ def _pyramid(x, factors, method, sparse):
   span = (
     device_telemetry.compile_span(kernel, device_telemetry._devices_of())
     if fresh else
-    device_telemetry.execute_span(kernel, elements=elements)
+    device_telemetry.execute_span(
+      kernel, elements=elements,
+      nbytes=sum(int(np.asarray(a).nbytes) for a in leaves),
+    )
+  )
+  with span:
+    outs = _jit_pyramid(x, factors, method, sparse)
+    jax.block_until_ready(outs)
+  return outs
+
+
+def _fused_pyramid(x, factors, method, sparse, mip_from: int = 0):
+  """The fused multi-mip walk: the SAME single compiled program as
+  ``_pyramid`` (the whole mip0→mipN walk is one XLA dispatch with no HBM
+  round-trips between mips — it shares ``_jit_pyramid``'s executable
+  cache), accounted under its own ``pooling.fused_pyramid[method]``
+  kernel with ``mip_from``/``mip_to`` attributes on the device.execute
+  span. Callers that walk a varying mip range per invocation (the serve
+  tier's ancestor synth) use this so the journal records which levels
+  each fused dispatch produced."""
+  from ..observability import device as device_telemetry
+
+  kernel = f"pooling.fused_pyramid[{method}]"
+  leaves = x if isinstance(x, tuple) else (x,)
+  sig = (tuple((np.shape(a), str(np.asarray(a).dtype)) for a in leaves),
+         factors, sparse)
+  fresh = device_telemetry.LEDGER.note_signature(kernel, sig)
+  elements = sum(int(np.size(a)) for a in leaves)
+  span = (
+    device_telemetry.compile_span(kernel, device_telemetry._devices_of())
+    if fresh else
+    device_telemetry.execute_span(
+      kernel, elements=elements,
+      nbytes=sum(int(np.asarray(a).nbytes) for a in leaves),
+      mip_from=int(mip_from), mip_to=int(mip_from) + len(factors),
+    )
   )
   with span:
     outs = _jit_pyramid(x, factors, method, sparse)
@@ -326,16 +361,28 @@ def downsample(
   num_mips: int = 1,
   method: str = "average",
   sparse: bool = False,
+  mip_from: Optional[int] = None,
 ) -> List[np.ndarray]:
   """Pool ``img`` (x,y,z[,c]) iteratively; returns one array per mip.
 
   ``factor`` is one (fx,fy,fz) triple applied every mip, or a per-mip
-  sequence of triples (near-isotropic pyramids)."""
+  sequence of triples (near-isotropic pyramids).
+
+  ``mip_from``: when given, the device walk runs as the
+  ``pooling.fused_pyramid`` kernel and its device.execute spans carry
+  ``mip_from``/``mip_to`` attributes (``img`` is a cutout of mip
+  ``mip_from``; the results are mips ``mip_from+1 .. mip_from+num_mips``).
+  The compiled program — and the numeric output — is identical either way.
+  """
   squeeze = img.ndim == 3
   orig_dtype = img.dtype
   if img.dtype == bool:
     img = img.view(np.uint8)
   factors = _normalize_factors(factor, num_mips)
+  run_pyramid = (
+    _pyramid if mip_from is None
+    else partial(_fused_pyramid, mip_from=mip_from)
+  )
 
   if method == "mode" and img.dtype.itemsize == 8:
     # 64-bit labels ride as (lo, hi) uint32 planes: equality distributes
@@ -347,8 +394,8 @@ def downsample(
       raise ValueError("mode pooling of floating-point data is not supported")
     u = img.view(np.uint64) if img.dtype.kind == "i" else img
     lo, hi = _split_u64_planes(u)
-    outs = _pyramid((_to_device_layout(lo), _to_device_layout(hi)),
-                    factors, method, sparse)
+    outs = run_pyramid((_to_device_layout(lo), _to_device_layout(hi)),
+                       factors, method, sparse)
     results = []
     for ol, oh in outs:
       r = _pack_u64_planes(_from_device_layout(ol), _from_device_layout(oh))
@@ -360,7 +407,7 @@ def downsample(
   if img.dtype.itemsize == 8 and method == "average":
     work = img.astype(np.float32)
   x = _to_device_layout(work)
-  outs = _pyramid(x, factors, method, sparse)
+  outs = run_pyramid(x, factors, method, sparse)
   results = []
   for o in outs:
     r = _from_device_layout(o).astype(orig_dtype, copy=False)
@@ -576,11 +623,16 @@ def downsample_auto(
   num_mips: int = 1,
   method: str = "average",
   sparse: bool = False,
+  mip_from: Optional[int] = None,
 ) -> List[np.ndarray]:
   """Production dispatch: native host kernels when jax would run on CPU
-  anyway (or when forced), device kernels otherwise."""
+  anyway (or when forced), device kernels otherwise. ``mip_from`` labels
+  the device walk's spans (see :func:`downsample`); the native host path
+  computes the same walk without device telemetry."""
   if _host_pool_active():
     out = host_downsample(img, factor, num_mips, method=method, sparse=sparse)
     if out is not None:
       return out
-  return downsample(img, factor, num_mips, method=method, sparse=sparse)
+  return downsample(
+    img, factor, num_mips, method=method, sparse=sparse, mip_from=mip_from
+  )

@@ -150,6 +150,9 @@ def batched_downsample(
     mesh, factors=tuple(factors), method=method, sparse=sparse,
     planes=2 if is_u64_mode else 1,
   )
+  # the fused walk's span attributes: every device.execute this run emits
+  # records which mip range the one-dispatch pyramid produced
+  executor.span_attrs = {"mip_from": int(mip), "mip_to": int(mip) + len(factors)}
 
   stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
            "drained": False}

@@ -237,6 +237,10 @@ class ChunkExecutor:
       raise ValueError("plane pairs are only meaningful for mode pooling")
     self.axis = self.mesh.axis_names[0]
     self.name = f"pooling.pyramid[{method}]"
+    # extra device.execute span attributes (mutable, not part of any
+    # cache key): batched_downsample stamps the fused walk's mip range
+    # ({"mip_from": m, "mip_to": m + len(factors)}) here before each run
+    self.span_attrs: dict = {}
     self._fn = self._build()
     self._compiled = {}  # input signature -> AOT executable (ISSUE 7)
 
@@ -304,7 +308,7 @@ class ChunkExecutor:
       ) if fresh else
       device_telemetry.execute_span(
         self.name, elements=device_telemetry.elements_of(arrs),
-        mesh=self.mesh,
+        mesh=self.mesh, **self.span_attrs,
       )
     )
     with span:
@@ -340,6 +344,7 @@ class ChunkExecutor:
     with device_telemetry.execute_span(
       self.name, elements=sum(int(p.size) for p in padded),
       nbytes=sum(int(p.nbytes) for p in padded), mesh=self.mesh,
+      **self.span_attrs,
     ):
       outs, nonzero = self._compiled[sig](xs)
       jax.block_until_ready((outs, nonzero))

@@ -518,29 +518,46 @@ class ServeApp:
     )
     if Bbox.intersection(expanded, bounds) != bbox:
       return None  # not a canonical (grid-aligned, bounds-clamped) chunk
-    factor = meta.downsample_ratio(mip) // meta.downsample_ratio(mip - 1)
-    if any(int(v) < 1 for v in factor) or all(int(v) == 1 for v in factor):
-      return None
-    src_bbox = Bbox.intersection(
-      Bbox(bbox.minpt * factor, bbox.maxpt * factor), meta.bounds(mip - 1)
-    )
-    if src_bbox.empty():
-      return None
-
     from ..ops import pooling
     from ..volume import EmptyVolumeError
 
     t0 = time.perf_counter()
-    try:
-      img = layer.volume(mip - 1).download(src_bbox, mip=mip - 1)
-    except EmptyVolumeError:
+    # walk down to the NEAREST ancestor mip with readable source data,
+    # collecting per-level factors; the whole walk then runs as ONE fused
+    # pyramid dispatch (pooling.fused_pyramid — each intermediate level is
+    # the same per-level pad+pool the offline DownsampleTask chain applies,
+    # so the result stays byte-identical). A request whose direct parent
+    # was itself never materialized no longer 404s as long as any ancestor
+    # (ultimately mip 0) holds the region.
+    factors = []
+    src_mip, src_bbox, img = mip, bbox, None
+    while src_mip > 0:
+      f = meta.downsample_ratio(src_mip) // meta.downsample_ratio(src_mip - 1)
+      if any(int(v) < 1 for v in f) or all(int(v) == 1 for v in f):
+        break
+      up = Bbox.intersection(
+        Bbox(src_bbox.minpt * f, src_bbox.maxpt * f), meta.bounds(src_mip - 1)
+      )
+      if up.empty():
+        break
+      factors.insert(0, tuple(int(v) for v in f))
+      src_mip -= 1
+      src_bbox = up
+      try:
+        img = layer.volume(src_mip).download(src_bbox, mip=src_mip)
+        break
+      except EmptyVolumeError:
+        img = None
+    if img is None or not factors:
       return None
     method = pooling.method_for_layer(meta.layer_type, "auto")
     mips_out = pooling.downsample_auto(
-      img, [tuple(int(v) for v in factor)], 1, method=method, sparse=False
+      img, factors, len(factors), method=method, sparse=False,
+      mip_from=src_mip,
     )
-    mipped = mips_out[0]
-    minpt = src_bbox.minpt // factor
+    mipped = mips_out[-1]
+    total = Vec(*np.prod(np.asarray(factors), axis=0).tolist())
+    minpt = src_bbox.minpt // total
     dest = Bbox.intersection(
       Bbox(minpt, minpt + Vec(*mipped.shape[:3])), bounds
     )
@@ -550,7 +567,7 @@ class ServeApp:
     cutout = np.asarray(mipped[sl], dtype=meta.dtype)
     metrics.incr("serve.synth")
     trace.record_span("serve.synth", time.perf_counter() - t0,
-                      mip=mip, key=key)
+                      mip=mip, src_mip=src_mip, key=key)
     if self.config.writeback:
       # the upload path IS the DownsampleTask write path, so the stored
       # object is exactly what offline downsampling would leave; the
