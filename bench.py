@@ -947,6 +947,49 @@ def bench_pyramid_fused(img):
     os.environ.pop("IGNEOUS_POOL_HOST", None)
 
 
+def bench_ragged():
+  """Ragged paged batching (ISSUE 12): one mixed-shape boundary-cell
+  fleet through the paged pyramid (ONE compiled signature + page slack)
+  vs the same fleet through solo per-cutout downsample (a compile per
+  distinct shape). Both run cold on purpose — the per-shape recompile
+  tax is exactly what paging removes, so warmed-cache rates would
+  measure the wrong thing. Device path pinned (IGNEOUS_POOL_HOST=0) so
+  CPU-fallback rounds measure the paged kernel rather than the native
+  host loop. Returns (batched_voxps, solo_voxps, pad_waste_pct)."""
+  from igneous_tpu.observability import device as device_mod
+  from igneous_tpu.ops import pooling
+  from igneous_tpu.parallel.paged import paged_pyramid
+
+  os.environ["IGNEOUS_POOL_HOST"] = "0"
+  try:
+    rng = np.random.default_rng(0)
+    shapes = [(129, 256, 64), (256, 129, 64), (129, 129, 64),
+              (65, 97, 33), (193, 65, 64)]
+    if QUICK:
+      shapes = shapes[:3]
+    imgs = [rng.integers(0, 255, s).astype(np.uint8) for s in shapes]
+    total = sum(i.size for i in imgs)
+
+    led = device_mod.LEDGER
+    pad0, real0 = led.pad_bytes, led.real_bytes
+    t0 = time.perf_counter()
+    paged_pyramid(imgs, (2, 2, 1), 2, method="average")
+    batched = total / (time.perf_counter() - t0)
+    pad = led.pad_bytes - pad0
+    real = led.real_bytes - real0
+    pad_waste_pct = (
+      round(100.0 * pad / (pad + real), 2) if (pad + real) else None
+    )
+
+    t0 = time.perf_counter()
+    for img in imgs:
+      pooling.downsample(img, (2, 2, 1), 2, method="average")
+    solo = total / (time.perf_counter() - t0)
+    return batched, solo, pad_waste_pct
+  finally:
+    os.environ.pop("IGNEOUS_POOL_HOST", None)
+
+
 def bench_host_kernels(img, seg):
   """The production path on an accelerator-less host: the native C++
   pooling kernels threaded across every core — exactly what
@@ -1112,6 +1155,7 @@ def run_bench(platform: str):
   edt_device_rate = bench_edt_device_kernel()
   mesh_extract_rate = bench_mesh_extract_kernel()
   pyramid_fused_rate = bench_pyramid_fused(img)
+  ragged_batched_rate, ragged_solo_rate, pad_waste_pct = bench_ragged()
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
   codec_tbl = bench_codecs(img, seg)
   cseg_speedup = bench_cseg_speedup()
@@ -1213,6 +1257,16 @@ def run_bench(platform: str):
       "edt_device_kernel_voxps": round(edt_device_rate, 1),
       "mesh_extract_kernel_voxps": round(mesh_extract_rate, 1),
       "pyramid_fused_voxps": round(pyramid_fused_rate, 1),
+      # ISSUE 12: a mixed-shape ragged fleet, paged (ONE compiled
+      # signature for the whole campaign) vs solo per-cutout (a compile
+      # per distinct shape) — both cold, because the recompile tax is
+      # the thing being removed — plus the page slack the campaign paid
+      "ragged_batched_voxps": round(ragged_batched_rate, 1),
+      "ragged_solo_voxps": round(ragged_solo_rate, 1),
+      "pad_waste_pct": (
+        pad_waste_pct if pad_waste_pct is not None
+        else _skip("no pad-waste bytes recorded during the paged run")
+      ),
       "pool_ab": pool_ab,
       # ISSUE 9: interactive serving tier — hot-path latency, sustained
       # keep-alive throughput, and herd-coalescing effectiveness

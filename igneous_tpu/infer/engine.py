@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import device as device_telemetry
 from .registry import InferenceModel
 
 
@@ -160,7 +161,15 @@ def infer_cutout(
     if fill:
       stack.extend(np.zeros_like(stack[0]) for _ in range(fill))
       padded_slots += fill
-    out = executor(np.stack(stack), consts=dev_params)
+      patch_nbytes = int(stack[0].nbytes)
+      device_telemetry.LEDGER.record_pad_waste(
+        padded_bytes=fill * patch_nbytes,
+        real_bytes=len(group) * patch_nbytes,
+      )
+    out = executor(
+      np.stack(stack), consts=dev_params,
+      span_attrs={"padded_slots": fill},
+    )
     dispatches += 1
     for j in range(len(group)):
       outputs[g0 + j] = _from_device_layout(out[j])

@@ -98,6 +98,10 @@ class DeviceLedger:
       self.recompiles = 0
       self.dispatches = 0
       self.fastpath = {"batched": 0, "host": 0}
+      # padding-byte accounting across every batched dispatch (pow2
+      # batch rounding, page-pool filler slots, infer group fill)
+      self.pad_bytes = 0
+      self.real_bytes = 0
       # device label -> last sampled memory stats (+ peak high-water)
       self.hbm: Dict[str, dict] = {}
       # anything recorded since the last journal flush? An idle worker
@@ -180,6 +184,23 @@ class DeviceLedger:
       metrics.incr("device.fastpath.host", int(host))
     if b + h:
       metrics.gauge_set("device.fastpath_ratio", b / (b + h))
+
+  def record_pad_waste(self, padded_bytes: int = 0,
+                       real_bytes: int = 0) -> None:
+    """Padding-byte accounting for batched dispatches: ``padded_bytes``
+    were filler (pow2 batch rounding, page-pool slack, dispatch-group
+    fill), ``real_bytes`` carried cutout data. The exported gauge is the
+    cumulative padded/real ratio — the waste the ragged paged packer
+    exists to eliminate. Padding layers can nest (a paged round's filler
+    pages also ride the executor's own pow2 rounding), so the totals are
+    additive bookkeeping of every layer's slack, not disjoint memory."""
+    with self.lock:
+      self.pad_bytes += int(padded_bytes)
+      self.real_bytes += int(real_bytes)
+      self._dirty = True
+      p, r = self.pad_bytes, self.real_bytes
+    if r:
+      metrics.gauge_set("device.pad_waste_ratio", p / r)
 
   def sample_hbm(self) -> Dict[str, dict]:
     """Poll ``Device.memory_stats()`` on every local device; a backend
@@ -279,6 +300,12 @@ class DeviceLedger:
           dev: round(s, 4) for dev, s in sorted(self.device_busy.items())
         },
         "fastpath": dict(self.fastpath),
+        "pad_bytes": self.pad_bytes,
+        "real_bytes": self.real_bytes,
+        "pad_waste_ratio": (
+          round(self.pad_bytes / self.real_bytes, 4)
+          if self.real_bytes else None
+        ),
         "h2d_bytes": self.h2d_bytes,
         "d2h_bytes": self.d2h_bytes,
         "h2d_MBps": (
@@ -743,6 +770,13 @@ def render_devices(ledgers: Dict[str, dict]) -> List[str]:
       f"fast path: {fp['batched']}/{total} deliveries batched "
       f"({fp['batched'] / total:.1%}), {fp['host']} fell to host"
     )
+  pad = sum(int(rec.get("pad_bytes") or 0) for rec in ledgers.values())
+  real = sum(int(rec.get("real_bytes") or 0) for rec in ledgers.values())
+  if real:
+    lines.append(
+      f"pad waste: {_fmt_bytes(pad)} padding over {_fmt_bytes(real)} real "
+      f"bytes ({pad / real:.1%})"
+    )
   return lines
 
 
@@ -767,8 +801,11 @@ def fleet_summary(ledgers: Dict[str, dict]) -> Optional[dict]:
   for rec in ledgers.values():
     for key in fp:
       fp[key] += int((rec.get("fastpath") or {}).get(key, 0))
+  pad = sum(int(rec.get("pad_bytes") or 0) for rec in ledgers.values())
+  real = sum(int(rec.get("real_bytes") or 0) for rec in ledgers.values())
   return {
     "workers": len(ledgers),
+    "pad_waste_ratio": round(pad / real, 4) if real else None,
     "busy_ratio": (
       round(sum(ratios) / len(ratios), 4) if ratios else None
     ),

@@ -6,8 +6,10 @@ grid, downloads K equal-shaped cutouts with an IO thread pool, runs ONE
 shard_map'd pooling program for all K across the chip mesh, and uploads
 every mip — IO overlaps device compute via double buffering.
 
-Edge cells (clamped to odd shapes) fall back to the per-task path so the
-batched program keeps a single compiled shape.
+Edge cells (clamped to odd shapes) ride the paged pyramid (parallel.paged,
+ISSUE 12): fixed (pz, py, px) pages with per-page extent sidecars keep one
+compiled signature for every shape; the per-task solo path remains only
+for factor chains the page can't tile.
 """
 
 from __future__ import annotations
@@ -154,8 +156,8 @@ def batched_downsample(
   # records which mip range the one-dispatch pyramid produced
   executor.span_attrs = {"mip_from": int(mip), "mip_to": int(mip) + len(factors)}
 
-  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "dispatches": 0,
-           "drained": False}
+  stats = {"batched_cutouts": 0, "edge_cutouts": 0, "paged_cutouts": 0,
+           "dispatches": 0, "drained": False}
 
   def draining() -> bool:
     if drain_flag is not None and drain_flag.is_set():
@@ -225,32 +227,64 @@ def batched_downsample(
     except Exception:  # noqa: BLE001 - nothing consumed them
       pass
 
-  # ragged edge cells: the standard per-task path (nominal grid shape —
-  # the task clamps to bounds itself, keeping even pooling extents)
-  for offset in edge_offsets:
-    if draining():
-      break
-    DownsampleTask(
-      layer_path=layer_path,
-      mip=mip,
-      shape=shape.tolist(),
-      offset=[int(v) for v in offset],
-      fill_missing=fill_missing,
-      sparse=sparse,
-      num_mips=len(factors),
-      factor=tuple(factor),
-      compress=compress,
-      downsample_method=method,
-    ).execute()
-    stats["edge_cutouts"] += 1
+  # ragged edge cells (ISSUE 12): the paged pyramid packs every clamped
+  # cutout into fixed pages, so edges ride the batched device path under
+  # the same compiled signature as every other round; the per-task solo
+  # path remains only for factor chains no page tiles (pages_compatible)
+  from .paged import PagedPyramid, pages_compatible
 
-  # fast-path eligibility (ISSUE 7): the ragged-batching roadmap item's
-  # baseline number — how many cutouts rode the batched device program
-  # vs fell to the per-task path on shape grounds
+  if edge_offsets and pages_compatible(tuple(factors)) and not draining():
+    from ..tasks.image import downsample_and_upload
+
+    edge_boxes = [
+      Bbox.intersection(Bbox(offset, offset + shape), bounds)
+      for offset in edge_offsets
+    ]
+    futs = [io_pool.submit(vol.download, b) for b in edge_boxes]
+    imgs = [f.result() for f in futs]
+    pyramid = PagedPyramid(
+      imgs, tuple(factors), len(factors), method=method, sparse=sparse,
+      mesh=mesh,
+    )
+    ticket = shared_encode_pool().ticket()
+    while pyramid.pending and not draining():
+      for idx in pyramid.run_round():
+        # the solo task's own upload routine, fed the paged mips: chunk
+        # bytes stay identical to per-task execution
+        downsample_and_upload(
+          None, edge_boxes[idx], vol, task_shape=shape.tolist(), mip=mip,
+          num_mips=len(factors), factor=tuple(factor), sparse=sparse,
+          method=method, compress=compress,
+          _mips_out=pyramid.result(idx), sink=ticket,
+        )
+        stats["paged_cutouts"] += 1
+      stats["dispatches"] += 1
+    ticket.join()
+  else:
+    for offset in edge_offsets:
+      if draining():
+        break
+      DownsampleTask(
+        layer_path=layer_path,
+        mip=mip,
+        shape=shape.tolist(),
+        offset=[int(v) for v in offset],
+        fill_missing=fill_missing,
+        sparse=sparse,
+        num_mips=len(factors),
+        factor=tuple(factor),
+        compress=compress,
+        downsample_method=method,
+      ).execute()
+      stats["edge_cutouts"] += 1
+
+  # fast-path eligibility (ISSUE 7): paged edge cutouts ride the batched
+  # device program, so only the solo fallback counts as host deliveries
   from ..observability import device as device_telemetry
 
   device_telemetry.LEDGER.record_fastpath(
-    batched=stats["batched_cutouts"], host=stats["edge_cutouts"]
+    batched=stats["batched_cutouts"] + stats["paged_cutouts"],
+    host=stats["edge_cutouts"],
   )
   return stats
 
@@ -278,10 +312,11 @@ def batched_ccl_faces(
 
   Consumes the same task grid create_ccl_face_tasks builds (identical
   task_nums, offsets, and face outputs — later passes cannot tell the
-  difference). Cutouts stream through the batched CCL kernel in
-  prefetched groups per predicted shape (boundary tasks clamped along
-  the same dataset faces batch together); a shape with a single member
-  falls back to the per-task path.
+  difference). Cutouts stream through the PAGED CCL kernel (ISSUE 12) in
+  prefetched mixed-shape groups — one compiled signature regardless of
+  boundary clamping. When the tile config can't page
+  (ccl_page_compatible), the pre-paged per-shape partition remains, with
+  single-member shapes on the per-task path.
   """
   from ..ops.ccl import (
     _batch_executor,
@@ -311,18 +346,6 @@ def batched_ccl_faces(
     return stats
   files = CloudFiles(src_path)
   scratch = ccl_scratch_path(src_path, mip)
-  # module-cached: a fresh executor per call would recompile per run
-  executor = _batch_executor(6, mesh=mesh)
-
-  # geometric pre-partition by PREDICTED cutout shape: boundary tasks
-  # clamped along the same dataset faces share shapes and batch together;
-  # only shapes with a single member run the plain task path
-  vol = Volume(src_path, mip=mip)
-  bounds = vol.meta.bounds(mip)
-  by_shape = {}
-  for t in tasks:
-    cutout = Bbox.intersection(Bbox(t.offset, t.offset + t.shape + 1), bounds)
-    by_shape.setdefault(tuple(cutout.size3()), []).append(t)
 
   def prep(task):
     img, cutout, core = _prep_ccl_image(
@@ -330,6 +353,45 @@ def batched_ccl_faces(
       threshold_gte, threshold_lte,
     )
     return task, img, cutout, core
+
+  from .paged import ccl_page_compatible, paged_ccl
+
+  if ccl_page_compatible():
+    # ragged paged CCL (ISSUE 12): every cutout — boundary or interior —
+    # rides the page kernel under ONE compiled signature, so there is no
+    # per-shape partition and no single-member solo fallback
+    groups = _chunked(tasks, batch_size)
+    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+      pending = [io_pool.submit(prep, t) for t in groups[0]] if groups else []
+      for i, group in enumerate(groups):
+        preps = [f.result() for f in pending]
+        pending = (
+          [io_pool.submit(prep, t) for t in groups[i + 1]]
+          if i + 1 < len(groups) else []
+        )
+        comps = paged_ccl([p[1] for p in preps], 6, mesh=mesh)
+        stats["dispatches"] += 1
+        for (task, _img, cutout, core), cc in zip(preps, comps):
+          cc = _offset_components(cc, task.task_num, task.shape)
+          store_ccl_faces(cc, cutout, core, task.task_num, files, scratch)
+          stats["batched_cutouts"] += 1
+    from ..observability import device as device_telemetry
+
+    device_telemetry.LEDGER.record_fastpath(
+      batched=stats["batched_cutouts"], host=stats["edge_cutouts"]
+    )
+    return stats
+
+  # page-incompatible tile config: the pre-ISSUE-12 per-shape partition —
+  # boundary tasks clamped along the same dataset faces batch together;
+  # shapes with a single member run the plain task path
+  executor = _batch_executor(6, mesh=mesh)
+  vol = Volume(src_path, mip=mip)
+  bounds = vol.meta.bounds(mip)
+  by_shape = {}
+  for t in tasks:
+    cutout = Bbox.intersection(Bbox(t.offset, t.offset + t.shape + 1), bounds)
+    by_shape.setdefault(tuple(cutout.size3()), []).append(t)
 
   with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
     for shp, members in by_shape.items():
@@ -371,12 +433,13 @@ def batched_skeleton_forge(
 ) -> dict:
   """Skeleton forge with the flop-heavy EDT batched across K tasks.
 
-  Tasks stream in prefetched groups per predicted cutout shape: label
-  prep on IO threads, all K EDTs as ONE device dispatch
-  (ops.edt.edt_batch), then per-task host TEASAR + uploads via
-  SkeletonTask.execute(_prepared, _edt_field). Single-member shapes run
-  solo. Outputs are identical to solo task execution (edt_batch honors
-  the same backend dispatch as edt()).
+  On the device EDT backend, tasks stream in prefetched MIXED-shape
+  groups through the paged canonical-shape EDT (ISSUE 12) — one compiled
+  signature, no per-shape partition, no solo fallback. Host backends keep
+  the per-shape grouping: label prep on IO threads, all K EDTs as one
+  edt_batch call (which runs the native/numpy kernel per cutout), then
+  per-task host TEASAR + uploads via SkeletonTask.execute(_prepared,
+  _edt_field). Outputs are identical to solo task execution either way.
   """
   from ..ops.edt import edt_batch
   from ..task_creation.skeleton import create_skeletonizing_tasks
@@ -389,12 +452,13 @@ def batched_skeleton_forge(
   bounds = vol.meta.bounds(mip)
   stats = {"batched_cutouts": 0, "solo_cutouts": 0, "dispatches": 0}
 
+  eligible = []
   by_shape = {}
-  solo = []
   for t in tasks:
     core = Bbox.intersection(Bbox(t.offset, t.offset + t.shape), bounds)
     if core.empty():
       continue
+    eligible.append(t)
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
     by_shape.setdefault(tuple(cutout.size3()), []).append(t)
 
@@ -402,6 +466,37 @@ def batched_skeleton_forge(
     return task, task.prepare_labels(Volume(
       cloudpath, mip=mip, fill_missing=task.fill_missing, bounded=False
     ))
+
+  from ..ops.edt import _host_backend
+  from .paged import paged_edt
+
+  if _host_backend() == "device":
+    # ragged paged EDT (ISSUE 12): canonical-shape pages batch every
+    # cutout — boundary or interior — through one compiled signature, so
+    # mixed shapes need neither a per-shape partition nor solo fallbacks
+    groups = _chunked(eligible, batch_size)
+    with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
+      pending = [io_pool.submit(prep, t) for t in groups[0]] if groups else []
+      for i, group in enumerate(groups):
+        preps = [f.result() for f in pending]
+        pending = (
+          [io_pool.submit(prep, t) for t in groups[i + 1]]
+          if i + 1 < len(groups) else []
+        )
+        preps = [(t, p) for t, p in preps if p is not None]
+        if not preps:
+          continue
+        fields = paged_edt([p[0] for _, p in preps], anis, mesh=mesh)
+        stats["dispatches"] += 1
+        for (task, prepared), field in zip(preps, fields):
+          task.execute(_prepared=prepared, _edt_field=field)
+          stats["batched_cutouts"] += 1
+    from ..observability import device as device_telemetry
+
+    device_telemetry.LEDGER.record_fastpath(
+      batched=stats["batched_cutouts"], host=stats["solo_cutouts"]
+    )
+    return stats
 
   with cf.ThreadPoolExecutor(max_workers=8) as io_pool:
     for shp, members in by_shape.items():

@@ -147,10 +147,13 @@ class BatchKernelExecutor:
       )
     return jax.jit(fn)
 
-  def __call__(self, batch, consts=None):
+  def __call__(self, batch, consts=None, span_attrs=None):
     """batch: pytree of (K, ...) arrays → pytree of (K, ...) numpy.
     ``consts``: optional non-batched pytree (see class docstring);
-    device arrays from :meth:`put_consts` skip the per-call h2d."""
+    device arrays from :meth:`put_consts` skip the per-call h2d.
+    ``span_attrs``: extra attributes for this call's device.execute
+    span (e.g. the infer engine's ``padded_slots``) — never part of
+    the compile signature."""
     batch = jax.tree.map(np.asarray, batch)
     leaves = jax.tree.leaves(batch)
     k = leaves[0].shape[0]
@@ -168,6 +171,12 @@ class BatchKernelExecutor:
         ),
         batch,
       )
+    # per-dispatch padding bytes (ISSUE 12): the pow2 batch rounding is
+    # one of the padding layers igneous_device_pad_waste_ratio tracks
+    row_bytes = sum(int(l.nbytes) // max(k, 1) for l in leaves)
+    device_telemetry.LEDGER.record_pad_waste(
+      padded_bytes=rem * row_bytes, real_bytes=k * row_bytes,
+    )
     if consts is not None:
       # numpy consts are staged ad hoc (keyed by leaf identity); callers
       # with a stable model identity use put_consts() for real reuse
@@ -199,6 +208,7 @@ class BatchKernelExecutor:
     with device_telemetry.execute_span(
       self.name, elements=device_telemetry.elements_of(batch),
       nbytes=device_telemetry.nbytes_of(batch), mesh=self.mesh,
+      **(span_attrs or {}),
     ):
       out = self._cache[sig](*argv)
       jax.block_until_ready(out)
@@ -328,6 +338,11 @@ class ChunkExecutor:
     for a in arrs:
       p, _ = self.pad_batch(np.asarray(a))
       padded.append(p)
+    real = sum(int(np.asarray(a).nbytes) for a in arrs)
+    device_telemetry.LEDGER.record_pad_waste(
+      padded_bytes=sum(int(p.nbytes) for p in padded) - real,
+      real_bytes=real,
+    )
     sharding = NamedSharding(self.mesh, P(self.axis))
     with device_telemetry.transfer_span(
       "h2d", sum(int(p.nbytes) for p in padded), kernel=self.name,

@@ -86,8 +86,22 @@ def _group_key(task, volmeta_cache):
     box = Bbox.intersection(
       Bbox(task.offset, task.offset + task.shape), bounds
     )
-    if box.empty() or box != Bbox(task.offset, task.offset + task.shape):
-      return None  # clamped edge cutout: shapes differ, run solo
+    if box.empty():
+      return None
+    if box != Bbox(task.offset, task.offset + task.shape):
+      # clamped edge cutout (ISSUE 12): the paged pyramid batches it
+      # with its full-shape siblings when the factor chain pages; chains
+      # that must resolve against destination metadata (factor/num_mips
+      # unset) stay solo — the handler can't predict their geometry here
+      from ..ops.pooling import _normalize_factors
+      from .paged import pages_compatible
+
+      if task.factor is None or task.num_mips is None:
+        return None
+      if not pages_compatible(
+        _normalize_factors(task.factor, int(task.num_mips))
+      ):
+        return None
     return (
       "downsample", task.src_path, int(task.mip),
       tuple(int(v) for v in task.shape),
@@ -105,9 +119,17 @@ def _group_key(task, volmeta_cache):
     if core.empty():
       return None  # solo path no-ops it cheaply
     cutout = Bbox.intersection(Bbox(core.minpt, core.maxpt + 1), bounds)
+    from ..ops.edt import _host_backend
+
+    # paged EDT (ISSUE 12) runs every shape through one canonical-shape
+    # signature, so shape need not partition the group on device hosts
+    shape_part = (
+      ("paged",) if _host_backend() == "device"
+      else tuple(int(v) for v in cutout.size3())
+    )
     return (
       "skeleton", task.cloudpath, int(task.mip),
-      tuple(int(v) for v in cutout.size3()), bool(task.fill_missing),
+      shape_part, bool(task.fill_missing),
     )
 
   if type(task) is CCLFacesTask:
@@ -123,9 +145,17 @@ def _group_key(task, volmeta_cache):
     )
     if cutout.empty():
       return None
+    from .paged import ccl_page_compatible
+
+    # paged CCL (ISSUE 12): one page-batch signature covers ragged
+    # cutouts, so shape only partitions when pages can't tile the tile
+    shape_part = (
+      ("paged",) if ccl_page_compatible()
+      else tuple(int(v) for v in cutout.size3())
+    )
     return (
       "ccl_faces", task.src_path, int(task.mip),
-      tuple(int(v) for v in cutout.size3()),
+      shape_part,
       task.threshold_gte, task.threshold_lte,
       int(task.dust_threshold), bool(task.fill_missing),
     )
@@ -180,6 +210,9 @@ class LeaseBatcher:
       # ISSUE 6: rounds where the health plane's straggler flag made
       # this worker surrender/skip round-(i+1) pre-leasing
       "straggler_surrenders": 0, "straggler_prefetch_skips": 0,
+      # ISSUE 12: members whose unstarted page ranges a flagged worker
+      # shed back to the queue mid-campaign (healthy hosts re-lease them)
+      "paged_splits": 0,
       "dispatches": defaultdict(int),
     }
     # straggler-flag poll cache: (checked_at_monotonic, flagged)
@@ -662,8 +695,13 @@ class LeaseBatcher:
   # -- group handlers -------------------------------------------------------
 
   def _run_downsample_group(self, key, group):
-    """K downsample cutouts → one ChunkExecutor pyramid dispatch; uploads
-    go back through downsample_and_upload so chunk bytes match solo."""
+    """K downsample cutouts → one ChunkExecutor pyramid dispatch for the
+    full-shape members plus one paged-pyramid campaign for the clamped
+    edge members (ISSUE 12: one compiled signature regardless of edge
+    geometry); uploads go back through downsample_and_upload so chunk
+    bytes match solo. Between paged rounds a straggler-flagged worker
+    sheds members whose page ranges haven't started back to the queue,
+    so idle hosts pick up the remainder of the campaign."""
     from ..ops import pooling
     from ..tasks.image import _resolve_factors, downsample_and_upload
     from ..volume import Volume
@@ -684,28 +722,80 @@ class LeaseBatcher:
         self._complete(lease_id)
       return
     method = pooling.method_for_layer(dest.layer_type, t0.downsample_method)
-    boxes = [Bbox(t.offset, t.offset + t.shape) for t, _ in group]
+    bounds = src.meta.bounds(t0.mip)
+    boxes = [
+      Bbox.intersection(Bbox(t.offset, t.offset + t.shape), bounds)
+      for t, _ in group
+    ]
+    nominal = tuple(int(v) for v in t0.shape)  # key-shared across members
+    full_idx = [
+      k for k, b in enumerate(boxes)
+      if tuple(int(v) for v in b.size3()) == nominal
+    ]
+    ragged_idx = [k for k in range(len(boxes)) if k not in full_idx]
 
     def fetch(pair):
-      task, box = pair
+      k, task = pair
       img = self._img_cache.pop(_cutout_key(task), None)
-      return img if img is not None else src.download(box)
+      if img is not None and (
+        tuple(int(v) for v in img.shape[:3])
+        == tuple(int(v) for v in boxes[k].size3())
+      ):
+        return img
+      return src.download(boxes[k])
 
     from ..pipeline import shared_prefetch_pool
 
     imgs = list(shared_prefetch_pool().map(
-      fetch, zip([t for t, _ in group], boxes)
+      fetch, list(enumerate(t for t, _ in group))
     ))
-    is_u64 = method == "mode" and dest.dtype.itemsize == 8
     mesh = self.mesh if self.mesh is not None else make_mesh()
-    executor = cached_chunk_executor(
-      mesh, factors=tuple(factors), method=method, sparse=t0.sparse,
-      planes=2 if is_u64 else 1,
-    )
-    mips_out = device_pyramid_batch(executor, imgs, is_u64)
-    self.stats["dispatches"]["downsample"] += 1
 
-    def finish(k, task):
+    mips_out = None
+    full_pos = {k: j for j, k in enumerate(full_idx)}
+    if full_idx:
+      is_u64 = method == "mode" and dest.dtype.itemsize == 8
+      executor = cached_chunk_executor(
+        mesh, factors=tuple(factors), method=method, sparse=t0.sparse,
+        planes=2 if is_u64 else 1,
+      )
+      mips_out = device_pyramid_batch(
+        executor, [imgs[k] for k in full_idx], is_u64
+      )
+      self.stats["dispatches"]["downsample"] += 1
+
+    pyramid = None
+    ragged_pos = {k: j for j, k in enumerate(ragged_idx)}
+    released = set()
+    if ragged_idx:
+      from .paged import PagedPyramid
+
+      pyramid = PagedPyramid(
+        [imgs[k] for k in ragged_idx], tuple(factors), len(factors),
+        method=method, sparse=t0.sparse, mesh=mesh,
+      )
+      while pyramid.pending:
+        if self._straggler_flagged():
+          shed = pyramid.split_unstarted()
+          if shed:
+            self._release_members([group[ragged_idx[j]] for j in shed])
+            self.stats["paged_splits"] += len(shed)
+            for j in shed:
+              k = ragged_idx[j]
+              released.add(k)
+              # the lease is back in the queue for a healthy worker: the
+              # group-fallback path must not ALSO rerun it solo here
+              self._completed_in_group.add(group[k][1])
+        if not pyramid.pending:
+          break
+        pyramid.run_round()
+        self.stats["dispatches"]["downsample_paged"] += 1
+
+    to_finish = [m for k, m in enumerate(group) if k not in released]
+    idx_map = [k for k in range(len(group)) if k not in released]
+
+    def finish(j, task):
+      k = idx_map[j]
       # the member's chunk encodes+puts thread on the shared pool; the
       # join keeps the completion contract (delete only after every
       # byte landed) inside the member's own deadline window
@@ -714,22 +804,26 @@ class LeaseBatcher:
       sink = (
         shared_encode_pool().ticket() if pcfg.use_threads() else SerialSink()
       )
+      mips = (
+        pyramid.result(ragged_pos[k]) if k in ragged_pos
+        else [_from_batch_layout(np.asarray(m[full_pos[k]])) for m in mips_out]
+      )
       downsample_and_upload(
         None, boxes[k], dest,
         task_shape=task.shape, mip=task.mip, num_mips=task.num_mips,
         factor=task.factor, sparse=task.sparse,
         method=task.downsample_method, compress=task.compress,
-        _mips_out=[_from_batch_layout(np.asarray(m[k])) for m in mips_out],
+        _mips_out=mips,
         sink=sink,
       )
       sink.join()
 
-    self._finish_members(group, finish)
+    self._finish_members(to_finish, finish)
 
   def _run_skeleton_group(self, key, group):
     """K skeleton cutouts → one batched EDT dispatch; TEASAR and uploads
     run through SkeletonTask.execute(_prepared, _edt_field)."""
-    from ..ops.edt import _host_backend, batch_edt_executor, edt_batch
+    from ..ops.edt import _host_backend, edt_batch
     from ..volume import Volume
 
     t0 = group[0][0]
@@ -750,17 +844,21 @@ class LeaseBatcher:
 
     live = [i for i, p in enumerate(preps) if p is not None]
     fields = {}
-    if live:
+    if live and _host_backend() == "device":
+      # device hosts group ragged shapes under one key (ISSUE 12): the
+      # paged EDT relabels every member into one canonical-shape page
+      # batch, so the whole group rides a single compiled signature
+      from .paged import paged_edt
+
+      edts = paged_edt([preps[i][0] for i in live], anis, mesh=self.mesh)
+      self.stats["dispatches"]["skeleton"] += 1
+      fields = {i: f for i, f in zip(live, edts)}
+    elif live:
       labels_batch = np.stack([preps[i][0] for i in live])
-      # only pin the executor to the injected mesh when edt_batch would
-      # take the device path anyway: an explicit executor bypasses its
-      # host-backend fallback, which is what keeps batched EDTs
-      # bit-identical to solo skeletonize on accelerator-less hosts
-      pin = self.mesh is not None and _host_backend() == "device"
-      edts = edt_batch(
-        labels_batch, anis, black_border=True,
-        executor=batch_edt_executor(anis, mesh=self.mesh) if pin else None,
-      )
+      # host backend: shapes partition the group key, so the stack is
+      # rectangular; no executor pin — edt_batch's host fallback keeps
+      # batched EDTs bit-identical to solo on accelerator-less hosts
+      edts = edt_batch(labels_batch, anis, black_border=True, executor=None)
       self.stats["dispatches"]["skeleton"] += 1
       fields = {i: f for i, f in zip(live, edts)}
 
@@ -798,10 +896,20 @@ class LeaseBatcher:
 
     preps = list(shared_prefetch_pool().map(prep, [t for t, _ in group]))
 
-    imgs = np.stack([p[0] for p in preps])
-    comps = connected_components_batch(
-      imgs, executor=_batch_executor(6, mesh=self.mesh)
-    )
+    from .paged import ccl_page_compatible
+
+    if ccl_page_compatible():
+      # page-compatible tile: ragged cutouts share the group key
+      # (ISSUE 12), and the paged CCL runs them all through one
+      # fixed-page-batch signature
+      from .paged import paged_ccl
+
+      comps = paged_ccl([p[0] for p in preps], 6, mesh=self.mesh)
+    else:
+      imgs = np.stack([p[0] for p in preps])
+      comps = connected_components_batch(
+        imgs, executor=_batch_executor(6, mesh=self.mesh)
+      )
     self.stats["dispatches"]["ccl_faces"] += 1
 
     def finish(k, task):

@@ -148,6 +148,43 @@ def lease_partition(n_chunks: int):
   return [i for i in range(start, start + per) if i < n_chunks], per
 
 
+def page_partition(n_pages: int, weights=None):
+  """Contiguous per-process PAGE ranges for a paged pod dispatch — the
+  page-granular sibling of :func:`lease_partition` (ISSUE 12).
+
+  Returns ``(start, stop, per)``: this process owns global page indices
+  ``[start, stop)`` of the campaign's page table, and every process pads
+  its local pages to ``per`` slots (a local-device multiple computed
+  identically everywhere from the shared inputs) before
+  :func:`from_process_local` assembles the global page batch. Pages, not
+  chunks, are the unit so ragged members split across hosts mid-cutout.
+
+  ``weights``: optional per-process throughput weights (from journal
+  telemetry): a flagged straggler gets a proportionally shorter page
+  range, which is how the lease batcher splits a slow host's unstarted
+  page ranges to idle hosts without abandoning in-flight rounds.
+  """
+  import jax
+
+  ndev = jax.device_count()
+  nproc = jax.process_count()
+  ldev = max(ndev // nproc, 1)
+  if weights is None:
+    w = np.ones(nproc, dtype=np.float64)
+  else:
+    if len(weights) != nproc:
+      raise ValueError(f"need {nproc} weights, got {len(weights)}")
+    w = np.maximum(np.asarray(weights, dtype=np.float64), 1e-9)
+  w = w / w.sum()
+  bounds = np.floor(np.cumsum(w) * n_pages + 0.5).astype(np.int64)
+  bounds[-1] = n_pages
+  starts = np.concatenate([[0], bounds[:-1]])
+  lens = np.maximum(bounds - starts, 0)
+  per = int(-(-max(int(lens.max()), 1) // ldev) * ldev)
+  pid = jax.process_index()
+  return int(starts[pid]), int(bounds[pid]), per
+
+
 def from_process_local(mesh, local_batch: np.ndarray, per: int):
   """Assemble the global sharded batch from each host's local chunks.
 
