@@ -2151,19 +2151,31 @@ def fleet_check(queue_spec, journal_path, window_sec, stall_sec,
               help="Render N frames then exit [default: until Ctrl-C].")
 @click.option("--no-clear", is_flag=True,
               help="Append frames instead of redrawing in place.")
+@click.option("--once", is_flag=True,
+              help="Render exactly one frame and exit (same as "
+                   "--iterations 1).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit each frame as one JSON object (report + queue "
+                   "snapshot) instead of the ANSI dashboard — for "
+                   "dashboards and the simulator's live-vs-predicted "
+                   "comparison. Implies --no-clear.")
 def fleet_watch(queue_spec, journal_path, window_sec, stall_sec,
                 straggler_ratio, horizon_sec, interval, iterations,
-                no_clear):
+                no_clear, once, as_json):
   """Live fleet dashboard over the journal rollups: status, per-worker
   table, stragglers, alerts, autoscale — refreshed in place."""
+  import json as json_mod
   import time as time_mod
 
   from . import secrets
   from .observability import health
 
   queue_spec = queue_spec or secrets.queue_url()
+  if once:
+    iterations = 1
   n = 0
   while True:
+    report = queue_stats = None
     try:
       _path, report, queue_stats = _evaluate_health(
         queue_spec, journal_path,
@@ -2172,14 +2184,397 @@ def fleet_watch(queue_spec, journal_path, window_sec, stall_sec,
       lines = health.render_dashboard(report, queue_stats)
     except click.ClickException as e:
       lines = [f"fleet watch: {e.message} (waiting...)"]
-    if not no_clear:
-      click.echo("\x1b[2J\x1b[H", nl=False)
-    for line in lines:
-      click.echo(line)
+    if as_json:
+      click.echo(json_mod.dumps({
+        "report": report, "queue": queue_stats,
+        "error": None if report is not None else lines[0],
+      }))
+    else:
+      if not no_clear:
+        click.echo("\x1b[2J\x1b[H", nl=False)
+      for line in lines:
+        click.echo(line)
     n += 1
     if iterations is not None and n >= iterations:
       return
     time_mod.sleep(max(interval, 0.0))
+
+
+def _parse_kv_spec(spec, caster=float):
+  out = {}
+  for part in (spec or "").split(","):
+    part = part.strip()
+    if not part:
+      continue
+    if "=" not in part:
+      raise click.UsageError(f"expected key=value, got {part!r}")
+    k, v = part.split("=", 1)
+    try:
+      out[k.strip()] = caster(v)
+    except ValueError:
+      raise click.UsageError(f"bad value in {part!r}")
+  return out
+
+
+def _load_or_mine_model(mine_path, model_path, window_sec=None):
+  from .observability import replay
+
+  if model_path:
+    import json as json_mod
+
+    with open(model_path) as f:
+      return replay.WorkloadModel.from_dict(json_mod.load(f))
+  model = replay.mine_journal(mine_path, window_sec=window_sec)
+  if not model.task_types:
+    raise click.ClickException(
+      f"no task spans to mine under {mine_path} — run a journaled "
+      "campaign first, or pass --model"
+    )
+  return model
+
+
+def _autoscale_policy_opts(fn):
+  for opt in (
+    click.option("--step-max", default=None, type=int,
+                 help="Max workers added/removed per action "
+                      "[default: $IGNEOUS_AUTOSCALE_STEP_MAX or uncapped]."),
+    click.option("--cooldown-sec", default=None, type=float,
+                 help="Min seconds between scale actions "
+                      "[default: $IGNEOUS_AUTOSCALE_COOLDOWN_SEC or 60]."),
+    click.option("--hysteresis", default=None, type=float,
+                 help="Dead band around current size "
+                      "[default: $IGNEOUS_AUTOSCALE_HYSTERESIS or 0.2]."),
+    click.option("--horizon-sec", "as_horizon_sec", default=None, type=float,
+                 help="Drain the backlog within this many seconds "
+                      "[default: $IGNEOUS_AUTOSCALE_HORIZON_SEC or 600]."),
+    click.option("--max-workers", default=None, type=int,
+                 help="Fleet ceiling [default: $IGNEOUS_AUTOSCALE_MAX "
+                      "or 1000]."),
+    click.option("--min-workers", default=None, type=int,
+                 help="Fleet floor [default: $IGNEOUS_AUTOSCALE_MIN or 1]."),
+  ):
+    fn = opt(fn)
+  return fn
+
+
+def _policy_from_opts(min_workers, max_workers, as_horizon_sec, hysteresis,
+                      cooldown_sec, step_max):
+  from .observability import autoscale
+
+  return autoscale.AutoscalePolicy.from_env(
+    min_workers=min_workers, max_workers=max_workers,
+    horizon_sec=as_horizon_sec, hysteresis=hysteresis,
+    cooldown_sec=cooldown_sec, step_max=step_max,
+  )
+
+
+@fleet_group.command("simulate")
+@_journal_opts
+@click.option("--from-journal", "mine_path", default=None,
+              help="Journal to mine the workload model from [default: the "
+                   "--journal/--queue location].")
+@click.option("--model", "model_path", default=None,
+              help="Load a saved workload_model.json instead of mining.")
+@click.option("--save-model", "save_model_path", default=None,
+              help="Write the mined model JSON here (commit it, diff it, "
+                   "re-simulate it months later).")
+@click.option("--workers", default=4, show_default=True, type=int)
+@click.option("--tasks", default=None, type=int,
+              help="Scale the campaign to N total tasks (mined mix "
+                   "proportions kept) [default: replay the mined counts].")
+@click.option("--seed", default=0, show_default=True, type=int)
+@click.option("--batch-size", default=None, type=int,
+              help="Members per lease round [default: $IGNEOUS_SIM_BATCH "
+                   "or 1].")
+@click.option("--fail-scale", default=None, type=float,
+              help="Multiply mined failure probabilities (what-if on "
+                   "fault rates) [default: $IGNEOUS_SIM_FAIL_SCALE or 1].")
+@click.option("--policy", "policy_mode",
+              type=click.Choice(["fixed", "auto"]), default="fixed",
+              show_default=True,
+              help="fixed = N workers for the whole run; auto = a virtual "
+                   "autoscale controller (the SAME PolicyLoop `fleet "
+                   "autoscale` runs) sizes the fleet as it goes.")
+@_autoscale_policy_opts
+@click.option("--chaos", "chaos_spec", default=None,
+              help="Fault injection, e.g. "
+                   "'preempt=1,kill=1,stragglers=2,stall=1'. Keys: "
+                   "preempt, preempt_at, kill, kill_at, stragglers, "
+                   "straggler_factor, stall, stall_at.")
+@click.option("--what-if", "what_if_spec", default=None,
+              help="Comma-separated alternative worker counts to forecast "
+                   "alongside the base run, e.g. '1,8,32'.")
+@click.option("--cost-per-worker-hour", default=0.0, show_default=True,
+              type=float, help="Price forecasts in $ (0 = no cost column).")
+@click.option("--emit-journal", "emit_path", default=None,
+              help="Write the simulated run AS journal segments here — "
+                   "`igneous fleet status|watch|top|trace` and the "
+                   "Perfetto exporter work on it unchanged.")
+@click.option("--base-ts", default=0.0, show_default=True, type=float,
+              help="Timestamp anchor for --emit-journal (0 keeps output "
+                   "bit-identical across same-seed reruns; pass a unix "
+                   "time to overlay simulated history on live dashboards).")
+@click.option("--json", "as_json", is_flag=True, help="Machine-readable.")
+@click.option("--out", "out_path", default=None,
+              help="Also write the full forecast JSON here (CI artifact).")
+def fleet_simulate(queue_spec, journal_path, mine_path, model_path,
+                   save_model_path, workers, tasks, seed, batch_size,
+                   fail_scale, policy_mode, min_workers, max_workers,
+                   as_horizon_sec, hysteresis, cooldown_sec, step_max,
+                   chaos_spec, what_if_spec, cost_per_worker_hour,
+                   emit_path, base_ts, as_json, out_path):
+  """Forecast a campaign on virtual workers from mined journal history.
+
+  Mines per-task-type empirical distributions (durations with their
+  straggler tails, retry probabilities, lease-round overhead, worker
+  speed spread) out of a real journal, then replays the campaign through
+  a deterministic discrete-event simulation of the queue semantics —
+  leases, redeliveries, DLQ, pre-lease rounds, preemption/kill/straggler
+  chaos, and optionally the autoscale policy loop itself. Same seed,
+  same model, same config => bit-identical forecast AND journal bytes."""
+  import json as json_mod
+
+  from . import secrets
+  from .observability import replay, sim as sim_mod
+
+  queue_spec = queue_spec or secrets.queue_url()
+  if not model_path:
+    mine_path = mine_path or _journal_location(queue_spec, journal_path)
+  model = _load_or_mine_model(mine_path, model_path)
+  if save_model_path:
+    with open(save_model_path, "w") as f:
+      json_mod.dump(model.to_dict(), f)
+
+  chaos = sim_mod.ChaosSpec(**{
+    k: (int(v) if k in ("preempt", "kill", "stragglers", "stall") else v)
+    for k, v in _parse_kv_spec(chaos_spec).items()
+  }) if chaos_spec else sim_mod.ChaosSpec()
+  policy = _policy_from_opts(min_workers, max_workers, as_horizon_sec,
+                             hysteresis, cooldown_sec, step_max)
+  cfg = sim_mod.SimConfig.from_env(
+    workers=workers, seed=seed, tasks=tasks, batch_size=batch_size,
+    fail_scale=fail_scale, base_ts=base_ts,
+    cost_per_worker_hour=cost_per_worker_hour,
+  )
+  cfg.chaos = chaos
+  cfg.autoscale = policy_mode == "auto"
+  cfg.policy = policy
+
+  results = sim_mod.simulate(model, cfg, journal_path=emit_path)
+  alternatives = []
+  if what_if_spec:
+    counts = [int(x) for x in what_if_spec.split(",") if x.strip()]
+    alternatives = sim_mod.what_if(model, cfg, counts)
+
+  payload = {
+    "model": model.summary(),
+    "config": {
+      "workers": cfg.workers, "seed": cfg.seed,
+      "batch_size": cfg.batch_size, "policy": policy_mode,
+      "fail_scale": cfg.fail_scale, "tasks": results["tasks"],
+    },
+    "forecast": results,
+    "what_if": alternatives,
+  }
+  if out_path:
+    with open(out_path, "w") as f:
+      json_mod.dump(payload, f, indent=2)
+  if as_json:
+    click.echo(json_mod.dumps(payload, indent=2))
+    return
+
+  ms = model.summary()
+  click.echo(
+    f"model: {ms['tasks_seen']} tasks mined across "
+    f"{len(ms['task_types'])} type(s); round overhead p50 "
+    f"{ms['round_overhead_p50_ms']}ms"
+  )
+  for name, t in ms["task_types"].items():
+    click.echo(
+      f"  {name:<30} n={t['count']:<6} p50 {t['p50_ms']}ms  "
+      f"p95 {t['p95_ms']}ms  fail {t['fail_prob'] * 100:.1f}%"
+    )
+  r = results
+  mode = "autoscaled" if cfg.autoscale else "fixed"
+  click.echo(
+    f"forecast ({mode}, {r['workers']} worker(s), seed {r['seed']}): "
+    f"{r['tasks']} tasks in {r['makespan_sec']}s "
+    f"({r['tasks_per_sec']}/s, utilization "
+    f"{r['utilization'] * 100:.0f}%)"
+  )
+  click.echo(
+    f"  completed {r['completed']}  dlq {r['dlq']}  retries "
+    f"{r['failed_deliveries']}  lease recycles {r['lease_recycles']}  "
+    f"released {r['released']}"
+    + (f"  cost ${r['cost_usd']}" if r["cost_usd"] is not None else "")
+  )
+  if r["scale_events"]:
+    click.echo(f"  scale events: {len(r['scale_events'])} "
+               f"(peak {r['peak_workers']} workers)")
+  if not r["completed_all"]:
+    click.echo("  WARNING: campaign did not complete "
+               f"(timed_out={r['timed_out']})")
+  if alternatives:
+    click.echo("what-if:")
+    click.echo(f"  {'workers':>8}  {'makespan_s':>11}  {'delta':>8}  "
+               f"{'dlq':>5}  {'util':>6}  cost")
+    for alt in alternatives:
+      delta = alt["makespan_sec"] - r["makespan_sec"]
+      cost = f"${alt['cost_usd']}" if alt["cost_usd"] is not None else "-"
+      click.echo(
+        f"  {alt['workers']:>8}  {alt['makespan_sec']:>11}  "
+        f"{delta:>+8.1f}  {alt['dlq']:>5}  "
+        f"{alt['utilization'] * 100:>5.0f}%  {cost}"
+      )
+  if emit_path:
+    click.echo(
+      f"emitted {results['journal_segments']} journal segment(s) to "
+      f"{emit_path} (try: igneous fleet status --journal {emit_path})"
+    )
+
+
+@fleet_group.command("autoscale")
+@_journal_opts
+@_autoscale_policy_opts
+@click.option("--actuator", "actuator_kind",
+              type=click.Choice(["local", "textfile", "command"]),
+              default="local", show_default=True,
+              help="local = spawn/drain real `igneous execute` "
+                   "subprocesses; textfile = atomically publish the "
+                   "target for an external reconciler; command = shell "
+                   "out to a template with {n}.")
+@click.option("--target-file", default=None,
+              help="Path for --actuator textfile.")
+@click.option("--scale-command", default=None,
+              help="Template for --actuator command, e.g. "
+                   "'kubectl scale --replicas={n} deploy/igneous-worker'.")
+@click.option("--worker-arg", "worker_args", multiple=True,
+              help="Extra args for spawned workers (local actuator), "
+                   "repeatable.")
+@click.option("--interval", default=None, type=float,
+              help="Seconds between controller ticks "
+                   "[default: $IGNEOUS_AUTOSCALE_INTERVAL_SEC or 15].")
+@click.option("--iterations", default=None, type=int,
+              help="Tick N times then exit [default: until drained or "
+                   "Ctrl-C].")
+@click.option("--drain-exit/--no-drain-exit", default=True,
+              show_default=True,
+              help="Exit once the backlog is empty and the pool is at "
+                   "the policy floor (batch-campaign mode). "
+                   "--no-drain-exit runs as a service.")
+@click.option("--validate/--no-validate", default=True, show_default=True,
+              help="Before touching the fleet, replay the mined journal "
+                   "through the simulator under THIS policy and abort if "
+                   "the simulated campaign fails to complete.")
+@click.option("--json", "as_json", is_flag=True,
+              help="One JSON object per controller decision.")
+def fleet_autoscale(queue_spec, journal_path, min_workers, max_workers,
+                    as_horizon_sec, hysteresis, cooldown_sec, step_max,
+                    actuator_kind, target_file, scale_command, worker_args,
+                    interval, iterations, drain_exit, validate, as_json):
+  """Closed-loop fleet autoscaler: act on the HealthEngine's
+  desired_workers signal.
+
+  Each tick reads the journal + live queue depth, runs the SAME policy
+  formula the health report and the simulator use, damps it (hysteresis,
+  cooldown, step cap), and actuates. Scale-down is always graceful
+  SIGTERM drain; nothing is ever killed."""
+  import json as json_mod
+  import time as time_mod
+
+  from . import secrets
+  from .observability import autoscale, sim as sim_mod
+  from .queues import TaskQueue
+
+  queue_spec = queue_spec or secrets.queue_url()
+  if not queue_spec:
+    raise click.UsageError("fleet autoscale needs a queue (-q or "
+                           "$QUEUE_URL): backlog drives the policy")
+  path = _journal_location(queue_spec, journal_path)
+  policy = _policy_from_opts(min_workers, max_workers, as_horizon_sec,
+                             hysteresis, cooldown_sec, step_max)
+
+  if validate:
+    from .observability import replay
+
+    try:
+      model = replay.mine_journal(path)
+    except Exception:
+      model = None
+    if model and model.task_types:
+      cfg = sim_mod.SimConfig.from_env(workers=policy.min_workers)
+      cfg.autoscale = True
+      cfg.policy = policy
+      forecast = sim_mod.simulate(model, cfg)
+      if not forecast["completed_all"]:
+        raise click.ClickException(
+          "policy validation failed: the simulated campaign did not "
+          f"complete (dlq={forecast['dlq']}, "
+          f"timed_out={forecast['timed_out']}). Loosen the policy or "
+          "pass --no-validate."
+        )
+      click.echo(
+        f"policy validated in simulation: {forecast['tasks']} tasks in "
+        f"{forecast['makespan_sec']}s, peak {forecast['peak_workers']} "
+        f"worker(s), {len(forecast['scale_events'])} scale event(s)",
+        err=True,
+      )
+    else:
+      click.echo("policy validation skipped: no task history to mine yet",
+                 err=True)
+
+  if actuator_kind == "local":
+    actuator = autoscale.LocalPoolActuator(
+      queue_spec, worker_args=list(worker_args),
+    )
+  elif actuator_kind == "textfile":
+    if not target_file:
+      raise click.UsageError("--actuator textfile needs --target-file")
+    actuator = autoscale.TextfileActuator(target_file)
+  else:
+    if not scale_command:
+      raise click.UsageError("--actuator command needs --scale-command")
+    actuator = autoscale.CommandActuator(scale_command)
+
+  controller = autoscale.AutoscaleController(
+    path, TaskQueue(queue_spec), actuator,
+    policy=policy, interval_sec=interval,
+  )
+  n = 0
+  try:
+    while True:
+      decision = controller.step()
+      if as_json:
+        click.echo(json_mod.dumps(decision))
+      else:
+        click.echo(
+          f"[{time_mod.strftime('%H:%M:%S')}] backlog "
+          f"{decision['backlog']}  rate {decision['per_worker_rate']}/s"
+          f"/worker  {decision['current']} -> {decision['target']} "
+          f"({decision['reason']})"
+        )
+      n += 1
+      actuator.reap()
+      if (
+        drain_exit and decision["backlog"] <= 0
+        and actuator.current() <= policy.min_workers
+        and n > 1
+      ):
+        break
+      if iterations is not None and n >= iterations:
+        break
+      time_mod.sleep(controller.interval_sec)
+  finally:
+    actuator.shutdown()
+  summary = {
+    "ticks": n,
+    "actions": sum(1 for d in controller.history if d["actuated"]),
+  }
+  if isinstance(actuator, autoscale.LocalPoolActuator):
+    summary["spawned"] = actuator.stats["spawned"]
+    summary["drained"] = actuator.stats["drained"]
+    summary["exits"] = actuator.stats["exits"]
+  click.echo(json_mod.dumps(summary))
 
 
 # on-demand profiler capture (ISSUE 7)

@@ -27,11 +27,30 @@ The subsystem in one picture::
     dashboard, recompile-storm/HBM/idle anomalies; profile/request.json
     triggers on-demand jax.profiler captures → <journal>/profiles/
 
+    forward plane (ISSUE 13): replay.py mines the journal into a
+    WorkloadModel; sim.py replays it through a deterministic
+    discrete-event fleet simulation that EMITS journal format (every
+    fleet command works on simulated runs); autoscale.py closes the
+    loop — the same policy formula drives the health report, the
+    simulator's virtual controller, and `igneous fleet autoscale`
+
 ``igneous_tpu.telemetry`` remains as a compat shim over
 :mod:`.metrics`; new code should import from here.
 """
 
-from . import device, fleet, health, journal, perfetto, prom, rollup, trace
+from . import (
+  autoscale,
+  device,
+  fleet,
+  health,
+  journal,
+  perfetto,
+  prom,
+  replay,
+  rollup,
+  sim,
+  trace,
+)
 from .metrics import (
   StageTimes,
   counters_snapshot,
@@ -54,8 +73,8 @@ from .metrics import (
 )
 
 __all__ = [
-  "device", "fleet", "health", "journal", "perfetto", "prom", "rollup",
-  "trace",
+  "autoscale", "device", "fleet", "health", "journal", "perfetto",
+  "prom", "replay", "rollup", "sim", "trace",
   "StageTimes", "counters_snapshot", "device_trace", "emit_counters",
   "gauge_max", "gauge_set", "gauges_snapshot", "histograms_snapshot",
   "incr", "observe", "observe_quiet", "queue_eta", "reset_all",

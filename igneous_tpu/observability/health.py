@@ -31,7 +31,6 @@ for CI/cron, and the live ``igneous fleet watch`` dashboard rendered by
 from __future__ import annotations
 
 import json
-import math
 import os
 import socket
 import time
@@ -192,8 +191,9 @@ class HealthEngine:
 
     def seen(worker, ts):
       # "health-*" actors are check/cron processes appending health.*
-      # events, not fleet workers — never liveness targets
-      if worker and ts and not worker.startswith("health-"):
+      # events, not fleet workers — never liveness targets; ditto the
+      # autoscale controller's own journal records
+      if worker and ts and not worker.startswith(("health-", "autoscale-")):
         v = view(worker)
         v["last_seen"] = max(v["last_seen"], float(ts))
 
@@ -498,19 +498,18 @@ class HealthEngine:
     contributing = [w for w, v in per.items() if v["task_durs"]]
     current = len(active)
     per_worker_rate = tasks_per_sec / max(len(contributing), 1)
-    if backlog <= 0:
-      desired = cfg.min_workers
-    elif per_worker_rate <= 0:
-      desired = max(current, cfg.min_workers)
-    else:
-      desired = int(math.ceil(backlog / (per_worker_rate * cfg.horizon_sec)))
-    desired = max(cfg.min_workers, min(cfg.max_workers, desired))
-    damped = False
-    if (
-      backlog > 0 and current > 0
-      and abs(desired - current) / current <= cfg.hysteresis
-    ):
-      desired, damped = current, True
+    # the desired-workers formula lives in observability.autoscale so
+    # the HealthEngine report, the fleet simulator, and the live
+    # controller share one implementation (ISSUE 13 policy extraction)
+    from .autoscale import AutoscalePolicy, compute_desired
+
+    desired, damped = compute_desired(
+      backlog, per_worker_rate, current,
+      AutoscalePolicy(
+        min_workers=cfg.min_workers, max_workers=cfg.max_workers,
+        horizon_sec=cfg.horizon_sec, hysteresis=cfg.hysteresis,
+      ),
+    )
 
     workers_report = {
       w: {
@@ -749,6 +748,10 @@ def render_dashboard(report: dict, queue_stats: Optional[dict] = None,
       + (
         f"  hbm peak {dev['hbm_peak_frac'] * 100:.0f}%"
         if dev.get("hbm_peak_frac") is not None else ""
+      )
+      + (
+        f"  pad waste {dev['pad_waste_ratio'] * 100:.1f}%"
+        if dev.get("pad_waste_ratio") is not None else ""
       )
       + (
         f"  fastpath {fp.get('batched', 0)}/{fp_total} batched"

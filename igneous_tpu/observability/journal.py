@@ -34,7 +34,45 @@ from . import metrics, trace
 
 FLUSH_SEC_ENV = "IGNEOUS_JOURNAL_FLUSH_SEC"
 PATH_ENV = "IGNEOUS_JOURNAL"
+COMPRESS_ENV = "IGNEOUS_JOURNAL_COMPRESS"
 DEFAULT_FLUSH_SEC = 30.0
+
+_GZIP_MAGIC = b"\x1f\x8b"
+
+
+def compression_enabled() -> bool:
+  return os.environ.get(COMPRESS_ENV, "") not in ("", "0", "false")
+
+
+def encode_segment(data: bytes) -> bytes:
+  """Segment bytes as written: gzip when ``IGNEOUS_JOURNAL_COMPRESS=1``
+  (mtime pinned to 0 so identical content is identical bytes — the
+  simulator's bit-identical-rerun contract extends through compression),
+  plain JSONL otherwise. Segment names stay ``*.jsonl`` either way; the
+  read side sniffs the gzip magic, so mixed journals (campaign enabled
+  compression midway) merge fine."""
+  if not compression_enabled():
+    return data
+  import gzip
+  import io
+
+  buf = io.BytesIO()
+  with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz:
+    gz.write(data)
+  return buf.getvalue()
+
+
+def decode_segment(data: bytes) -> bytes:
+  """Inverse of :func:`encode_segment`, keyed on magic bytes rather than
+  the env — readers never need to know how the writer was configured."""
+  if data[:2] == _GZIP_MAGIC:
+    import gzip
+
+    try:
+      return gzip.decompress(data)
+    except OSError:
+      return data
+  return data
 
 # extra-record providers: callables returning a list of record dicts to
 # append to every flushed segment (the device plane's utilization ledger
@@ -167,7 +205,7 @@ class Journal:
         lines.append(json.dumps(rec))
       name = f"{self.worker_id}-{self._seq:06d}.jsonl"
       self._seq += 1
-      data = ("\n".join(lines) + "\n").encode("utf8")
+      data = encode_segment(("\n".join(lines) + "\n").encode("utf8"))
     try:
       from ..storage import CloudFiles
 
@@ -206,7 +244,7 @@ class Journal:
         return None
       name = f"{self.worker_id}-{self._seq:06d}.jsonl"
       self._seq += 1
-      data = ("\n".join(lines) + "\n").encode("utf8")
+      data = encode_segment(("\n".join(lines) + "\n").encode("utf8"))
     try:
       from ..storage import CloudFiles
 
@@ -327,6 +365,7 @@ def read_records(cloudpath: str,
     data = cf.get(key)
     if data is None:
       continue
+    data = decode_segment(data)
     for line in data.decode("utf8", errors="replace").splitlines():
       line = line.strip()
       if not line:

@@ -117,6 +117,7 @@ def load_rollups(cloudpath: str) -> Tuple[List[dict], Dict[str, float]]:
     data = cf.get(key)
     if data is None:
       continue
+    data = journal_mod.decode_segment(data)
     recs = []
     for line in data.decode("utf8", errors="replace").splitlines():
       line = line.strip()
@@ -266,8 +267,8 @@ def compact(
 
   _SEQ[0] += 1
   name = f"{ROLLUP_PREFIX}{actor}-{int(time.time() * 1000):013d}-{_SEQ[0]:04d}.jsonl"
-  CloudFiles(cloudpath).put(name, ("\n".join(lines) + "\n").encode("utf8"),
-                            compress=None)
+  data = journal_mod.encode_segment(("\n".join(lines) + "\n").encode("utf8"))
+  CloudFiles(cloudpath).put(name, data, compress=None)
   metrics.incr("rollup.compactions")
   metrics.incr("rollup.segments_folded", len(segs))
   return {

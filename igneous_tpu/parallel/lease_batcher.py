@@ -334,12 +334,24 @@ class LeaseBatcher:
         # the budget shrank between prefetch and now: surplus goes back
         self._release_members(members[cap:])
         members = members[:cap]
+      lease_t0 = time.time()
+      synced = 0
       while len(members) < cap and not self._draining():
         leased = self.queue.lease(self.lease_seconds)
         if leased is None:
           break
         members.append(leased)
         self._hb.track(leased[1])
+        synced += 1
+      if synced:
+        # per-round queue-interaction cost: the workload miner folds
+        # these into the round-overhead distribution the fleet
+        # simulator replays, so batched campaigns simulate queue time,
+        # not just compute
+        trace.record_root(
+          "lease.acquire", lease_t0, time.time() - lease_t0,
+          members=synced,
+        )
       if self._draining():
         # preempted between lease and dispatch: nothing ran, so every
         # member goes straight back (_release_members untracks each
@@ -555,6 +567,8 @@ class LeaseBatcher:
     for _task, lease_id in members:
       self._hb.track(lease_id)  # idempotent for pre-leased members
     t0 = time.time()
+    before_exec = self.stats["executed"]
+    before_fail = self.stats["failed"]
     try:
       self._run_round_inner(members)
     finally:
@@ -562,6 +576,8 @@ class LeaseBatcher:
       # completions) under the process's own trace id
       trace.record_root(
         "lease.round", t0, time.time() - t0, members=len(members),
+        executed=self.stats["executed"] - before_exec,
+        failed=self.stats["failed"] - before_fail,
       )
       # cutouts this round's writes made stale must never feed a later
       # round from the prefetch cache (a member re-leased after failure,

@@ -78,3 +78,16 @@ class FailTask(RegisteredTask):
 
   def execute(self):
     raise RuntimeError(self.message)
+
+
+class SleepTask(RegisteredTask):
+  """Sleeps for a fixed duration; gives smoke campaigns (and the fleet
+  simulator's calibration runs) a task whose true cost is known."""
+
+  def __init__(self, seconds: float = 0.05):
+    self.seconds = seconds
+
+  def execute(self):
+    import time
+
+    time.sleep(float(self.seconds))

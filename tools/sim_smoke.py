@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Fleet-simulator smoke: mine a real campaign, predict it, close the loop.
+
+CI acceptance for ISSUE 13, in four acts:
+
+1. run a seeded, journaled SleepTask campaign against a real fq:// queue
+   and measure its wall-clock;
+2. mine the journal into a WorkloadModel, simulate the same campaign,
+   and assert the predicted completion time lands within +/-20% of the
+   measured one — and that two same-seed simulations are bit-identical
+   (results AND emitted journal bytes);
+3. run `igneous fleet status` against the *simulated* journal and
+   require exit 0 (simulated output is first-class journal format);
+4. inject a backlog and let `igneous fleet autoscale` (local subprocess
+   actuator, scale-to-zero floor) scale a real worker pool up and back
+   down, asserted via the autoscale.* counters the controller journals.
+
+Writes sim-report.json next to the CWD for the CI artifact upload.
+Exit 0 = all gates passed.
+"""
+
+import hashlib
+import json
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from click.testing import CliRunner  # noqa: E402
+
+from igneous_tpu.cli import main as cli_main  # noqa: E402
+from igneous_tpu.observability import fleet, replay, sim  # noqa: E402
+from igneous_tpu.queues import TaskQueue  # noqa: E402
+from igneous_tpu.tasks import SleepTask  # noqa: E402
+
+TASKS = 48
+SLEEP_SEC = 0.02
+BATCH = 4
+SEED = 1234
+TOLERANCE = 0.20
+
+report = {"gates": {}, "ok": False}
+failures = []
+
+
+def gate(name, ok, **detail):
+  report["gates"][name] = {"ok": bool(ok), **detail}
+  status = "PASS" if ok else "FAIL"
+  print(f"[sim_smoke] {status} {name}: {detail}")
+  if not ok:
+    failures.append(name)
+
+
+def journal_digest(path):
+  h = hashlib.sha256()
+  for f in sorted(pathlib.Path(path).rglob("*")):
+    if f.is_file():
+      h.update(f.name.encode())
+      h.update(f.read_bytes())
+  return h.hexdigest()
+
+
+def main():
+  workdir = tempfile.mkdtemp(prefix="sim_smoke_")
+  runner = CliRunner()
+  try:
+    # -- act 1: the real campaign ------------------------------------------
+    qpath = os.path.join(workdir, "campaign")
+    qspec = f"fq://{qpath}"
+    TaskQueue(qspec).insert(
+      [SleepTask(seconds=SLEEP_SEC) for _ in range(TASKS)]
+    )
+    t0 = time.monotonic()
+    res = runner.invoke(cli_main, [
+      "execute", qspec, "-x", "--quiet", "--batch", str(BATCH),
+    ])
+    actual_sec = time.monotonic() - t0
+    gate("campaign", res.exit_code == 0,
+         exit_code=res.exit_code, wall_sec=round(actual_sec, 3))
+    if res.exit_code != 0:
+      print(res.output[-2000:])
+      raise SystemExit(1)
+
+    # -- act 2: mine + predict + determinism --------------------------------
+    jpath = f"file://{qpath}/journal"
+    model = replay.mine_journal(jpath)
+    gate("mining", model.total_tasks() >= TASKS,
+         tasks_mined=model.total_tasks(),
+         types=sorted(model.task_types))
+
+    def run_sim(outdir):
+      cfg = sim.SimConfig(
+        workers=1, seed=SEED, batch_size=BATCH, poll_sec=0.5,
+      )
+      s = sim.FleetSimulator(model, cfg)
+      results = s.run()
+      s.write_journal(f"file://{outdir}")
+      return results
+
+    sim_a = os.path.join(workdir, "sim_a")
+    sim_b = os.path.join(workdir, "sim_b")
+    ra = run_sim(sim_a)
+    rb = run_sim(sim_b)
+    predicted = ra["makespan_sec"]
+    err = abs(predicted - actual_sec) / actual_sec
+    gate("prediction", err <= TOLERANCE,
+         predicted_sec=predicted, actual_sec=round(actual_sec, 3),
+         relative_error=round(err, 4), tolerance=TOLERANCE)
+    gate("determinism",
+         ra == rb and journal_digest(sim_a) == journal_digest(sim_b),
+         digest=journal_digest(sim_a)[:16])
+    report["forecast"] = ra
+
+    # -- act 3: fleet status on the simulated journal ----------------------
+    res = runner.invoke(cli_main, [
+      "fleet", "status", "--journal", f"file://{sim_a}",
+    ])
+    gate("fleet_status_on_sim", res.exit_code == 0,
+         exit_code=res.exit_code)
+    if res.exit_code != 0:
+      print(res.output[-2000:])
+
+    # -- act 4: the real autoscale loop ------------------------------------
+    qpath2 = os.path.join(workdir, "autoscale")
+    qspec2 = f"fq://{qpath2}"
+    TaskQueue(qspec2).insert(
+      [SleepTask(seconds=SLEEP_SEC) for _ in range(90)]
+    )
+    res = runner.invoke(cli_main, [
+      "fleet", "autoscale", "-q", qspec2,
+      "--min-workers", "0", "--max-workers", "3",
+      "--horizon-sec", "2", "--cooldown-sec", "0.5", "--interval", "1.5",
+      "--worker-arg", "--quiet",
+      "--no-validate", "--json", "--iterations", "40",
+    ])
+    drained = TaskQueue(qspec2).backlog == 0
+    counters = {}
+    for rec in fleet.load_effective(f"file://{qpath2}/journal"):
+      if (
+        rec.get("kind") == "counters"
+        and str(rec.get("worker", "")).startswith("autoscale-")
+      ):
+        counters = rec.get("counters") or counters
+    ups = counters.get("autoscale.scale_up", 0)
+    downs = counters.get("autoscale.scale_down", 0)
+    gate("autoscale_loop",
+         res.exit_code == 0 and drained and ups >= 1 and downs >= 1,
+         exit_code=res.exit_code, drained=drained,
+         scale_up=ups, scale_down=downs)
+    if res.exit_code != 0:
+      print(res.output[-2000:])
+    report["autoscale_counters"] = {
+      k: v for k, v in counters.items() if k.startswith("autoscale.")
+    }
+  finally:
+    report["ok"] = not failures
+    with open("sim-report.json", "w") as f:
+      json.dump(report, f, indent=2)
+    shutil.rmtree(workdir, ignore_errors=True)
+  if failures:
+    print(f"[sim_smoke] FAILED gates: {failures}")
+    return 1
+  print("[sim_smoke] all gates passed")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
