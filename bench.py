@@ -35,6 +35,8 @@ import time
 
 import numpy as np
 
+from igneous_tpu.analysis import knobs
+
 QUICK = bool(int(os.environ.get("BENCH_QUICK", "0")))
 INIT_TIMEOUT_S = int(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
@@ -308,7 +310,7 @@ def bench_trace_overhead(img, seg):
   the traced run doubles as the per-stage summary BENCH reports."""
   from igneous_tpu.observability import trace as trace_mod
 
-  prev = os.environ.get("IGNEOUS_TRACE_SAMPLE")
+  prev = knobs.raw("IGNEOUS_TRACE_SAMPLE")
 
   def restore():
     if prev is None:

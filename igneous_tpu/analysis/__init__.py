@@ -1,0 +1,15 @@
+"""Project-native static analysis (`igneous lint`, ISSUE 14).
+
+Five AST passes over the repo's own invariants — knob registry
+(IGN1xx), recompile/host-sync hazards (IGN2xx), lock discipline
+(IGN3xx), determinism (IGN4xx), telemetry grammar (IGN5xx) — plus the
+:mod:`.knobs` registry every runtime module reads its ``IGNEOUS_*``
+configuration through, and the :mod:`.racecheck` dynamic lock checker.
+
+Stdlib-only by design (``ast``, ``re``, ``json``): the lint suite must
+run in CI before any heavy dependency imports.
+"""
+
+from . import knobs  # noqa: F401  (the runtime-facing registry)
+from .findings import Finding  # noqa: F401
+from .runner import main, run_passes  # noqa: F401

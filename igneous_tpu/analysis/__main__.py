@@ -1,0 +1,42 @@
+"""``python -m igneous_tpu.analysis`` — the `igneous lint` engine
+without the click dependency (CI can run it before `pip install -e .`
+finishes wiring entry points)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .runner import DEFAULT_BASELINE, PASS_IDS, main
+
+
+def cli(argv=None) -> int:
+  ap = argparse.ArgumentParser(
+    prog="igneous lint",
+    description="project-native static analysis (see README "
+                "'Static analysis')",
+  )
+  ap.add_argument("--root", default=".", help="repo root")
+  ap.add_argument("--knobs-md", action="store_true",
+                  help="print the generated README knob table")
+  ap.add_argument("--write", action="store_true",
+                  help="with --knobs-md: rewrite README.md in place")
+  ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                  help="baseline file (repo-relative)")
+  ap.add_argument("--update-baseline", action="store_true",
+                  help="accept current findings as the new baseline "
+                       "(env-knobs/telemetry passes refuse)")
+  ap.add_argument("--select", action="append", choices=PASS_IDS,
+                  help="run only these passes (repeatable)")
+  ap.add_argument("--json", action="store_true", dest="as_json")
+  args = ap.parse_args(argv)
+  return main(
+    args.root, knobs_md=args.knobs_md, write=args.write,
+    baseline_path=args.baseline,
+    update_baseline=args.update_baseline,
+    select=args.select, as_json=args.as_json,
+  )
+
+
+if __name__ == "__main__":
+  sys.exit(cli())

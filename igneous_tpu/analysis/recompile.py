@@ -1,0 +1,246 @@
+"""Pass IGN2 — recompile and host-sync hazards in device code.
+
+Scope: ``ops/``, ``parallel/``, ``infer/`` — the modules holding the
+one-signature-per-campaign guarantee (PR 12) and the device fast
+paths. Codes:
+
+IGN201  ``jax.jit``/``jax.pmap`` constructed inside a function body.
+        Module-level jit (or a decorator) compiles once; a jit built
+        per call recompiles per call. Exempt when the result lands in
+        a subscript cache slot (``self._fns[sig] = jax.jit(fn)`` —
+        the paged runner's signature cache) or the enclosing function
+        is ``functools.lru_cache``/``cache``-decorated.
+IGN202  ``jax.jit`` constructed inside a ``for``/``while`` loop — the
+        per-iteration variant of IGN201; never legitimate, no cache
+        exemption.
+IGN203  host sync inside a jit-decorated function body: ``.item()``,
+        ``np.asarray``/``np.array`` on a traced value, or
+        ``float()/int()/bool()`` of a non-constant. Each forces a
+        device round-trip mid-kernel (or a tracer error at runtime).
+IGN204  shape-constructor (``jnp.zeros/ones/full/empty/arange``)
+        inside a jit-decorated function whose shape argument names a
+        function parameter not routed through ``static_argnames`` —
+        a Python-value-dependent shape, i.e. recompile (or
+        concretization error) per distinct value.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .findings import Context, Finding, filter_suppressed
+
+PASS_ID = "recompile"
+
+SCOPE_DIRS = (
+  "igneous_tpu/ops/", "igneous_tpu/parallel/", "igneous_tpu/infer/",
+)
+_SHAPE_FNS = frozenset({"zeros", "ones", "full", "empty", "arange"})
+_CACHE_DECOS = frozenset({"lru_cache", "cache"})
+
+
+def _dotted(node: ast.AST) -> str:
+  parts = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+  return ".".join(reversed(parts))
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+  d = _dotted(node.func)
+  if d in ("jax.jit", "jax.pmap", "jit", "pmap"):
+    return True
+  # partial(jax.jit, static_argnames=...) / functools.partial(...)
+  if d.endswith("partial") and node.args:
+    return _dotted(node.args[0]) in ("jax.jit", "jax.pmap")
+  return False
+
+
+def _jit_decorator(deco: ast.AST) -> Optional[ast.Call]:
+  """The jit Call node if this decorator jits the function."""
+  if isinstance(deco, ast.Call) and _is_jit_call(deco):
+    return deco
+  if isinstance(deco, ast.Attribute) or isinstance(deco, ast.Name):
+    if _dotted(deco) in ("jax.jit", "jit"):
+      return ast.Call(func=deco, args=[], keywords=[])
+  return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+  names: Set[str] = set()
+  for kw in call.keywords:
+    if kw.arg in ("static_argnames", "static_argnums"):
+      for n in ast.walk(kw.value):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+          names.add(n.value)
+  return names
+
+
+def _has_cache_deco(fn: ast.AST) -> bool:
+  for deco in getattr(fn, "decorator_list", []):
+    d = deco.func if isinstance(deco, ast.Call) else deco
+    if _dotted(d).split(".")[-1] in _CACHE_DECOS:
+      return True
+  return False
+
+
+class _Walker(ast.NodeVisitor):
+  def __init__(self, src):
+    self.src = src
+    self.found: List[Finding] = []
+    self.fn_stack: List[ast.AST] = []
+    self.loop_depth = 0
+    # (params_not_static) for the innermost jit-decorated function
+    self.jit_stack: List[Set[str]] = []
+
+  # -- function / loop bookkeeping ----------------------------------
+  def _visit_fn(self, node):
+    # decorators and parameter defaults evaluate in the ENCLOSING
+    # scope — visit them before pushing this function
+    for deco in node.decorator_list:
+      self.visit(deco)
+    for dflt in node.args.defaults + node.args.kw_defaults:
+      if dflt is not None:
+        self.visit(dflt)
+    jit_call = None
+    for deco in node.decorator_list:
+      jit_call = jit_call or _jit_decorator(deco)
+    if jit_call is not None:
+      static = _static_argnames(jit_call)
+      params = {
+        a.arg for a in (node.args.posonlyargs + node.args.args
+                        + node.args.kwonlyargs)
+      } - static - {"self", "cls"}
+      self.jit_stack.append(params)
+    self.fn_stack.append(node)
+    outer_loops, self.loop_depth = self.loop_depth, 0
+    for stmt in node.body:
+      self.visit(stmt)
+    self.loop_depth = outer_loops
+    self.fn_stack.pop()
+    if jit_call is not None:
+      self.jit_stack.pop()
+
+  visit_FunctionDef = _visit_fn
+  visit_AsyncFunctionDef = _visit_fn
+
+  def _visit_loop(self, node):
+    self.loop_depth += 1
+    self.generic_visit(node)
+    self.loop_depth -= 1
+
+  visit_For = _visit_loop
+  visit_While = _visit_loop
+  visit_AsyncFor = _visit_loop
+
+  # -- jit construction sites ---------------------------------------
+  def visit_Assign(self, node):
+    if (isinstance(node.value, ast.Call) and _is_jit_call(node.value)
+        and self.fn_stack and not self.loop_depth):
+      # cache-slot assignment: self._fns[sig] = jax.jit(fn)
+      if all(isinstance(t, ast.Subscript) for t in node.targets):
+        for t in node.targets:
+          self.generic_visit(t)
+        return
+    self.generic_visit(node)
+
+  def visit_Call(self, node):
+    if _is_jit_call(node):
+      fn_name = getattr(self.fn_stack[-1], "name", "?") \
+        if self.fn_stack else ""
+      if self.loop_depth and self.fn_stack:
+        self.found.append(Finding(
+          "IGN202", self.src.rel, node.lineno,
+          f"jax.jit constructed inside a loop in {fn_name}() — "
+          f"recompiles every iteration; build once at module level",
+          f"jit-in-loop:{fn_name}",
+        ))
+      elif self.fn_stack and not _has_cache_deco(self.fn_stack[-1]):
+        self.found.append(Finding(
+          "IGN201", self.src.rel, node.lineno,
+          f"jax.jit constructed inside {fn_name}() — a fresh jit per "
+          f"call recompiles per call; hoist to module level or cache "
+          f"by signature",
+          f"jit-in-function:{fn_name}",
+        ))
+    self._check_host_sync(node)
+    self._check_dynamic_shape(node)
+    self.generic_visit(node)
+
+  # -- host syncs inside jit bodies ---------------------------------
+  def _check_host_sync(self, node: ast.Call):
+    if not self.jit_stack:
+      return
+    d = _dotted(node.func)
+    tail = d.split(".")[-1]
+    key = None
+    if tail == "item" and isinstance(node.func, ast.Attribute):
+      key = ".item()"
+    elif d in ("np.asarray", "np.array", "numpy.asarray",
+               "numpy.array", "onp.asarray", "onp.array"):
+      key = d
+    elif d in ("float", "int", "bool") and node.args and not (
+        isinstance(node.args[0], ast.Constant)):
+      key = f"{d}()"
+    if key:
+      self.found.append(Finding(
+        "IGN203", self.src.rel, node.lineno,
+        f"{key} inside a jit-decorated body forces a host sync (or a "
+        f"tracer error); keep the value on device or move the "
+        f"conversion outside the kernel",
+        f"host-sync:{key}:{node.lineno}",
+      ))
+
+  # -- python-value-dependent shapes --------------------------------
+  def _check_dynamic_shape(self, node: ast.Call):
+    if not self.jit_stack:
+      return
+    d = _dotted(node.func)
+    if not (d.startswith("jnp.") and d.split(".")[-1] in _SHAPE_FNS):
+      return
+    shape_arg = None
+    if node.args:
+      shape_arg = node.args[0]
+    for kw in node.keywords:
+      if kw.arg == "shape":
+        shape_arg = kw.value
+    if shape_arg is None:
+      return
+    nonstatic = self.jit_stack[-1]
+    # names under an Attribute chain (labels.shape, x.size) resolve to
+    # static ints under trace — only bare Names are shape hazards
+    skip = set()
+    for n in ast.walk(shape_arg):
+      if isinstance(n, ast.Attribute):
+        for sub in ast.walk(n.value):
+          if isinstance(sub, ast.Name):
+            skip.add(id(sub))
+    for n in ast.walk(shape_arg):
+      if (isinstance(n, ast.Name) and id(n) not in skip
+          and n.id in nonstatic):
+        self.found.append(Finding(
+          "IGN204", self.src.rel, node.lineno,
+          f"{d} shape references traced parameter {n.id!r} — route "
+          f"it through static_argnames or the shape recompiles per "
+          f"value",
+          f"dyn-shape:{n.id}:{node.lineno}",
+        ))
+        return
+
+
+def run(ctx: Context, files) -> List[Finding]:
+  out: List[Finding] = []
+  for abspath in files:
+    src = ctx.source(abspath)
+    if src.tree is None:
+      continue
+    if not any(s in src.rel for s in SCOPE_DIRS):
+      continue
+    w = _Walker(src)
+    w.visit(src.tree)
+    out.extend(filter_suppressed(src, w.found))
+  return out

@@ -35,7 +35,6 @@ into their own cutout assembly, never mutate the cached array.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 from collections import OrderedDict
 from typing import Iterable, Optional, Tuple
@@ -43,19 +42,20 @@ from typing import Iterable, Optional, Tuple
 import numpy as np
 
 from . import telemetry
+from .analysis import knobs, racecheck
 
 
 def enabled() -> bool:
-  val = os.environ.get("IGNEOUS_CHUNK_CACHE", "auto").strip().lower()
+  val = knobs.get_str("IGNEOUS_CHUNK_CACHE").strip().lower()
   if val in ("0", "off", "false", "no"):
     return False
   return True
 
 
 def budget_bytes() -> int:
-  env = os.environ.get("IGNEOUS_CHUNK_CACHE_MB")
-  if env:
-    return max(int(float(env) * 1e6), 1)
+  mb = knobs.get_float("IGNEOUS_CHUNK_CACHE_MB")
+  if mb:
+    return max(int(mb * 1e6), 1)
   from .pipeline import config
 
   return max(config.memory_budget_bytes() // 8, 1)
@@ -73,9 +73,11 @@ class ChunkDecodeCache:
   def __init__(self, budget: Optional[int] = None):
     self._budget = budget
     self._lock = threading.Lock()
-    self._entries: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
-    self._by_layer: dict = {}  # (path, mip) -> set of keys
-    self._bytes = 0
+    self._entries = racecheck.guard(  # guarded-by: self._lock
+      OrderedDict(), self._lock, "ChunkDecodeCache._entries")
+    self._by_layer = racecheck.guard(  # guarded-by: self._lock
+      {}, self._lock, "ChunkDecodeCache._by_layer")
+    self._bytes = 0  # guarded-by: self._lock
 
   @property
   def budget(self) -> int:

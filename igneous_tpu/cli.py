@@ -28,6 +28,8 @@ import sys
 
 import click
 
+from .analysis import knobs
+
 
 class Tuple3(click.ParamType):
   """'64,64,64' → (64, 64, 64) (reference cli.py:80-162 param types)."""
@@ -1450,13 +1452,13 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
     os.environ["SQS_REGION_NAME"] = aws_region
   if pipeline is not None:
     # env (not a param thread) so spawned workers inherit the choice
-    os.environ["IGNEOUS_PIPELINE"] = "1" if pipeline else "off"
+    knobs.set_env("IGNEOUS_PIPELINE", "1" if pipeline else "off")
   if journal_path is not None:
-    os.environ["IGNEOUS_JOURNAL"] = journal_path  # children inherit too
+    knobs.set_env("IGNEOUS_JOURNAL", journal_path)  # children inherit too
   if metrics_port is not None:
     # multi-process workers each need their own port: 0 lets the OS pick
-    os.environ["IGNEOUS_METRICS_PORT"] = str(
-      0 if ctx.obj["parallel"] > 1 else metrics_port
+    knobs.set_env(
+      "IGNEOUS_METRICS_PORT", 0 if ctx.obj["parallel"] > 1 else metrics_port
     )
   parallel = ctx.obj["parallel"]
   if parallel > 1:
@@ -1465,8 +1467,8 @@ def execute(ctx, queue_spec, aws_region, lease_sec, tally, num_tasks,
 
     # divide cores among workers for native kernel threading (same
     # oversubscription hygiene as the reference's cv2.setNumThreads(0))
-    os.environ.setdefault(
-      "IGNEOUS_POOL_THREADS", str(max(1, (os.cpu_count() or 1) // parallel))
+    knobs.setdefault_env(
+      "IGNEOUS_POOL_THREADS", max(1, (os.cpu_count() or 1) // parallel)
     )
     ctx_mp = mp.get_context("spawn")
     procs = [
@@ -1844,7 +1846,7 @@ def _journal_location(queue_spec, journal_path):
   from .observability import journal as journal_mod
   from .queues import TaskQueue
 
-  path = journal_path or os.environ.get("IGNEOUS_JOURNAL")
+  path = journal_path or knobs.get_str("IGNEOUS_JOURNAL")
   if path is None and queue_spec:
     path = journal_mod.journal_path_for(TaskQueue(queue_spec), queue_spec)
   if not path:
@@ -2121,7 +2123,7 @@ def fleet_check(queue_spec, journal_path, window_sec, stall_sec,
     window_sec, stall_sec, straggler_ratio, horizon_sec,
   )
   health.publish_gauges(report)
-  if textfile or os.environ.get(prom.TEXTFILE_ENV):
+  if textfile or knobs.get_str("IGNEOUS_METRICS_TEXTFILE"):
     prom.write_textfile(textfile)
   if emit_events:
     health.emit_events(
@@ -2877,6 +2879,42 @@ def serve_cmd(paths, port, host, ram_mb, ssd_dir, ssd_mb, synth, writeback,
   signal_mod.signal(signal_mod.SIGTERM, _on_signal)
   signal_mod.signal(signal_mod.SIGINT, _on_signal)
   server.join()
+
+
+@main.command("lint")
+@click.option("--root", default=".", show_default=True,
+              help="Repo root to analyze.")
+@click.option("--knobs-md", is_flag=True,
+              help="Print the generated README knob table.")
+@click.option("--write", is_flag=True,
+              help="With --knobs-md: rewrite README.md in place.")
+@click.option("--baseline", default=None,
+              help="Baseline file (repo-relative; default "
+                   "tools/lint_baseline.json).")
+@click.option("--update-baseline", is_flag=True,
+              help="Accept current findings as the new baseline "
+                   "(env-knobs/telemetry passes refuse).")
+@click.option("--select", multiple=True,
+              help="Run only these passes (repeatable).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable findings output.")
+def lint_cmd(root, knobs_md, write, baseline, update_baseline, select,
+             as_json):
+  """Project-native static analysis (see README 'Static analysis')."""
+  from igneous_tpu.analysis import runner
+
+  for pid in select:
+    if pid not in runner.PASS_IDS:
+      raise click.BadParameter(
+        f"unknown pass {pid!r}; choose from {', '.join(runner.PASS_IDS)}"
+      )
+  rc = runner.main(
+    root, knobs_md=knobs_md, write=write, baseline_path=baseline,
+    update_baseline=update_baseline, select=list(select) or None,
+    as_json=as_json, echo=click.echo,
+  )
+  if rc:
+    raise SystemExit(rc)
 
 
 @main.command("license")

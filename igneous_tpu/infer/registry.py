@@ -190,8 +190,8 @@ class InferenceModel:
         f"registered: {sorted(ARCHITECTURES)}"
       )
     self.apply = builder(spec)
-    self._executors = {}
     self._lock = threading.Lock()
+    self._executors = {}  # guarded-by: self._lock
 
   @property
   def kernel_name(self) -> str:

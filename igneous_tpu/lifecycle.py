@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from .analysis import knobs
 from typing import Optional
 
 # distinct from any Python/click failure code so the pod spec can map it:
@@ -33,7 +35,7 @@ class StopFlag:
   def __init__(self):
     self._event = threading.Event()
     self._lock = threading.Lock()
-    self.reason: Optional[str] = None
+    self.reason: Optional[str] = None  # guarded-by: self._lock
 
   def set(self, reason: str = "stop"):
     with self._lock:
@@ -123,14 +125,14 @@ class PreemptionWatcher:
     self.flag = flag
     self.sentinel = (
       sentinel if sentinel is not None
-      else os.environ.get("IGNEOUS_PREEMPT_SENTINEL")
+      else knobs.get_str("IGNEOUS_PREEMPT_SENTINEL")
     )
     self.metadata_url = (
       metadata_url if metadata_url is not None
-      else os.environ.get("IGNEOUS_PREEMPT_URL")
+      else knobs.get_str("IGNEOUS_PREEMPT_URL")
     )
     if interval is None:
-      interval = float(os.environ.get("IGNEOUS_PREEMPT_POLL_SEC", 1.0))
+      interval = knobs.get_float("IGNEOUS_PREEMPT_POLL_SEC")
     self.interval = float(interval)
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None

@@ -16,6 +16,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..analysis import knobs
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _CSRC = os.path.join(_HERE, "csrc")
 _BUILD = os.path.join(_HERE, "build")
@@ -50,7 +52,7 @@ def _build_lib(name: str) -> Optional[str]:
 
 def load(name: str) -> Optional[ctypes.CDLL]:
   """Compile (if needed) and load csrc/<name>.cpp; None on any failure."""
-  if os.environ.get("IGNEOUS_TPU_NO_NATIVE"):
+  if knobs.get_bool("IGNEOUS_TPU_NO_NATIVE"):
     return None
   with _lock:
     if name in _libs:

@@ -38,22 +38,14 @@ from typing import List, Optional
 
 from . import metrics
 
+from ..analysis import knobs
+
 COOLDOWN_ENV = "IGNEOUS_AUTOSCALE_COOLDOWN_SEC"
 INTERVAL_ENV = "IGNEOUS_AUTOSCALE_INTERVAL_SEC"
 STEP_MAX_ENV = "IGNEOUS_AUTOSCALE_STEP_MAX"
 
 DEFAULT_COOLDOWN_SEC = 60.0
 DEFAULT_INTERVAL_SEC = 15.0
-
-
-def _env_float(name: str, default):
-  raw = os.environ.get(name)
-  if raw is None or raw == "":
-    return default
-  try:
-    return float(raw)
-  except ValueError:
-    return default
 
 
 @dataclass
@@ -87,7 +79,7 @@ class AutoscalePolicy:
         continue
       val = overrides.get(f.name)
       if val is None:
-        val = _env_float(cls._ENV[f.name], None)
+        val = knobs.opt_float(cls._ENV[f.name])
       if val is not None:
         kw[f.name] = val
     pol = cls(**kw)
@@ -367,7 +359,7 @@ class AutoscaleController:
     self.engine = health_mod.HealthEngine(health_config)
     self.interval_sec = (
       float(interval_sec) if interval_sec is not None
-      else _env_float(INTERVAL_ENV, DEFAULT_INTERVAL_SEC)
+      else knobs.get_float(INTERVAL_ENV)
     )
     self.journal = journal or journal_mod.Journal(
       journal_path, worker_id=f"autoscale-{os.getpid()}",

@@ -52,6 +52,8 @@ from typing import Dict, Iterator, List, Optional
 
 from . import metrics, trace
 
+from ..analysis import knobs
+
 PROFILE_DIR_ENV = "IGNEOUS_PROFILE_DIR"
 PROFILE_EVERY_ENV = "IGNEOUS_PROFILE_EVERY"
 PROFILE_REQUEST_KEY = "profile/request.json"
@@ -82,33 +84,33 @@ class DeviceLedger:
     self.reset()
 
   def reset(self) -> None:
-    with getattr(self, "lock", threading.Lock()):
-      self.t_start = time.time()
-      self._t0 = time.monotonic()
+    with self.lock:
+      self.t_start = time.time()  # guarded-by: self.lock
+      self._t0 = time.monotonic()  # guarded-by: self.lock
       # kernel -> cumulative stats
-      self.kernels: Dict[str, dict] = {}
+      self.kernels: Dict[str, dict] = {}  # guarded-by: self.lock
       # (kernel, signature-repr) seen-set: the recompile ledger
-      self._signatures: set = set()
+      self._signatures: set = set()  # guarded-by: self.lock
       # device label -> cumulative busy seconds
-      self.device_busy: Dict[str, float] = {}
-      self.h2d_bytes = 0
-      self.d2h_bytes = 0
-      self.h2d_seconds = 0.0
-      self.d2h_seconds = 0.0
-      self.recompiles = 0
-      self.dispatches = 0
-      self.fastpath = {"batched": 0, "host": 0}
+      self.device_busy: Dict[str, float] = {}  # guarded-by: self.lock
+      self.h2d_bytes = 0  # guarded-by: self.lock
+      self.d2h_bytes = 0  # guarded-by: self.lock
+      self.h2d_seconds = 0.0  # guarded-by: self.lock
+      self.d2h_seconds = 0.0  # guarded-by: self.lock
+      self.recompiles = 0  # guarded-by: self.lock
+      self.dispatches = 0  # guarded-by: self.lock
+      self.fastpath = {"batched": 0, "host": 0}  # guarded-by: self.lock
       # padding-byte accounting across every batched dispatch (pow2
       # batch rounding, page-pool filler slots, infer group fill)
-      self.pad_bytes = 0
-      self.real_bytes = 0
+      self.pad_bytes = 0  # guarded-by: self.lock
+      self.real_bytes = 0  # guarded-by: self.lock
       # device label -> last sampled memory stats (+ peak high-water)
-      self.hbm: Dict[str, dict] = {}
+      self.hbm: Dict[str, dict] = {}  # guarded-by: self.lock
       # anything recorded since the last journal flush? An idle worker
       # must not grow a segment per flush interval forever
-      self._dirty = False
+      self._dirty = False  # guarded-by: self.lock
 
-  def _kernel(self, name: str) -> dict:
+  def _kernel_locked(self, name: str) -> dict:
     k = self.kernels.get(name)
     if k is None:
       k = self.kernels[name] = {
@@ -135,7 +137,7 @@ class DeviceLedger:
 
   def record_compile(self, kernel: str, seconds: float) -> None:
     with self.lock:
-      k = self._kernel(kernel)
+      k = self._kernel_locked(kernel)
       k["compiles"] += 1
       k["compile_s"] += float(seconds)
       self._dirty = True
@@ -148,7 +150,7 @@ class DeviceLedger:
     each is attributed the full interval)."""
     seconds = float(seconds)
     with self.lock:
-      k = self._kernel(kernel)
+      k = self._kernel_locked(kernel)
       k["executes"] += 1
       k["execute_s"] += seconds
       k["elements"] += int(elements)
@@ -597,7 +599,7 @@ def _capture_blocking(duration_sec, journal, request_id, logdir):
     import jax
   except Exception:
     return
-  base = logdir or os.environ.get(PROFILE_DIR_ENV)
+  base = logdir or knobs.get_str(PROFILE_DIR_ENV)
   tmp = None
   if not base:
     tmp = tempfile.mkdtemp(prefix="igneous-profile-")
@@ -669,19 +671,16 @@ def maybe_sample_profile() -> None:
   ``IGNEOUS_PROFILE_EVERY=N`` (N>0), every Nth device dispatch starts a
   short capture. Inert by default — two env reads per dispatch, nothing
   else."""
-  if not os.environ.get(PROFILE_DIR_ENV):
+  if not knobs.get_str(PROFILE_DIR_ENV):
     return
-  try:
-    every = int(os.environ.get(PROFILE_EVERY_ENV, "0"))
-  except ValueError:
-    return
+  every = knobs.get_int(PROFILE_EVERY_ENV)
   if every <= 0:
     return
   _SAMPLE_COUNT[0] += 1
   if _SAMPLE_COUNT[0] % every:
     return
   start_capture(
-    duration_sec=float(os.environ.get("IGNEOUS_PROFILE_SEC", "2")),
+    duration_sec=knobs.get_float("IGNEOUS_PROFILE_SEC"),
     request_id=f"sample-{_SAMPLE_COUNT[0]}",
   )
 

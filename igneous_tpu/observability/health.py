@@ -40,22 +40,14 @@ from typing import Iterable, List, Optional
 
 from . import fleet, metrics
 
+from ..analysis import knobs
+
 FLAGS_KEY = "health/flags.json"
 
 # a worker's first compile of each kernel is churn-free startup, not a
 # storm: the recompile-storm anomaly needs at least this many recompiles
 # in the measured interval before the per-minute rate means anything
 DEVICE_RECOMPILE_STORM_MIN = 10
-
-
-def _env_float(name: str, default):
-  raw = os.environ.get(name)
-  if raw is None or raw == "":
-    return default
-  try:
-    return float(raw)
-  except ValueError:
-    return default
 
 
 @dataclass
@@ -140,7 +132,7 @@ class HealthConfig:
       env_name = cls._ENV.get(f.name)
       val = overrides.get(f.name)
       if val is None and env_name:
-        val = _env_float(env_name, None)
+        val = knobs.opt_float(env_name)
       if val is not None:
         if f.type in ("int",):
           val = int(val)

@@ -32,6 +32,8 @@ from typing import Iterable, Iterator, List, Optional
 
 from . import metrics, trace
 
+from ..analysis import knobs
+
 FLUSH_SEC_ENV = "IGNEOUS_JOURNAL_FLUSH_SEC"
 PATH_ENV = "IGNEOUS_JOURNAL"
 COMPRESS_ENV = "IGNEOUS_JOURNAL_COMPRESS"
@@ -41,7 +43,7 @@ _GZIP_MAGIC = b"\x1f\x8b"
 
 
 def compression_enabled() -> bool:
-  return os.environ.get(COMPRESS_ENV, "") not in ("", "0", "false")
+  return knobs.get_bool(COMPRESS_ENV)
 
 
 def encode_segment(data: bytes) -> bytes:
@@ -103,7 +105,7 @@ def journal_path_for(queue, spec: Optional[str] = None) -> Optional[str]:
   """Resolve where a worker's journal lives: ``IGNEOUS_JOURNAL`` wins;
   fq:// queues get a ``journal/`` sibling of queue/leased/dlq on the same
   filesystem; other backends (SQS has no storage) need the env."""
-  env = os.environ.get(PATH_ENV)
+  env = knobs.get_str(PATH_ENV)
   if env:
     return env
   path = getattr(queue, "path", None)  # FileQueue
@@ -125,18 +127,13 @@ class Journal:
     self.cloudpath = cloudpath
     self.worker_id = worker_id or default_worker_id()
     if flush_interval is None:
-      try:
-        flush_interval = float(
-          os.environ.get(FLUSH_SEC_ENV, DEFAULT_FLUSH_SEC)
-        )
-      except ValueError:
-        flush_interval = DEFAULT_FLUSH_SEC
+      flush_interval = knobs.get_float(FLUSH_SEC_ENV)
     self.flush_interval = float(flush_interval)
-    self._seq = 0
     self._lock = threading.Lock()
-    self._last_flush = time.monotonic()
+    self._seq = 0  # guarded-by: self._lock
+    self._last_flush = time.monotonic()  # guarded-by: self._lock
     self._dirty = threading.Event()  # drain requested: flush ASAP
-    self.segments_written = 0
+    self.segments_written = 0  # guarded-by: self._lock
     # register the self-health keys so the Prometheus exposition carries
     # igneous_journal_segments_total/..._flush_failed_total from the
     # moment a journal exists — a writer that NEVER lands a segment is
@@ -215,7 +212,8 @@ class Journal:
       # gone but the next flush carries the cumulative counters anyway
       metrics.incr("journal.flush_failed")
       return False
-    self.segments_written += 1
+    with self._lock:
+      self.segments_written += 1
     metrics.incr("journal.segments")
     # rollup maintenance rides the flush cadence: every N segments the
     # worker folds its OWN raw segments (worker-unique names, so no
@@ -252,7 +250,8 @@ class Journal:
     except Exception:
       metrics.incr("journal.flush_failed")
       return None
-    self.segments_written += 1
+    with self._lock:
+      self.segments_written += 1
     metrics.incr("journal.segments")
     return name
 

@@ -23,6 +23,8 @@ from typing import Dict, Iterator, Optional
 
 from . import trace
 
+from ..analysis import knobs
+
 _local = threading.local()
 
 # -- failure-containment counters (ISSUE 1) ----------------------------------
@@ -266,8 +268,8 @@ def device_trace(logdir: Optional[str] = None):
   the region's own outcome."""
   logdir = (
     logdir
-    or os.environ.get("IGNEOUS_PROFILE_DIR")
-    or os.environ.get("IGNEOUS_TPU_PROFILE_DIR")
+    or knobs.get_str("IGNEOUS_PROFILE_DIR")
+    or knobs.get_str("IGNEOUS_TPU_PROFILE_DIR")
   )
   if not logdir:
     yield

@@ -26,6 +26,8 @@ from typing import Optional
 
 from . import metrics
 
+from ..analysis import knobs
+
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 PORT_ENV = "IGNEOUS_METRICS_PORT"
 TEXTFILE_ENV = "IGNEOUS_METRICS_TEXTFILE"
@@ -110,7 +112,7 @@ def _self_health_gauges() -> dict:
 def write_textfile(path: Optional[str] = None) -> Optional[str]:
   """Atomic write for the textfile collector; returns the path written
   (env ``IGNEOUS_METRICS_TEXTFILE`` when not given), or None if unset."""
-  path = path or os.environ.get(TEXTFILE_ENV)
+  path = path or knobs.get_str(TEXTFILE_ENV)
   if not path:
     return None
   tmp = f"{path}.tmp.{os.getpid()}"
@@ -162,7 +164,7 @@ def start_http_server(port: Optional[int] = None) -> Optional[int]:
   port or None. Idempotent per process."""
   global _SERVER
   if port is None:
-    raw = os.environ.get(PORT_ENV, "")
+    raw = knobs.raw(PORT_ENV) or ""
     if not raw:
       return None
     try:

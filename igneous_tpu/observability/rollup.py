@@ -51,6 +51,8 @@ from typing import Dict, List, Optional, Tuple
 from . import journal as journal_mod
 from . import metrics
 
+from ..analysis import knobs
+
 ROLLUP_PREFIX = "rollup/"
 WINDOW_SEC_ENV = "IGNEOUS_ROLLUP_WINDOW_SEC"
 MAX_SAMPLES_ENV = "IGNEOUS_ROLLUP_MAX_SAMPLES"
@@ -65,28 +67,21 @@ DEFAULT_RETAIN_SEC = 3600.0
 _SEQ = [0]  # per-process uniqueness suffix for rollup file names
 
 
-def _env_float(name: str, default: float) -> float:
-  try:
-    return float(os.environ.get(name, default))
-  except (TypeError, ValueError):
-    return default
-
-
 def window_sec() -> float:
-  return _env_float(WINDOW_SEC_ENV, DEFAULT_WINDOW_SEC)
+  return knobs.get_float(WINDOW_SEC_ENV)
 
 
 def max_samples() -> int:
-  return int(_env_float(MAX_SAMPLES_ENV, DEFAULT_MAX_SAMPLES))
+  return knobs.get_int(MAX_SAMPLES_ENV)
 
 
 def self_compact_every() -> int:
   """Worker self-compaction cadence in segments (0 disables)."""
-  return int(_env_float(EVERY_ENV, DEFAULT_EVERY))
+  return knobs.get_int(EVERY_ENV)
 
 
 def retain_sec() -> float:
-  return _env_float(RETAIN_ENV, DEFAULT_RETAIN_SEC)
+  return knobs.get_float(RETAIN_ENV)
 
 
 def default_actor() -> str:

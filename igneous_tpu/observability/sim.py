@@ -44,17 +44,7 @@ from typing import Dict, List, Optional
 
 from .autoscale import AutoscalePolicy, PolicyLoop
 
-
-def _env_num(name: str, default):
-  import os
-
-  raw = os.environ.get(name)
-  if raw is None or raw == "":
-    return default
-  try:
-    return float(raw)
-  except ValueError:
-    return default
+from ..analysis import knobs
 
 
 @dataclass
@@ -120,7 +110,7 @@ class SimConfig:
         continue
       val = overrides.get(f.name)
       if val is None and f.name in cls._ENV:
-        val = _env_num(cls._ENV[f.name], None)
+        val = knobs.opt_float(cls._ENV[f.name])
       if val is not None:
         kw[f.name] = val
     cfg = cls(**kw)

@@ -27,6 +27,8 @@ import time
 import uuid
 from typing import Iterator, Optional
 
+from ..analysis import knobs
+
 SAMPLE_ENV = "IGNEOUS_TRACE_SAMPLE"
 
 # per-thread span buffers are bounded: a worker that never flushes (no
@@ -44,10 +46,7 @@ _WORKER_TRACE = uuid.uuid4().hex[:16]
 
 
 def sample_rate() -> float:
-  try:
-    return float(os.environ.get(SAMPLE_ENV, "1.0"))
-  except ValueError:
-    return 1.0
+  return knobs.get_float(SAMPLE_ENV)
 
 
 def tracing_enabled() -> bool:

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import knobs
+
 
 def neighbor_offsets(connectivity: int):
   """cc3d-style neighborhoods: 6 = faces, 18 = +edges, 26 = +corners."""
@@ -208,7 +210,7 @@ def _tile_shape() -> Tuple[int, int, int]:
   a tile's whole round loop runs on-chip with room to double-buffer."""
   import os
 
-  spec = os.environ.get("IGNEOUS_CCL_TILE", "")
+  spec = knobs.get_str("IGNEOUS_CCL_TILE")
   if not spec:
     return (
       _DEFAULT_TILE_TPU if jax.default_backend() == "tpu"
@@ -233,7 +235,7 @@ def _ccl_engine() -> str:
   correct but slow; for parity tests)."""
   import os
 
-  override = os.environ.get("IGNEOUS_CCL_ENGINE", "")
+  override = knobs.get_str("IGNEOUS_CCL_ENGINE")
   if override:
     if override not in ("lax", "pallas"):
       raise ValueError(
@@ -457,7 +459,7 @@ def _ccl_native(labels: np.ndarray, connectivity: int):
 def _device_algo() -> str:
   import os
 
-  algo = os.environ.get("IGNEOUS_CCL_DEVICE_ALGO", "scan")
+  algo = knobs.get_str("IGNEOUS_CCL_DEVICE_ALGO")
   if algo not in ("scan", "relax"):
     raise ValueError(
       f"IGNEOUS_CCL_DEVICE_ALGO must be 'scan' or 'relax': {algo!r}"
@@ -468,7 +470,7 @@ def _device_algo() -> str:
 def _ccl_backend() -> str:
   import os
 
-  override = os.environ.get("IGNEOUS_CCL_BACKEND", "")
+  override = knobs.get_str("IGNEOUS_CCL_BACKEND")
   if override:
     if override not in ("native", "device"):
       raise ValueError(

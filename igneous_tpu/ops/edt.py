@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import knobs
+
 INF = np.float32(1e20)
 
 
@@ -438,7 +440,7 @@ def _host_backend() -> str:
   """'native' | 'numpy' | 'device' for the current environment."""
   import os
 
-  override = os.environ.get("IGNEOUS_EDT_BACKEND", "")
+  override = knobs.get_str("IGNEOUS_EDT_BACKEND")
   if override:
     if override not in ("native", "numpy", "device"):
       raise ValueError(

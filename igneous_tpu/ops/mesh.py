@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import knobs
+
 # cube corner i sits at offset (i&1, i>>1&1, i>>2&1)
 CORNER_OFFSETS = np.array(
   [[(i >> d) & 1 for d in range(3)] for i in range(8)], dtype=np.float32
@@ -595,7 +597,7 @@ def _mesh_emit_backend() -> str:
   through the host. Override with IGNEOUS_MESH_EMIT=host|device."""
   import os
 
-  override = os.environ.get("IGNEOUS_MESH_EMIT", "")
+  override = knobs.get_str("IGNEOUS_MESH_EMIT")
   if override:
     if override not in ("host", "device"):
       raise ValueError(

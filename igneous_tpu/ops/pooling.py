@@ -31,12 +31,14 @@ Exact semantics (mirrored by ops.oracle for tests):
 from __future__ import annotations
 
 import os
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..analysis import knobs
 
 Factor3 = Tuple[int, int, int]
 
@@ -275,6 +277,7 @@ def _fused_pyramid(x, factors, method, sparse, mip_from: int = 0):
   return outs
 
 
+@lru_cache(maxsize=None)
 def pyramid_batched(factors: Tuple[Factor3, ...], method: str, sparse: bool):
   """Compiled batched pyramid: (B, c, z, y, x) → tuple of (B, …) mips.
 
@@ -451,7 +454,7 @@ def _backend_is_cpu() -> bool:
 
 
 def _host_pool_threads() -> int:
-  return int(os.environ.get("IGNEOUS_POOL_THREADS", "0"))
+  return knobs.get_int("IGNEOUS_POOL_THREADS")
 
 
 def _mode_as_u64(img: np.ndarray):
@@ -613,7 +616,7 @@ def _host_pool_active() -> bool:
   downsamples solo on accelerator-less workers, where per-cutout native
   pooling IS the fast path and an XLA-CPU batch dispatch is a ~9x
   pessimization."""
-  mode = os.environ.get("IGNEOUS_POOL_HOST", "auto").lower()
+  mode = knobs.get_str("IGNEOUS_POOL_HOST").lower()
   return mode != "0" and (mode == "1" or _backend_is_cpu())
 
 

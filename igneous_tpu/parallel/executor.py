@@ -145,6 +145,7 @@ class BatchKernelExecutor:
         batched, mesh=self.mesh,
         in_specs=in_specs, out_specs=out_specs, check_rep=False,
       )
+    # lint: allow=IGN201 AOT lower+compile cached by signature at call site
     return jax.jit(fn)
 
   def __call__(self, batch, consts=None, span_attrs=None):
@@ -282,6 +283,7 @@ class ChunkExecutor:
     fn = _shard_map(
       per_shard, mesh=self.mesh, in_specs=(in_spec,), out_specs=out_spec
     )
+    # lint: allow=IGN201 AOT lower+compile cached by signature at call site
     return jax.jit(fn)
 
   @property

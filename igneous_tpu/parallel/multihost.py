@@ -32,6 +32,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..analysis import knobs
+
 
 def initialize(
   coordinator_address: Optional[str] = None,
@@ -49,19 +51,19 @@ def initialize(
   kw = {}
   addr = (
     coordinator_address if coordinator_address is not None
-    else os.environ.get("IGNEOUS_COORDINATOR")
+    else knobs.get_str("IGNEOUS_COORDINATOR")
   )
   if addr:
     kw["coordinator_address"] = addr
   nproc = (
     num_processes if num_processes is not None
-    else os.environ.get("IGNEOUS_NUM_PROCESSES")
+    else knobs.get_int("IGNEOUS_NUM_PROCESSES")
   )
   if nproc is not None:
     kw["num_processes"] = int(nproc)
   pid = (
     process_id if process_id is not None
-    else os.environ.get("IGNEOUS_PROCESS_ID")
+    else knobs.get_int("IGNEOUS_PROCESS_ID")
   )
   if pid is not None:
     kw["process_id"] = int(pid)

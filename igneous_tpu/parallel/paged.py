@@ -68,6 +68,8 @@ from ..ops.pooling import (
 )
 from .executor import BatchKernelExecutor, _shard_map, make_mesh
 
+from ..analysis import knobs
+
 _DEFAULT_PAGE = (32, 32, 32)
 
 
@@ -84,7 +86,7 @@ def page_shape() -> Tuple[int, int, int]:
   The default 32^3 divides evenly by every standard mip factor chain up
   to 5 halvings and by both CCL tile defaults, so all three paged kernels
   share one page geometry."""
-  raw = os.environ.get("IGNEOUS_PAGE_SHAPE", "")
+  raw = knobs.raw("IGNEOUS_PAGE_SHAPE") or ""
   if not raw:
     return _DEFAULT_PAGE
   parts = tuple(int(v) for v in raw.replace(" ", "").split(","))
@@ -100,7 +102,8 @@ def page_round_cap(n_devices: int) -> int:
   (zero filler pages, extent 0), so the compiled signature is
   round-count-independent. Pow2 multiple of the device count so the
   executor's own canonical-K rounding is a no-op."""
-  want = int(os.environ.get("IGNEOUS_PAGE_BATCH", "32"))
+  want = int(knobs.raw("IGNEOUS_PAGE_BATCH")
+             or knobs.KNOBS["IGNEOUS_PAGE_BATCH"].default)
   if want <= 0:
     raise ValueError("IGNEOUS_PAGE_BATCH must be positive")
   cap = max(n_devices, 1)

@@ -40,17 +40,18 @@ class BoundedBuffer:
     self._lock = threading.Lock()
     self._not_full = threading.Condition(self._lock)
     self._not_empty = threading.Condition(self._lock)
-    self._items: deque = deque()
-    self._bytes_held = 0  # acquired weight (includes producers mid-work)
-    self._closed = False
+    self._items: deque = deque()  # guarded-by: self._lock
+    # acquired weight (includes producers mid-work)
+    self._bytes_held = 0  # guarded-by: self._lock
+    self._closed = False  # guarded-by: self._lock
     self._flag = None  # optional drain flag; wakes all waiters when set
     # FIFO budget grants: producers racing for the last budget slice out
     # of order can starve the OLDEST producer — the one the consumer is
     # blocked on — which deadlocks the whole pipeline. Sequences are
     # reserved at submit time (consumer thread, in order) and acquire()
     # grants strictly in sequence.
-    self._seq_next = 0
-    self._seq_grant = 0
+    self._seq_next = 0  # guarded-by: self._lock
+    self._seq_grant = 0  # guarded-by: self._lock
 
   # -- drain cooperation ----------------------------------------------------
 
@@ -70,11 +71,13 @@ class BoundedBuffer:
     t0 = time.perf_counter()
     while not pred():
       if self._interrupted():
+        # lint: allow=IGN503 stall_counter forwards literals from call sites
         telemetry.observe(stall_counter, time.perf_counter() - t0)
         raise PipelineInterrupted(self.name)
       if self._closed:
         break
       cond.wait(timeout=0.1)
+    # lint: allow=IGN503 stall_counter forwards literals from call sites
     telemetry.observe(stall_counter, time.perf_counter() - t0)
 
   # -- producer side --------------------------------------------------------

@@ -26,6 +26,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from ..analysis import knobs
+
 # the downsample planner's default task byte target
 # (task_creation.image.create_downsampling_tasks memory_target) — the
 # pipeline budget defaults to a multiple of the same solver's output so
@@ -45,7 +47,7 @@ def enabled(default: Optional[bool] = None) -> bool:
   stream runners (LocalTaskQueue, batch_runner) pass True, solo task
   execution passes False — pipelining a one-task poll loop only adds
   thread churn, while a task STREAM is where the stages overlap."""
-  val = os.environ.get("IGNEOUS_PIPELINE", "auto").strip().lower()
+  val = knobs.get_str("IGNEOUS_PIPELINE").strip().lower()
   if val in ("1", "on", "true", "yes"):
     return True
   if val in ("0", "off", "false", "no"):
@@ -65,9 +67,9 @@ def memory_budget_bytes(
   can prefetch while one computes. ``task_nbytes`` (a known cutout size)
   tightens the default for small-task streams.
   """
-  env = os.environ.get("IGNEOUS_PIPELINE_MEM_MB")
-  if env:
-    return max(int(float(env) * 1e6), 1)
+  mb = knobs.get_float("IGNEOUS_PIPELINE_MEM_MB")
+  if mb:
+    return max(int(mb * 1e6), 1)
   base = memory_target if memory_target else DEFAULT_MEMORY_TARGET
   if task_nbytes:
     base = min(base, int(task_nbytes) * 2)
@@ -75,7 +77,7 @@ def memory_budget_bytes(
 
 
 def prefetch_depth() -> int:
-  return max(int(os.environ.get("IGNEOUS_PIPELINE_PREFETCH", "2")), 1)
+  return max(knobs.get_int("IGNEOUS_PIPELINE_PREFETCH"), 1)
 
 
 def use_threads() -> bool:
@@ -88,7 +90,7 @@ def use_threads() -> bool:
   then degrades to in-order execution of the SAME stage plans (same
   bytes, same telemetry), and the pipeline's win comes from the
   persistent pools + encode fast paths instead of overlap."""
-  val = os.environ.get("IGNEOUS_PIPELINE_THREADS", "auto").strip().lower()
+  val = knobs.get_str("IGNEOUS_PIPELINE_THREADS").strip().lower()
   if val in ("1", "on", "true", "yes"):
     return True
   if val in ("0", "off", "false", "no"):
@@ -97,14 +99,14 @@ def use_threads() -> bool:
 
 
 def io_threads() -> int:
-  env = os.environ.get("IGNEOUS_PIPELINE_IO_THREADS")
+  env = knobs.get_int("IGNEOUS_PIPELINE_IO_THREADS")
   if env:
-    return max(int(env), 1)
+    return max(env, 1)
   return min(8, _cores() * 2)
 
 
 def encode_threads() -> int:
-  env = os.environ.get("IGNEOUS_PIPELINE_ENCODE_THREADS")
+  env = knobs.get_int("IGNEOUS_PIPELINE_ENCODE_THREADS")
   if env:
-    return max(int(env), 1)
+    return max(env, 1)
   return min(8, max(_cores(), 1))

@@ -28,6 +28,7 @@ import threading
 from typing import Optional
 
 from .filequeue import StaleLeaseError
+from ..analysis import racecheck
 
 
 class LeaseHeartbeat:
@@ -58,9 +59,12 @@ class LeaseHeartbeat:
     self.interval = float(interval)
     self.enabled = self.interval > 0 and hasattr(queue, "renew")
     self.renewals = 0
-    self.lost: set = set()
     self._lock = threading.Lock()
-    self._current: dict = {}  # token at track() time -> current token
+    self.lost = racecheck.guard(  # guarded-by: self._lock
+      set(), self._lock, "LeaseHeartbeat.lost")
+    # token at track() time -> current token
+    self._current = racecheck.guard(  # guarded-by: self._lock
+      {}, self._lock, "LeaseHeartbeat._current")
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
 

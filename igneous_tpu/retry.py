@@ -22,19 +22,13 @@ run retry schedules deterministically without wall-clock sleeps.
 
 from __future__ import annotations
 
-import os
 import random
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from .analysis import knobs
+
 RETRYABLE_STATUS = (408, 429, 500, 502, 503, 504)
-
-
-def _env_float(name: str, default: float) -> float:
-  try:
-    return float(os.environ.get(name, default))
-  except ValueError:
-    return default
 
 
 @dataclass
@@ -69,10 +63,10 @@ class RetryPolicy:
   @classmethod
   def from_env(cls, **overrides) -> "RetryPolicy":
     kw = dict(
-      attempts=int(_env_float("IGNEOUS_RETRY_ATTEMPTS", 6)),
-      base_s=_env_float("IGNEOUS_RETRY_BASE_S", 0.25),
-      cap_s=_env_float("IGNEOUS_RETRY_CAP_S", 30.0),
-      budget_s=_env_float("IGNEOUS_RETRY_BUDGET_S", 120.0),
+      attempts=knobs.get_int("IGNEOUS_RETRY_ATTEMPTS"),
+      base_s=knobs.get_float("IGNEOUS_RETRY_BASE_S"),
+      cap_s=knobs.get_float("IGNEOUS_RETRY_CAP_S"),
+      budget_s=knobs.get_float("IGNEOUS_RETRY_BUDGET_S"),
     )
     kw.update(overrides)
     return cls(**kw)

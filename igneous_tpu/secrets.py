@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import os
 
+from .analysis import knobs
+
 
 def queue_url() -> "str | None":
   return os.environ.get("QUEUE_URL") or os.environ.get("SQS_URL")
@@ -29,11 +31,10 @@ def lease_seconds() -> int:
 def heartbeat_seconds() -> "float | None":
   """Lease-renewal interval for workers. None (unset) lets the heartbeat
   default to lease/3; 0 disables renewal entirely."""
-  val = os.environ.get("IGNEOUS_HEARTBEAT_SEC")
-  return None if val is None or val == "" else float(val)
+  return knobs.opt_float("IGNEOUS_HEARTBEAT_SEC")
 
 
 def secrets_dir() -> str:
-  return os.environ.get(
-    "IGNEOUS_TPU_SECRETS", os.path.expanduser("~/.cloudfiles/secrets")
+  return knobs.get_str("IGNEOUS_TPU_SECRETS") or os.path.expanduser(
+    "~/.cloudfiles/secrets"
   )

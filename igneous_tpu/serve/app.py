@@ -54,22 +54,9 @@ from ..storage import CloudFiles, compress_bytes, decompress_bytes, normalize_pa
 from .cache import Entry, TieredStoredCache, strong_etag
 from .server import Request, Response
 
+from ..analysis import knobs
+
 _JSON_KEYS = ("info", "provenance")
-
-
-def _env_bool(name: str, default: bool) -> bool:
-  raw = os.environ.get(name, "").strip().lower()
-  if not raw:
-    return default
-  return raw not in ("0", "off", "false", "no")
-
-
-def _env_float(name: str, default: float) -> float:
-  raw = os.environ.get(name, "")
-  try:
-    return float(raw) if raw else default
-  except ValueError:
-    return default
 
 
 @dataclass
@@ -90,17 +77,15 @@ class ServeConfig:
   @classmethod
   def from_env(cls, **overrides) -> "ServeConfig":
     kw = dict(
-      ram_mb=_env_float("IGNEOUS_SERVE_RAM_MB", cls.ram_mb),
-      ssd_dir=os.environ.get("IGNEOUS_SERVE_SSD_DIR") or None,
-      ssd_mb=_env_float("IGNEOUS_SERVE_SSD_MB", cls.ssd_mb),
-      cache_control=os.environ.get(
-        "IGNEOUS_SERVE_CACHE_CONTROL", cls.cache_control
-      ),
-      synth_mips=_env_bool("IGNEOUS_SERVE_SYNTH_MIPS", cls.synth_mips),
-      writeback=_env_bool("IGNEOUS_SERVE_WRITEBACK", cls.writeback),
-      max_object_mb=_env_float("IGNEOUS_SERVE_MAX_OBJECT_MB", cls.max_object_mb),
-      io_threads=int(_env_float("IGNEOUS_SERVE_IO_THREADS", cls.io_threads)),
-      drain_sec=_env_float("IGNEOUS_SERVE_DRAIN_SEC", cls.drain_sec),
+      ram_mb=knobs.get_float("IGNEOUS_SERVE_RAM_MB"),
+      ssd_dir=knobs.get_str("IGNEOUS_SERVE_SSD_DIR") or None,
+      ssd_mb=knobs.get_float("IGNEOUS_SERVE_SSD_MB"),
+      cache_control=knobs.get_str("IGNEOUS_SERVE_CACHE_CONTROL"),
+      synth_mips=knobs.get_bool("IGNEOUS_SERVE_SYNTH_MIPS"),
+      writeback=knobs.get_bool("IGNEOUS_SERVE_WRITEBACK"),
+      max_object_mb=knobs.get_float("IGNEOUS_SERVE_MAX_OBJECT_MB"),
+      io_threads=knobs.get_int("IGNEOUS_SERVE_IO_THREADS"),
+      drain_sec=knobs.get_float("IGNEOUS_SERVE_DRAIN_SEC"),
     )
     for name, val in overrides.items():
       if val is not None:
@@ -613,4 +598,5 @@ class ServeApp:
     for q, name in ((0.5, "serve.p50_ms"), (0.99, "serve.p99_ms")):
       val = metrics.histogram_quantile("serve.request", q)
       if val is not None:
+        # lint: allow=IGN503 name comes from the literal tuple above
         metrics.gauge_set(name, val * 1e3)

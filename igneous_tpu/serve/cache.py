@@ -64,8 +64,8 @@ class RamTier:
   def __init__(self, budget_bytes: int):
     self.budget = int(budget_bytes)
     self._lock = threading.Lock()
-    self._entries: "OrderedDict[tuple, Entry]" = OrderedDict()
-    self._bytes = 0
+    self._entries: "OrderedDict[tuple, Entry]" = OrderedDict()  # guarded-by: self._lock
+    self._bytes = 0  # guarded-by: self._lock
 
   def get(self, key: tuple) -> Optional[Entry]:
     with self._lock:
@@ -129,8 +129,8 @@ class SsdTier:
     self._lock = threading.Lock()
     # access-ordered index: relpath -> size (seeded from disk by mtime so
     # restart eviction order approximates the predecessor's LRU)
-    self._index: "OrderedDict[str, int]" = OrderedDict()
-    self._bytes = 0
+    self._index: "OrderedDict[str, int]" = OrderedDict()  # guarded-by: self._lock
+    self._bytes = 0  # guarded-by: self._lock
     os.makedirs(root, exist_ok=True)
     self._seed_index()
 

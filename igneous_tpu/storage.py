@@ -42,6 +42,8 @@ except ImportError:  # zstd stays readable/writable only where the codec ships
 from .lib import jsonify
 from .observability import trace as _trace
 
+from .analysis import knobs
+
 # brotli is deliberately absent: no brotli codec ships in this environment,
 # so .br files are left visible under their literal names rather than
 # advertised as readable and then crashing on get().
@@ -124,7 +126,7 @@ def scratch_compression(default="gzip"):
   releases, which is what lets the chaos soak and containment tests keep
   pinning output bytes while operators tune scratch IO independently.
   """
-  val = os.environ.get("IGNEOUS_SCRATCH_COMPRESS", "").strip().lower()
+  val = knobs.get_str("IGNEOUS_SCRATCH_COMPRESS").strip().lower()
   if not val:
     return default
   if val in ("none", "raw", "0", "off"):

@@ -34,14 +34,16 @@ from . import secrets
 from .retry import default_policy
 from .storage_http import HttpError, quote_path, request
 
+from .analysis import knobs
+
 # objects >= this use a resumable upload session (env-tunable, read per
 # call so tests exercise the session path with small payloads)
 def _resumable_threshold() -> int:
-  return int(os.environ.get("IGNEOUS_GCS_RESUMABLE_THRESHOLD", 8 * 1024 * 1024))
+  return knobs.get_int("IGNEOUS_GCS_RESUMABLE_THRESHOLD")
 
 
 def _upload_chunk() -> int:
-  return int(os.environ.get("IGNEOUS_GCS_UPLOAD_CHUNK", 8 * 1024 * 1024))
+  return knobs.get_int("IGNEOUS_GCS_UPLOAD_CHUNK")
 _SCOPE = "https://www.googleapis.com/auth/devstorage.read_write"
 
 

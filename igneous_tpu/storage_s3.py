@@ -35,13 +35,15 @@ from . import secrets
 from .retry import default_policy
 from .storage_http import HttpError, request
 
+from .analysis import knobs
+
 # env-tunable, read per call so tests exercise multipart with small payloads
 def _multipart_threshold() -> int:
-  return int(os.environ.get("IGNEOUS_S3_MULTIPART_THRESHOLD", 64 * 1024 * 1024))
+  return knobs.get_int("IGNEOUS_S3_MULTIPART_THRESHOLD")
 
 
 def _multipart_chunk() -> int:
-  return int(os.environ.get("IGNEOUS_S3_MULTIPART_CHUNK", 32 * 1024 * 1024))
+  return knobs.get_int("IGNEOUS_S3_MULTIPART_CHUNK")
 
 
 def _load_creds() -> Tuple[Optional[str], Optional[str]]:

@@ -32,6 +32,8 @@ from ..ops import pooling
 from ..pipeline import StagePlan
 from .. import telemetry
 
+from ..analysis import knobs
+
 # empty-cutout tasks stage as no-ops: the pipeline treats them uniformly
 # instead of barriering the stream for a solo no-op execute()
 _NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
@@ -40,8 +42,7 @@ _NOOP_PLAN = StagePlan(lambda: None, lambda p: None, lambda o, s: None)
 def _passthrough_enabled() -> bool:
   """``IGNEOUS_TRANSFER_PASSTHROUGH=0|off`` forces eligible transfers down
   the decode/re-encode path (debugging aid + the bench's A/B switch)."""
-  val = os.environ.get("IGNEOUS_TRANSFER_PASSTHROUGH", "1").strip().lower()
-  return val not in ("0", "off", "false", "no")
+  return knobs.get_bool("IGNEOUS_TRANSFER_PASSTHROUGH")
 
 
 def _resolve_factors(

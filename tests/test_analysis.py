@@ -1,0 +1,788 @@
+"""`igneous lint` acceptance pins (ISSUE 14).
+
+Covers every checker pass with true-positive AND false-positive fixture
+pins, the knob-registry round trip against the dataclass defaults it
+mirrors, the generated README table's stability and code<->docs
+agreement, the baseline lifecycle (including the env-knobs/telemetry
+refuse-to-baseline rule), and the dynamic race-check companion.
+
+Fixture snippets are written under tmp_path at the rel paths each pass
+scopes to (e.g. ``igneous_tpu/ops/``); tests/ itself is deliberately
+outside lint scope (discovery.iter_source_files), so the IGNEOUS_*
+literals in this file never trip the real run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from igneous_tpu.analysis import (
+  determinism, discovery, env_knobs, findings as findings_mod, knobs,
+  locks, racecheck, recompile, runner, telemetry_names,
+)
+from igneous_tpu.observability.autoscale import AutoscalePolicy
+from igneous_tpu.observability.health import HealthConfig
+from igneous_tpu.observability.sim import SimConfig
+from igneous_tpu.retry import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# fixture plumbing
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, rel, source):
+  path = tmp_path / rel
+  path.parent.mkdir(parents=True, exist_ok=True)
+  path.write_text(textwrap.dedent(source))
+  return str(path)
+
+
+def _run_pass(tmp_path, pass_mod, rel, source):
+  abspath = _write(tmp_path, rel, source)
+  ctx = findings_mod.Context(str(tmp_path))
+  return pass_mod.run(ctx, [abspath])
+
+
+def _codes(found):
+  return sorted(f.code for f in found)
+
+
+# ---------------------------------------------------------------------------
+# pass IGN1 — env-knob registry
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs_true_positives(tmp_path):
+  found = _run_pass(tmp_path, env_knobs, "igneous_tpu/mod.py", """\
+    import os
+    from igneous_tpu.analysis import knobs
+
+    NAME = "IGNEOUS_PIPELINE"
+
+    def f():
+      a = os.environ.get("IGNEOUS_PIPELINE")        # IGN101
+      b = os.environ["IGNEOUS_CHUNK_CACHE"]         # IGN101
+      c = os.getenv(NAME)                           # IGN105
+      d = os.environ[NAME]                          # IGN105
+      e = register("IGNEOUS_TOTALLY_FAKE_KNOB")     # IGN102
+      g = knobs.get_float("IGNEOUS_CHUNK_CACHE_MB", 1.0)  # IGN104
+      return a, b, c, d, e, g
+  """)
+  assert _codes(found) == [
+    "IGN101", "IGN101", "IGN102", "IGN104", "IGN105", "IGN105",
+  ]
+
+
+def test_env_knobs_false_positive_pins(tmp_path):
+  # writes are configuration authorship, accessors are the sanctioned
+  # read path, and the registry module itself is exempt
+  found = _run_pass(tmp_path, env_knobs, "igneous_tpu/mod.py", """\
+    import os
+    from igneous_tpu.analysis import knobs
+
+    def f(env):
+      os.environ["IGNEOUS_PIPELINE"] = "off"
+      os.environ.setdefault("IGNEOUS_PIPELINE", "off")
+      os.environ.pop("IGNEOUS_PIPELINE", None)
+      env["IGNEOUS_PIPELINE"] = "off"
+      a = knobs.get_str("IGNEOUS_PIPELINE")
+      b = knobs.get_bool("IGNEOUS_RACE_CHECK")
+      c = knobs.raw("IGNEOUS_PAGE_SHAPE")
+      d = os.environ.get("HOME")
+      return a, b, c, d
+  """)
+  assert found == []
+
+
+def test_env_knobs_registry_file_exempt(tmp_path):
+  found = _run_pass(
+    tmp_path, env_knobs, "igneous_tpu/analysis/knobs.py", """\
+    import os
+
+    def raw(name):
+      return os.environ.get(name)
+
+    def get_str():
+      return os.environ.get("IGNEOUS_PIPELINE")
+  """)
+  assert found == []
+
+
+def test_env_knobs_suppression(tmp_path):
+  found = _run_pass(tmp_path, env_knobs, "igneous_tpu/mod.py", """\
+    import os
+
+    a = os.environ.get("IGNEOUS_PIPELINE")  # lint: allow=IGN101 pinned
+    # lint: allow=IGN101 preceding-line form
+    b = os.environ.get("IGNEOUS_CHUNK_CACHE")
+    c = os.environ.get("IGNEOUS_JOURNAL")  # lint: allow=ALL wildcard
+
+    d = os.environ.get("IGNEOUS_SIM_SEED")  # lint: allow=IGN105 wrong code
+  """)
+  assert _codes(found) == ["IGN101"]
+  assert found[0].key == "read:IGNEOUS_SIM_SEED"
+
+
+# ---------------------------------------------------------------------------
+# pass IGN2 — recompile / host-sync hazards
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_true_positives(tmp_path):
+  found = _run_pass(tmp_path, recompile, "igneous_tpu/ops/mod.py", """\
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+
+    def per_call(x, fn):
+      g = jax.jit(fn)                     # IGN201
+      return g(x)
+
+    def per_iter(xs, fn):
+      out = []
+      for x in xs:
+        g = jax.jit(fn)                   # IGN202
+        out.append(g(x))
+      return out
+
+    @jax.jit
+    def syncs(x):
+      y = x.sum().item()                  # IGN203
+      z = float(x.mean())                 # IGN203
+      return y + z
+
+    @partial(jax.jit, static_argnames=("n",))
+    def shapes(x, n, m):
+      return jnp.zeros((n, 3)) + jnp.zeros((m, 3))   # IGN204 (m only)
+  """)
+  assert _codes(found) == [
+    "IGN201", "IGN202", "IGN203", "IGN203", "IGN204",
+  ]
+  (dyn,) = [f for f in found if f.code == "IGN204"]
+  assert "'m'" in dyn.message
+
+
+def test_recompile_false_positive_pins(tmp_path):
+  found = _run_pass(tmp_path, recompile, "igneous_tpu/parallel/mod.py", """\
+    import functools
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+
+    module_level = jax.jit(lambda x: x + 1)
+
+    @functools.lru_cache(maxsize=None)
+    def cached_builder(key, fn):
+      return jax.jit(fn)
+
+    class PagedRunner:
+      def _compile(self, sig, fn):
+        self._fns[sig] = jax.jit(fn)      # signature-cache slot
+        return self._fns[sig]
+
+    @partial(jax.jit, static_argnames=("n",))
+    def static_shapes(x, n):
+      a = jnp.zeros((n, 3))               # n is static
+      b = jnp.zeros(x.shape)              # attribute chain: static ints
+      return a + b
+
+    def host_side(x):
+      return float(x)                     # no jit decorator: no IGN203
+  """)
+  assert found == []
+
+
+def test_recompile_out_of_scope(tmp_path):
+  # the same hazard outside ops/parallel/infer is not this pass's beat
+  found = _run_pass(tmp_path, recompile, "igneous_tpu/other/mod.py", """\
+    import jax
+
+    def per_call(x, fn):
+      return jax.jit(fn)(x)
+  """)
+  assert found == []
+
+
+# ---------------------------------------------------------------------------
+# pass IGN3 — lock discipline
+# ---------------------------------------------------------------------------
+
+_LOCKS_FIXTURE = """\
+  import threading
+
+  class Cache:
+    def __init__(self):
+      self._lock = threading.Lock()
+      self._not_full = threading.Condition(self._lock)
+      self._items = []     # guarded-by: self._lock
+      self._bytes = 0      # guarded-by: self._lock
+
+    def good(self, x):
+      with self._lock:
+        self._items.append(x)
+        self._bytes += 1
+
+    def good_condition_alias(self):
+      with self._not_full:
+        self._bytes -= 1
+
+    def _drain_locked(self):
+      self._items.clear()
+
+    def good_holds(self):
+      # holds: self._lock
+      self._items.pop()
+
+    def bad_write(self):
+      self._bytes = 0
+
+    def bad_mutator(self, x):
+      self._items.append(x)
+"""
+
+
+def test_locks_true_and_false_positives(tmp_path):
+  found = _run_pass(tmp_path, locks, "igneous_tpu/mod.py", _LOCKS_FIXTURE)
+  assert _codes(found) == ["IGN301", "IGN301"]
+  keys = sorted(f.key.rsplit(":", 1)[0] for f in found)
+  assert keys == ["unguarded:_bytes", "unguarded:_items"]
+
+
+def test_locks_malformed_annotation(tmp_path):
+  found = _run_pass(tmp_path, locks, "igneous_tpu/mod.py", """\
+    import threading
+
+    class C:
+      def __init__(self):
+        self._lock = threading.Lock()
+        count = 0  # guarded-by: self._lock
+  """)
+  assert _codes(found) == ["IGN302"]
+
+
+def test_locks_nested_def_gets_fresh_scope(tmp_path):
+  # the closure runs on another thread; the enclosing `with` does not
+  # protect it lexically
+  found = _run_pass(tmp_path, locks, "igneous_tpu/mod.py", """\
+    import threading
+
+    class C:
+      def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: self._lock
+
+      def spawn(self):
+        with self._lock:
+          def worker():
+            self._items.append(1)
+          return worker
+  """)
+  assert _codes(found) == ["IGN301"]
+
+
+# ---------------------------------------------------------------------------
+# pass IGN4 — determinism
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_true_positives(tmp_path):
+  found = _run_pass(
+    tmp_path, determinism, "igneous_tpu/observability/sim.py", """\
+    import glob
+    import os
+    import random
+    import time
+    from datetime import datetime
+
+    def tick():
+      return time.time()                        # IGN401
+
+    def stamp():
+      return datetime.now()                     # IGN401
+
+    def pick(items):
+      return random.choice(items)               # IGN402
+
+    def scan(path, items):
+      for f in os.listdir(path):                # IGN403
+        pass
+      for x in set(items):                      # IGN403
+        pass
+
+    def late(t=time.time()):                    # IGN404
+      return t
+  """)
+  assert _codes(found) == [
+    "IGN401", "IGN401", "IGN402", "IGN403", "IGN403", "IGN404",
+  ]
+
+
+def test_determinism_false_positive_pins(tmp_path):
+  found = _run_pass(
+    tmp_path, determinism, "igneous_tpu/observability/replay.py", """\
+    import os
+    import random
+
+    def seeded(seed, items, path):
+      rng = random.Random(seed)                 # sanctioned ctor
+      rng.shuffle(items)                        # instance call: fine
+      for f in sorted(os.listdir(path)):        # sorted listing: fine
+        pass
+      return rng.random()
+  """)
+  assert found == []
+
+
+def test_determinism_out_of_scope(tmp_path):
+  found = _run_pass(tmp_path, determinism, "igneous_tpu/mod.py", """\
+    import time
+
+    def tick():
+      return time.time()
+  """)
+  assert found == []
+
+
+# ---------------------------------------------------------------------------
+# pass IGN5 — telemetry grammar + prom collisions
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_true_positives(tmp_path):
+  found = _run_pass(tmp_path, telemetry_names, "igneous_tpu/mod.py", """\
+    from igneous_tpu import telemetry
+
+    def f(name, kind):
+      telemetry.incr("bogus.thing")             # IGN501 unknown subsystem
+      telemetry.span(f"{kind}.run")             # IGN501 dynamic subsystem
+      telemetry.stage("two words")              # IGN501 stage grammar
+      telemetry.incr(name)                      # IGN503 non-literal
+      telemetry.gauge_set("pipeline.depth_total", 1)
+      telemetry.incr("pipeline.depth")          # IGN502 family collision
+  """)
+  assert _codes(found) == [
+    "IGN501", "IGN501", "IGN501", "IGN502", "IGN503",
+  ]
+  (collision,) = [f for f in found if f.code == "IGN502"]
+  assert "igneous_pipeline_depth_total" in collision.message
+
+
+def test_telemetry_false_positive_pins(tmp_path):
+  found = _run_pass(tmp_path, telemetry_names, "igneous_tpu/mod.py", """\
+    from igneous_tpu import telemetry
+
+    def f(kind, sec):
+      telemetry.incr("tasks.done")
+      telemetry.observe("queue.lease.seconds", sec)
+      telemetry.incr(f"tasks.{kind}.done")      # placeholder after subsys
+      telemetry.gauge_set("pipeline.depth", 2)
+      telemetry.span("device.execute")
+      telemetry.stage("encode")
+  """)
+  assert found == []
+
+
+def test_telemetry_impl_files_exempt(tmp_path):
+  found = _run_pass(
+    tmp_path, telemetry_names, "igneous_tpu/telemetry.py", """\
+    def incr(name, n=1):
+      record(name, n)
+
+    def forward(name):
+      incr(name)
+  """)
+  assert found == []
+
+
+def test_prom_family_mapping():
+  assert telemetry_names.family("counter", "tasks.done") == \
+      "igneous_tasks_done_total"
+  assert telemetry_names.family("hist", "queue.lease") == \
+      "igneous_queue_lease_seconds"
+  assert telemetry_names.family("gauge", "pipeline.depth") == \
+      "igneous_pipeline_depth"
+  assert telemetry_names.family("span", "device.execute") is None
+
+
+# ---------------------------------------------------------------------------
+# knob registry: accessors
+# ---------------------------------------------------------------------------
+
+
+def test_unregistered_knob_raises():
+  with pytest.raises(KeyError, match="unregistered knob"):
+    knobs.get_str("IGNEOUS_NOT_A_REAL_KNOB")
+  with pytest.raises(KeyError):
+    knobs.raw("IGNEOUS_NOT_A_REAL_KNOB")
+
+
+def test_get_str_default_and_override(monkeypatch):
+  monkeypatch.delenv("IGNEOUS_PIPELINE", raising=False)
+  assert knobs.get_str("IGNEOUS_PIPELINE") == "auto"
+  monkeypatch.setenv("IGNEOUS_PIPELINE", "")
+  assert knobs.get_str("IGNEOUS_PIPELINE") == "auto"
+  monkeypatch.setenv("IGNEOUS_PIPELINE", "off")
+  assert knobs.get_str("IGNEOUS_PIPELINE") == "off"
+  monkeypatch.delenv("IGNEOUS_JOURNAL", raising=False)
+  assert knobs.get_str("IGNEOUS_JOURNAL") is None
+
+
+def test_numeric_junk_falls_back_to_registry_default(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_PAGE_BATCH", "pages")
+  assert knobs.get_int("IGNEOUS_PAGE_BATCH") == 32
+  monkeypatch.setenv("IGNEOUS_PAGE_BATCH", "48.5")
+  assert knobs.get_int("IGNEOUS_PAGE_BATCH") == 48
+  monkeypatch.setenv("IGNEOUS_JOURNAL_FLUSH_SEC", "banana")
+  assert knobs.get_float("IGNEOUS_JOURNAL_FLUSH_SEC") == 30.0
+  # None-default knobs stay None on junk: a bad heartbeat knob must
+  # never take the worker down, it degrades to the derived value
+  monkeypatch.setenv("IGNEOUS_HEARTBEAT_SEC", "soon")
+  assert knobs.get_float("IGNEOUS_HEARTBEAT_SEC") is None
+
+
+def test_opt_float_tristate(monkeypatch):
+  monkeypatch.delenv("IGNEOUS_HEALTH_WINDOW_SEC", raising=False)
+  assert knobs.opt_float("IGNEOUS_HEALTH_WINDOW_SEC") is None
+  monkeypatch.setenv("IGNEOUS_HEALTH_WINDOW_SEC", "junk")
+  assert knobs.opt_float("IGNEOUS_HEALTH_WINDOW_SEC") is None
+  monkeypatch.setenv("IGNEOUS_HEALTH_WINDOW_SEC", "120")
+  assert knobs.opt_float("IGNEOUS_HEALTH_WINDOW_SEC") == 120.0
+
+
+def test_raw_is_verbatim(monkeypatch):
+  monkeypatch.delenv("IGNEOUS_PAGE_SHAPE", raising=False)
+  assert knobs.raw("IGNEOUS_PAGE_SHAPE") is None
+  monkeypatch.setenv("IGNEOUS_PAGE_SHAPE", "8, 8, 8")
+  assert knobs.raw("IGNEOUS_PAGE_SHAPE") == "8, 8, 8"
+
+
+def test_get_bool_word_semantics(monkeypatch):
+  for word in ("0", "off", "OFF", "false", "no", "No"):
+    monkeypatch.setenv("IGNEOUS_JOURNAL_COMPRESS", word)
+    assert knobs.get_bool("IGNEOUS_JOURNAL_COMPRESS") is False, word
+  for word in ("1", "on", "yes", "gzip", "true"):
+    monkeypatch.setenv("IGNEOUS_JOURNAL_COMPRESS", word)
+    assert knobs.get_bool("IGNEOUS_JOURNAL_COMPRESS") is True, word
+  monkeypatch.delenv("IGNEOUS_JOURNAL_COMPRESS", raising=False)
+  assert knobs.get_bool("IGNEOUS_JOURNAL_COMPRESS") is False
+
+
+def test_no_native_zero_means_native_on(monkeypatch):
+  # pre-registry code treated any set value as truthy; the unified
+  # semantics make IGNEOUS_TPU_NO_NATIVE=0 mean "native stays on"
+  monkeypatch.setenv("IGNEOUS_TPU_NO_NATIVE", "0")
+  assert knobs.get_bool("IGNEOUS_TPU_NO_NATIVE") is False
+  monkeypatch.setenv("IGNEOUS_TPU_NO_NATIVE", "1")
+  assert knobs.get_bool("IGNEOUS_TPU_NO_NATIVE") is True
+
+
+def test_journal_compress_uses_registry(monkeypatch):
+  from igneous_tpu.observability import journal
+
+  monkeypatch.setenv("IGNEOUS_JOURNAL_COMPRESS", "off")
+  assert journal.compression_enabled() is False
+  monkeypatch.setenv("IGNEOUS_JOURNAL_COMPRESS", "1")
+  assert journal.compression_enabled() is True
+
+
+def test_registered_writes(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_SIM_SEED", "1")
+  knobs.set_env("IGNEOUS_SIM_SEED", "7")
+  assert os.environ["IGNEOUS_SIM_SEED"] == "7"
+  knobs.setdefault_env("IGNEOUS_SIM_SEED", "9")
+  assert os.environ["IGNEOUS_SIM_SEED"] == "7"
+  with pytest.raises(KeyError):
+    knobs.set_env("IGNEOUS_NOT_A_REAL_KNOB", "1")
+
+
+# ---------------------------------------------------------------------------
+# knob registry: one default per knob, pinned against the dataclasses
+# ---------------------------------------------------------------------------
+
+
+def _assert_defaults_agree(cls, env_map):
+  by_name = {f.name: f for f in dataclasses.fields(cls)}
+  for field_name, env_name in env_map.items():
+    assert env_name in knobs.KNOBS, f"{env_name} not registered"
+    knob = knobs.KNOBS[env_name]
+    dflt = by_name[field_name].default
+    where = f"{cls.__name__}.{field_name} vs {env_name}"
+    if dflt is None or knob.default is None:
+      assert dflt is None and knob.default is None, where
+    elif isinstance(dflt, bool) or isinstance(knob.default, bool):
+      assert bool(knob.default) == bool(dflt), where
+    elif isinstance(dflt, (int, float)):
+      assert float(knob.default) == float(dflt), where
+    else:
+      assert knob.default == dflt, where
+
+
+def test_health_config_defaults_mirror_registry():
+  _assert_defaults_agree(HealthConfig, HealthConfig._ENV)
+
+
+def test_autoscale_policy_defaults_mirror_registry():
+  _assert_defaults_agree(AutoscalePolicy, AutoscalePolicy._ENV)
+
+
+def test_sim_config_defaults_mirror_registry():
+  _assert_defaults_agree(SimConfig, SimConfig._ENV)
+
+
+def test_retry_policy_defaults_mirror_registry():
+  _assert_defaults_agree(RetryPolicy, {
+    "attempts": "IGNEOUS_RETRY_ATTEMPTS",
+    "base_s": "IGNEOUS_RETRY_BASE_S",
+    "cap_s": "IGNEOUS_RETRY_CAP_S",
+    "budget_s": "IGNEOUS_RETRY_BUDGET_S",
+  })
+
+
+def test_serve_config_defaults_mirror_registry():
+  from igneous_tpu.serve.app import ServeConfig
+
+  _assert_defaults_agree(ServeConfig, {
+    "ram_mb": "IGNEOUS_SERVE_RAM_MB",
+    "ssd_dir": "IGNEOUS_SERVE_SSD_DIR",
+    "ssd_mb": "IGNEOUS_SERVE_SSD_MB",
+    "cache_control": "IGNEOUS_SERVE_CACHE_CONTROL",
+    "synth_mips": "IGNEOUS_SERVE_SYNTH_MIPS",
+    "writeback": "IGNEOUS_SERVE_WRITEBACK",
+    "max_object_mb": "IGNEOUS_SERVE_MAX_OBJECT_MB",
+    "io_threads": "IGNEOUS_SERVE_IO_THREADS",
+    "drain_sec": "IGNEOUS_SERVE_DRAIN_SEC",
+  })
+
+
+def test_from_env_round_trip(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_HEALTH_WINDOW_SEC", "120")
+  monkeypatch.setenv("IGNEOUS_HEALTH_STRAGGLER_MIN_TASKS", "5")
+  cfg = HealthConfig.from_env()
+  assert cfg.window_sec == 120.0
+  assert cfg.straggler_min_tasks == 5
+  # junk never takes the analyzer down: registry default wins
+  monkeypatch.setenv("IGNEOUS_HEALTH_WINDOW_SEC", "banana")
+  assert HealthConfig.from_env().window_sec == 600.0
+  # explicit overrides (CLI flags) beat env
+  assert HealthConfig.from_env(window_sec=5.0).window_sec == 5.0
+
+  monkeypatch.setenv("IGNEOUS_SIM_WORKERS", "6")
+  cfg = SimConfig.from_env()
+  assert cfg.workers == 6 and isinstance(cfg.workers, int)
+  monkeypatch.setenv("IGNEOUS_SIM_WORKERS", "a-few")
+  assert SimConfig.from_env().workers == 4
+
+  monkeypatch.setenv("IGNEOUS_RETRY_ATTEMPTS", "3")
+  assert RetryPolicy.from_env().attempts == 3
+  monkeypatch.setenv("IGNEOUS_RETRY_ATTEMPTS", "zillion")
+  assert RetryPolicy.from_env().attempts == 6
+
+
+# ---------------------------------------------------------------------------
+# generated README table: stability + code<->docs agreement (IGN103)
+# ---------------------------------------------------------------------------
+
+
+def test_knobs_markdown_stable_and_complete():
+  a = knobs.knobs_markdown()
+  b = knobs.knobs_markdown()
+  assert a == b
+  assert a.startswith(knobs.BEGIN_MARK)
+  assert a.rstrip("\n").endswith(knobs.END_MARK)
+  for name in knobs.KNOBS:
+    assert f"`{name}`" in a, f"{name} missing from the generated table"
+
+
+def test_readme_agrees_with_registry():
+  # the committed README block must equal the generated table
+  # byte-for-byte; `igneous lint --knobs-md --write` regenerates it
+  assert runner.readme_check(REPO) == []
+
+
+def test_readme_drift_detected(tmp_path):
+  md = knobs.knobs_markdown()
+  (tmp_path / "README.md").write_text(
+    "# x\n\n" + md.replace("| str |", "| int |", 1)
+  )
+  found = runner.readme_check(str(tmp_path))
+  assert _codes(found) == ["IGN103"]
+  (tmp_path / "README.md").write_text("# no markers\n")
+  assert _codes(runner.readme_check(str(tmp_path))) == ["IGN103"]
+
+
+# ---------------------------------------------------------------------------
+# runner: baseline lifecycle + the zero-baseline acceptance rule
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_is_line_free():
+  a = findings_mod.Finding("IGN201", "a/b.py", 10, "m", "jit:f")
+  b = findings_mod.Finding("IGN201", "a/b.py", 99, "other", "jit:f")
+  assert a.fingerprint == b.fingerprint == "IGN201 a/b.py jit:f"
+
+
+def test_shipped_baseline_is_empty():
+  with open(os.path.join(REPO, runner.DEFAULT_BASELINE)) as f:
+    data = json.load(f)
+  assert data["entries"] == []
+
+
+def test_update_baseline_refuses_env_and_telemetry(tmp_path):
+  _write(tmp_path, "igneous_tpu/mod.py", """\
+    import os
+
+    FLAG = os.environ.get("IGNEOUS_PIPELINE")
+  """)
+  (tmp_path / "tools").mkdir()
+  rc = runner.main(
+    str(tmp_path), update_baseline=True, echo=lambda *_: None)
+  assert rc == 2
+  assert not (tmp_path / runner.DEFAULT_BASELINE).exists()
+
+
+def test_baseline_lifecycle(tmp_path):
+  rel = "igneous_tpu/ops/hot.py"
+  _write(tmp_path, rel, """\
+    import jax
+
+    def per_call(x, fn):
+      return jax.jit(fn)(x)
+  """)
+  (tmp_path / "tools").mkdir()
+  quiet = lambda *_: None  # noqa: E731
+
+  assert runner.main(str(tmp_path), echo=quiet) == 1
+  # recompile findings ARE baselineable (deliberate deferral)
+  assert runner.main(str(tmp_path), update_baseline=True,
+                     echo=quiet) == 0
+  with open(tmp_path / runner.DEFAULT_BASELINE) as f:
+    entries = json.load(f)["entries"]
+  assert entries == ["IGN201 igneous_tpu/ops/hot.py "
+                     "jit-in-function:per_call"]
+  assert runner.main(str(tmp_path), echo=quiet) == 0
+  # fixing the site makes the entry stale -> fail until removed
+  _write(tmp_path, rel, "HOT = None\n")
+  assert runner.main(str(tmp_path), echo=quiet) == 1
+
+
+def test_select_limits_passes(tmp_path):
+  _write(tmp_path, "igneous_tpu/mod.py", """\
+    import os
+
+    FLAG = os.environ.get("IGNEOUS_PIPELINE")
+  """)
+  lines = []
+  rc = runner.main(str(tmp_path), select=("locks",),
+                   echo=lines.append)
+  assert rc == 0 and "0 finding(s)" in lines[-1]
+  rc = runner.main(str(tmp_path), select=("env-knobs",),
+                   echo=lines.append)
+  assert rc == 1
+
+
+def test_repo_lint_is_green():
+  # the ISSUE 14 acceptance gate itself: zero findings, zero baseline,
+  # zero stale entries over the real tree
+  lines = []
+  assert runner.main(REPO, echo=lines.append) == 0
+  assert lines[-1] == (
+    "igneous lint: 0 finding(s), 0 baselined, 0 stale baseline "
+    "entr(ies)"
+  )
+
+
+def test_cli_knobs_md_matches_registry():
+  from click.testing import CliRunner
+
+  from igneous_tpu.cli import main as cli_main
+
+  result = CliRunner().invoke(cli_main, ["lint", "--knobs-md"])
+  assert result.exit_code == 0
+  assert result.output == knobs.knobs_markdown()
+
+
+# ---------------------------------------------------------------------------
+# discovery: the shared noise policy
+# ---------------------------------------------------------------------------
+
+
+def test_walk_files_prunes_noise(tmp_path):
+  (tmp_path / "__pycache__").mkdir()
+  (tmp_path / "__pycache__" / "m.cpython-312.pyc").write_bytes(b"x")
+  (tmp_path / "pkg.egg-info").mkdir()
+  (tmp_path / "pkg.egg-info" / "PKG-INFO").write_text("x")
+  (tmp_path / "a.pyc").write_bytes(b"x")
+  (tmp_path / "b.py").write_text("B = 1\n")
+  (tmp_path / "sub").mkdir()
+  (tmp_path / "sub" / "c.txt").write_text("hi")
+  got = [
+    os.path.relpath(p, tmp_path)
+    for p in discovery.walk_files(str(tmp_path))
+  ]
+  assert got == ["b.py", os.path.join("sub", "c.txt")]
+  only_py = [
+    os.path.relpath(p, tmp_path)
+    for p in discovery.walk_files(str(tmp_path), suffixes=(".py",))
+  ]
+  assert only_py == ["b.py"]
+
+
+def test_iter_source_files_scope():
+  files = [os.path.relpath(p, REPO)
+           for p in discovery.iter_source_files(REPO)]
+  assert files, "lint walker found no sources"
+  assert all(f.endswith(".py") for f in files)
+  assert not any(f.startswith("tests" + os.sep) for f in files)
+  assert os.path.join("igneous_tpu", "analysis", "knobs.py") in files
+  assert len(files) == len(set(files))
+
+
+# ---------------------------------------------------------------------------
+# racecheck: the dynamic companion of IGN3
+# ---------------------------------------------------------------------------
+
+
+def test_guard_is_noop_when_disabled(monkeypatch):
+  monkeypatch.delenv("IGNEOUS_RACE_CHECK", raising=False)
+  d = {}
+  assert racecheck.guard(d, threading.Lock(), "x") is d
+
+
+def test_guarded_proxy_asserts_unlocked_writes(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_RACE_CHECK", "1")
+  lock = threading.Lock()
+  p = racecheck.guard({}, lock, "Cache._entries")
+  assert isinstance(p, racecheck.GuardedProxy)
+  with lock:
+    p["a"] = 1
+    p.update(b=2)
+    del p["b"]
+  # reads never assert (benign racy reads are policy-tolerated)
+  assert p["a"] == 1 and len(p) == 1 and "a" in p and list(p) == ["a"]
+  with pytest.raises(AssertionError, match="Cache._entries"):
+    p["c"] = 3
+  with pytest.raises(AssertionError, match="race check"):
+    p.update(c=3)
+  with pytest.raises(AssertionError):
+    del p["a"]
+
+
+def test_guarded_proxy_rlock_ownership(monkeypatch):
+  monkeypatch.setenv("IGNEOUS_RACE_CHECK", "1")
+  rlock = threading.RLock()
+  p = racecheck.guard([], rlock, "C._items")
+  with rlock:
+    p.append(1)
+    p.extend([2, 3])
+    p.pop()
+  assert list(p) == [1, 2]
+  with pytest.raises(AssertionError):
+    p.append(4)

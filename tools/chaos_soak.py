@@ -49,6 +49,7 @@ sys.path.insert(0, REPO_ROOT)
 import numpy as np  # noqa: E402
 
 from igneous_tpu import task_creation as tc  # noqa: E402
+from igneous_tpu.analysis import discovery, knobs  # noqa: E402
 from igneous_tpu import telemetry  # noqa: E402
 from igneous_tpu.chaos import ChaosConfig, ChaosQueue, chaos_storage  # noqa: E402
 from igneous_tpu.queues import FileQueue  # noqa: E402
@@ -70,16 +71,14 @@ def layer_bytes(root):
   files excluded too — a SIGKILLed worker can leave one behind, and
   readers never see them)."""
   out = {}
-  for dirpath, _dirs, files in os.walk(root):
-    for fname in files:
-      if ".tmp." in fname:
-        continue
-      full = os.path.join(dirpath, fname)
-      rel = os.path.relpath(full, root)
-      if rel.startswith("provenance"):
-        continue
-      with open(full, "rb") as f:
-        out[rel] = f.read()
+  for full in discovery.walk_files(root):
+    if ".tmp." in os.path.basename(full):
+      continue
+    rel = os.path.relpath(full, root)
+    if rel.startswith("provenance"):
+      continue
+    with open(full, "rb") as f:
+      out[rel] = f.read()
   return out
 
 
@@ -112,7 +111,7 @@ def pipeline_disabled():
   """The CLEAN reference run always pins bytes with the strict-serial
   path, even when --pipeline turns the staged pipeline on for the
   fault/storm runs — that asymmetry IS the byte-identity claim."""
-  prev = os.environ.get("IGNEOUS_PIPELINE")
+  prev = knobs.raw("IGNEOUS_PIPELINE")
   os.environ["IGNEOUS_PIPELINE"] = "off"
   try:
     yield

@@ -61,6 +61,7 @@ def main():
   jpath = f"file://{qdir}/journal"
 
   from igneous_tpu import task_creation as tc
+  from igneous_tpu.analysis import discovery
   from igneous_tpu.observability import device as device_mod
   from igneous_tpu.queues import FileQueue
   from igneous_tpu.volume import Volume
@@ -136,13 +137,11 @@ def main():
   if args.profile_out:
     os.makedirs(args.profile_out, exist_ok=True)
     src_root = os.path.join(qdir, "journal", "profiles")
-    for root, _dirs, files in os.walk(src_root):
-      for fname in files:
-        full = os.path.join(root, fname)
-        rel = os.path.relpath(full, src_root)
-        dest = os.path.join(args.profile_out, rel)
-        os.makedirs(os.path.dirname(dest), exist_ok=True)
-        shutil.copyfile(full, dest)
+    for full in discovery.walk_files(src_root):
+      rel = os.path.relpath(full, src_root)
+      dest = os.path.join(args.profile_out, rel)
+      os.makedirs(os.path.dirname(dest), exist_ok=True)
+      shutil.copyfile(full, dest)
     print(f"copied artifacts to {args.profile_out}")
 
   print("DEVICE_SMOKE_OK")

@@ -26,6 +26,8 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from igneous_tpu.analysis import discovery  # noqa: E402
+
 
 def worker_env(pipeline: str):
   env = dict(os.environ)
@@ -44,13 +46,12 @@ def worker_env(pipeline: str):
 
 def layer_bytes(root):
   out = {}
-  for dirpath, _dirs, files in os.walk(root):
-    for fname in files:
-      if "provenance" in fname or ".tmp." in fname:
-        continue
-      full = os.path.join(dirpath, fname)
-      with open(full, "rb") as f:
-        out[os.path.relpath(full, root)] = f.read()
+  for full in discovery.walk_files(root):
+    fname = os.path.basename(full)
+    if "provenance" in fname or ".tmp." in fname:
+      continue
+    with open(full, "rb") as f:
+      out[os.path.relpath(full, root)] = f.read()
   return out
 
 

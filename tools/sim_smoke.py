@@ -22,7 +22,6 @@ Exit 0 = all gates passed.
 import hashlib
 import json
 import os
-import pathlib
 import shutil
 import sys
 import tempfile
@@ -35,6 +34,7 @@ sys.path.insert(0, REPO_ROOT)
 
 from click.testing import CliRunner  # noqa: E402
 
+from igneous_tpu.analysis import discovery  # noqa: E402
 from igneous_tpu.cli import main as cli_main  # noqa: E402
 from igneous_tpu.observability import fleet, replay, sim  # noqa: E402
 from igneous_tpu.queues import TaskQueue  # noqa: E402
@@ -60,10 +60,10 @@ def gate(name, ok, **detail):
 
 def journal_digest(path):
   h = hashlib.sha256()
-  for f in sorted(pathlib.Path(path).rglob("*")):
-    if f.is_file():
-      h.update(f.name.encode())
-      h.update(f.read_bytes())
+  for full in discovery.walk_files(path):
+    h.update(os.path.basename(full).encode())
+    with open(full, "rb") as f:
+      h.update(f.read())
   return h.hexdigest()
 
 
