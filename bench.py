@@ -1056,6 +1056,55 @@ def bench_forge_pipelines():
   return round(seg.size / mesh_dt, 1), round(seg.size / skel_dt, 1)
 
 
+def bench_queue():
+  """Queue scale-out (ISSUE 15): the control-plane rates a 10M-task
+  campaign lives or dies on, measured on a 100k-task fq:// queue —
+  batched segment enqueue vs the classic one-file-per-task layout,
+  range-lease acquisition throughput, and the `queue status` depth read
+  (O(shards): task counts ride in segment file names)."""
+  import shutil
+  import tempfile
+
+  from igneous_tpu.queues import FileQueue, PrintTask, serialize
+
+  n = 20_000 if QUICK else 100_000
+  n_classic = 1_000 if QUICK else 2_000
+  payload = serialize(PrintTask("bench"))
+  root = tempfile.mkdtemp(prefix="bench_queue_")
+  try:
+    cq = FileQueue(f"fq://{root}/classic")
+    t0 = time.perf_counter()
+    cq.insert(payload for _ in range(n_classic))
+    classic_rate = n_classic / (time.perf_counter() - t0)
+
+    q = FileQueue(f"fq://{root}/batched")
+    t0 = time.perf_counter()
+    q.insert_batch((payload for _ in range(n)), total=n)
+    enqueue_rate = n / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+      snap = q.depth_snapshot()
+    status_ms = (time.perf_counter() - t0) / 3 * 1e3
+    assert snap["enqueued"] == n, snap
+
+    target = min(n, 4_096 if QUICK else 20_480)
+    leased = 0
+    t0 = time.perf_counter()
+    while leased < target:
+      got = q.lease_batch(600, max_tasks=1024)
+      if not got:
+        break
+      leased += len(got)
+    lease_rate = leased / (time.perf_counter() - t0)
+  finally:
+    shutil.rmtree(root, ignore_errors=True)
+  return (
+    round(enqueue_rate, 1), round(lease_rate, 1), round(status_ms, 3),
+    round(classic_rate, 1),
+  )
+
+
 def _skip(reason: str) -> dict:
   """Explicit not-run marker (ISSUE 6 satellite): a gated metric records
   WHY it has no number, so the BENCH trajectory distinguishes "skipped
@@ -1161,6 +1210,8 @@ def run_bench(platform: str):
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
   codec_tbl = bench_codecs(img, seg)
   cseg_speedup = bench_cseg_speedup()
+  (queue_enqueue_rate, queue_lease_rate,
+   queue_status_ms, queue_classic_rate) = bench_queue()
   xfer_passthrough, xfer_decode = bench_transfer_passthrough(seg)
   serve_stats = bench_serve(seg)
 
@@ -1246,6 +1297,18 @@ def run_bench(platform: str):
       # ISSUE 4: compressed-domain fast paths
       "codec_MBps": codec_tbl,
       "cseg_vs_loop": cseg_speedup,
+      # ISSUE 15: batched queue wire protocol + range leases — segment
+      # enqueue and range-lease acquisition rates on a 100k-task fq://
+      # campaign, the classic per-task enqueue for the speedup
+      # denominator, and the depth read (O(shards), not O(tasks))
+      "queue_enqueue_tasks_per_sec": queue_enqueue_rate,
+      "queue_lease_tasks_per_sec": queue_lease_rate,
+      "queue_status_ms_100k": queue_status_ms,
+      "queue_classic_enqueue_tasks_per_sec": queue_classic_rate,
+      "queue_enqueue_speedup": (
+        round(queue_enqueue_rate / queue_classic_rate, 1)
+        if queue_classic_rate else _skip("classic enqueue measured zero")
+      ),
       "transfer_passthrough_voxps": xfer_passthrough,
       "transfer_decode_voxps": xfer_decode,
       "transfer_passthrough_speedup": (

@@ -60,7 +60,7 @@ _REGISTRY: Dict[str, Knob] = {}
 # section display order for the generated README table
 SECTIONS = (
   "pipeline", "chunk cache", "device kernels", "paged batching",
-  "multihost", "worker lifecycle", "retry", "storage", "serve",
+  "multihost", "worker lifecycle", "retry", "queue", "storage", "serve",
   "journal", "trace / metrics / profile", "health / SLO", "autoscale",
   "simulator", "misc",
 )
@@ -164,6 +164,17 @@ _knob("IGNEOUS_RETRY_CAP_S", "float", 30.0,
       "max single backoff delay", "retry")
 _knob("IGNEOUS_RETRY_BUDGET_S", "float", 120.0,
       "total sleep budget per operation", "retry")
+
+# --- queue ----------------------------------------------------------------
+_knob("IGNEOUS_QUEUE_SHARDS", "int", 16,
+      "segment files a known-total `insert_batch` spreads across "
+      "(lease-contention fan-out)", "queue")
+_knob("IGNEOUS_QUEUE_SEG_TASKS", "int", 1024,
+      "max tasks per fq:// segment file; 0 = classic one-file-per-task "
+      "layout", "queue")
+_knob("IGNEOUS_QUEUE_RECYCLE_SEC", "float", 5.0,
+      "min interval between expired-lease scans on lease(); 0 scans "
+      "every call (forced when the pending pool looks drained)", "queue")
 
 # --- storage --------------------------------------------------------------
 _knob("IGNEOUS_SCRATCH_COMPRESS", "str", "",
@@ -317,6 +328,9 @@ _knob("IGNEOUS_SIM_FAIL_SCALE", "float", 1.0,
       "multiply mined failure probabilities", "simulator")
 _knob("IGNEOUS_SIM_MAX_SEC", "float", 30 * 24 * 3600.0,
       "simulated-time safety valve (30 days)", "simulator")
+_knob("IGNEOUS_SIM_RANGE_LEASE", "int", 0,
+      "1 = simulate range-lease rounds (one shared lease per batch); "
+      "0 = classic per-member leases", "simulator")
 
 # --- misc -----------------------------------------------------------------
 _knob("IGNEOUS_TPU_NO_NATIVE", "bool", False,

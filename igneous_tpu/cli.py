@@ -119,7 +119,17 @@ def enqueue(queue_spec: str, tasks, parallel: int = 1):
   if queue_spec is None:
     LocalTaskQueue(parallel=parallel).insert(tasks)
   else:
-    TaskQueue(queue_spec).insert(tasks)
+    # batched wire protocol (ISSUE 15): grid iterators know their task
+    # count up front, which lets fq:// size its segment shards
+    total = None
+    if hasattr(tasks, "num_pending"):
+      total = tasks.num_pending()
+    elif hasattr(tasks, "__len__"):
+      try:
+        total = len(tasks)
+      except TypeError:
+        total = None
+    TaskQueue(queue_spec).insert_batch(tasks, total=total)
 
 
 @click.group()
@@ -1655,6 +1665,10 @@ def queue_status(queue_spec, eta, sample_sec):
   click.echo(f"enqueued: {tq.enqueued}")
   click.echo(f"leased: {tq.leased}")
   click.echo(f"completed: {tq.completed}")
+  if hasattr(tq, "queue_files"):
+    # control-plane objects, not tasks: O(shards) for batch-inserted
+    # campaigns — the scale-out signal (ISSUE 15)
+    click.echo(f"queue files: {tq.queue_files}")
   if hasattr(tq, "dlq_count"):
     click.echo(f"dead-lettered: {tq.dlq_count}")
   if hasattr(tq, "stale_leases"):

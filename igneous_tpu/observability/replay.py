@@ -62,6 +62,7 @@ class WorkloadModel:
     round_overhead: Optional[dict] = None,
     worker_speeds: Optional[List[float]] = None,
     meta: Optional[dict] = None,
+    range_sizes: Optional[List[int]] = None,
   ):
     # task_types[name] = {count, failures, sum, durs (sorted, capped),
     #                     bytes_per_task, max_attempt}
@@ -70,6 +71,10 @@ class WorkloadModel:
     self.round_overhead: dict = round_overhead or {
       "count": 0, "sum": 0.0, "durs": [],
     }
+    # range-lease spans per round, mined from the lease batcher's
+    # ``range_sizes`` attr on lease.acquire (ISSUE 15); empty for
+    # campaigns that ran per-task leases
+    self.range_sizes: List[int] = list(range_sizes or [])
     # per-worker median_dur / fleet median_dur ratios (sorted): the
     # straggler-tail replay — a simulated worker's speed is one of these
     self.worker_speeds: List[float] = sorted(worker_speeds or [])
@@ -103,6 +108,7 @@ class WorkloadModel:
     trace_to_type: Dict[str, str] = {}
     per_worker_durs: Dict[str, List[float]] = defaultdict(list)
     overhead = {"count": 0, "sum": 0.0, "durs": []}
+    range_sizes: List[int] = []
 
     def type_stats(name: str) -> dict:
       st = types.get(name)
@@ -152,6 +158,12 @@ class WorkloadModel:
         overhead["sum"] += float(dur)
         if len(overhead["durs"]) < sample_cap:
           overhead["durs"].append(float(dur))
+        sizes = rec.get("range_sizes")
+        if isinstance(sizes, (list, tuple)):
+          for s in sizes:
+            if len(range_sizes) >= sample_cap:
+              break
+            range_sizes.append(int(s))
         continue
       if name in _BYTE_SPAN_NAMES:
         nbytes = rec.get("bytes")
@@ -195,6 +207,7 @@ class WorkloadModel:
       task_types=task_types,
       round_overhead=overhead,
       worker_speeds=speeds,
+      range_sizes=sorted(range_sizes),
       meta={
         "version": MODEL_VERSION,
         "tasks_seen": sum(t["count"] for t in task_types.values()),
@@ -277,6 +290,7 @@ class WorkloadModel:
       "task_types": self.task_types,
       "round_overhead": self.round_overhead,
       "worker_speeds": self.worker_speeds,
+      "range_sizes": self.range_sizes,
       "meta": self.meta,
     }
 
@@ -292,6 +306,8 @@ class WorkloadModel:
       task_types=d.get("task_types") or {},
       round_overhead=d.get("round_overhead"),
       worker_speeds=d.get("worker_speeds"),
+      # pre-ISSUE-15 models have no range_sizes; default to none mined
+      range_sizes=d.get("range_sizes"),
       meta=d.get("meta"),
     )
 

@@ -87,6 +87,7 @@ class SimConfig:
   chaos: ChaosSpec = field(default_factory=ChaosSpec)
   max_sim_sec: float = 30 * 24 * 3600.0
   segment_spans: int = 512         # spans per emitted journal segment
+  range_lease: int = 0             # 1 = one shared lease per round (ISSUE 15)
 
   _ENV = {
     "workers": "IGNEOUS_SIM_WORKERS",
@@ -98,9 +99,10 @@ class SimConfig:
     "worker_start_sec": "IGNEOUS_SIM_WORKER_START_SEC",
     "fail_scale": "IGNEOUS_SIM_FAIL_SCALE",
     "max_sim_sec": "IGNEOUS_SIM_MAX_SEC",
+    "range_lease": "IGNEOUS_SIM_RANGE_LEASE",
   }
   _INT_FIELDS = ("workers", "seed", "tasks", "batch_size",
-                 "max_deliveries", "segment_spans")
+                 "max_deliveries", "segment_spans", "range_lease")
 
   @classmethod
   def from_env(cls, **overrides) -> "SimConfig":
@@ -183,6 +185,7 @@ class FleetSimulator:
     self.lease_recycles = 0
     self.zombie_fenced = 0
     self.released = 0
+    self.range_rounds = 0
     self.policy_loop = PolicyLoop(
       self.cfg.policy or AutoscalePolicy()
     ) if self.cfg.autoscale else None
@@ -371,20 +374,37 @@ class FleetSimulator:
       return self._drain_exit(w, [])
     members: List[int] = []
     cap = 1 if w.straggler_flagged else max(self.cfg.batch_size, 1)
+    use_range = bool(self.cfg.range_lease)
     while self.pending and len(members) < cap:
       i = self.pending.popleft()
       task = self.tasks[i]
       task["state"] = "leased"
       task["deliveries"] += 1
       task["lease_worker"] = w.wid
+      if not use_range:
+        self._lease_seq += 1
+        task["lease_token"] = self._lease_seq
+        tok = self._lease_seq
+        self._push(
+          self.t + self.cfg.lease_sec,
+          lambda i=i, tok=tok: self._lease_expire(i, tok),
+        )
+      members.append(i)
+    if use_range and members:
+      # range lease (ISSUE 15): the round holds ONE shared token and ONE
+      # expiry event, mirroring an fq:// segment lease — completed /
+      # nacked members change state individually (sub-task accounting),
+      # so the shared expiry recycles only still-leased survivors
       self._lease_seq += 1
-      task["lease_token"] = self._lease_seq
       tok = self._lease_seq
+      for i in members:
+        self.tasks[i]["lease_token"] = tok
       self._push(
         self.t + self.cfg.lease_sec,
-        lambda i=i, tok=tok: self._lease_expire(i, tok),
+        lambda m=tuple(members), tok=tok: self._range_expire(m, tok),
       )
-      members.append(i)
+      self.range_rounds += 1
+      w.incr("sim.range_rounds")
     if not members:
       if self.done:
         return self._clean_exit(w)
@@ -397,9 +417,10 @@ class FleetSimulator:
       "executed": 0, "failed": 0,
     }
     if overhead > 0:
-      self._span(
-        w, "lease.acquire", self.t, overhead, members=len(members),
-      )
+      attrs = {"members": len(members)}
+      if use_range:
+        attrs["range_sizes"] = [len(members)]
+      self._span(w, "lease.acquire", self.t, overhead, **attrs)
     if w.mode == "stall" and not w.stalled:
       # the zombie scenario: a round is leased, then the worker goes
       # dark holding it — expiry recycles the members, and any fence
@@ -526,6 +547,22 @@ class FleetSimulator:
       self.driver.incr("retries.lease_recycle")
       self.lease_recycles += 1
 
+  def _range_expire(self, members, tok: int) -> None:
+    """Shared-token expiry for a range-leased round: recycle every member
+    still holding the round's token. Members already done / dlq'd / nacked
+    back to pending (sub-task accounting) are untouched."""
+    recycled = 0
+    for i in members:
+      task = self.tasks[i]
+      if task["state"] == "leased" and task["lease_token"] == tok:
+        task["state"] = "pending"
+        task["lease_worker"] = None
+        self.pending.append(i)
+        recycled += 1
+    if recycled:
+      self.driver.incr("retries.lease_recycle", recycled)
+      self.lease_recycles += recycled
+
   def _terminal(self) -> None:
     self.terminal += 1
     if self.terminal >= len(self.tasks) and not self.done:
@@ -647,6 +684,7 @@ class FleetSimulator:
       "zombie_fenced": self.zombie_fenced,
       "released": self.released,
       "rounds": sum(w.rounds for w in self.workers.values()),
+      "range_rounds": self.range_rounds,
       "makespan_sec": round(makespan, 3),
       "tasks_per_sec": (
         round(completed / makespan, 4) if makespan > 0 else 0.0

@@ -52,6 +52,20 @@ def _cutout_key(task):
   )
 
 
+def _range_sizes(tokens):
+  """Contiguous-range composition of a lease round: member counts per
+  shared RangeLease, largest first (classic tokens excluded). None when
+  the round had no range members — the journal attr only appears for
+  range-leased rounds, which is what replay.py mines."""
+  from ..queues.ranges import RangeSub
+
+  sizes = {}
+  for tok in tokens:
+    if isinstance(tok, RangeSub):
+      sizes[id(tok.parent)] = sizes.get(id(tok.parent), 0) + 1
+  return sorted(sizes.values(), reverse=True) if sizes else None
+
+
 def _group_key(task, volmeta_cache):
   """Hashable device-stage signature, or None when the task must run solo.
 
@@ -335,22 +349,27 @@ class LeaseBatcher:
         self._release_members(members[cap:])
         members = members[:cap]
       lease_t0 = time.time()
-      synced = 0
+      synced = []
       while len(members) < cap and not self._draining():
-        leased = self.queue.lease(self.lease_seconds)
-        if leased is None:
+        got = self._lease_many(cap - len(members))
+        if not got:
           break
-        members.append(leased)
-        self._hb.track(leased[1])
-        synced += 1
+        for leased in got:
+          members.append(leased)
+          self._hb.track(leased[1])
+          synced.append(leased[1])
       if synced:
         # per-round queue-interaction cost: the workload miner folds
         # these into the round-overhead distribution the fleet
         # simulator replays, so batched campaigns simulate queue time,
-        # not just compute
+        # not just compute. range_sizes (when present) records the
+        # round's contiguous-range composition for range-lease replay.
+        attrs = {"members": len(synced)}
+        sizes = _range_sizes(synced)
+        if sizes:
+          attrs["range_sizes"] = sizes
         trace.record_root(
-          "lease.acquire", lease_t0, time.time() - lease_t0,
-          members=synced,
+          "lease.acquire", lease_t0, time.time() - lease_t0, **attrs,
         )
       if self._draining():
         # preempted between lease and dispatch: nothing ran, so every
@@ -411,6 +430,23 @@ class LeaseBatcher:
       # round boundary: the round's spans (one lease.round + K member
       # task spans) flush as one journal segment
       journal_mod.maybe_flush_active(event="round")
+
+  def _lease_many(self, n: int):
+    """One queue interaction for up to ``n`` leases: the batched wire
+    protocol (ISSUE 15) when the backend has it — fq:// segments arrive
+    as RangeSub members sharing ONE underlying lease, which the round's
+    delete/nack/release/renew calls consume natively — else the classic
+    scalar lease loop."""
+    lease_batch = getattr(self.queue, "lease_batch", None)
+    if lease_batch is not None:
+      return lease_batch(self.lease_seconds, max_tasks=n)
+    out = []
+    while len(out) < n and not self._draining():
+      leased = self.queue.lease(self.lease_seconds)
+      if leased is None:
+        break
+      out.append(leased)
+    return out
 
   # -- next-round pipelining ------------------------------------------------
 
@@ -489,25 +525,27 @@ class LeaseBatcher:
     real error."""
     members = []
     while len(members) < cap and not self._draining():
-      leased = self.queue.lease(self.lease_seconds)
-      if leased is None:
+      got = self._lease_many(cap - len(members))
+      if not got:
         break
       if self._draining():
-        # the drain raced our lease: a member the dying round just
-        # released (or a fresh task) must go straight back UNCOUNTED —
-        # keeping it would double-account the same task as both a round
-        # release and a surrendered prefetch
-        try:
-          self.queue.release(leased[1])
-        except Exception:
-          pass
+        # the drain raced our leases: members the dying round just
+        # released (or fresh tasks) must go straight back UNCOUNTED —
+        # keeping them would double-account the same task as both a
+        # round release and a surrendered prefetch
+        for leased in got:
+          try:
+            self.queue.release(leased[1])
+          except Exception:
+            pass
         break
-      members.append(leased)
-      if self._hb is not None:
-        # renew from the moment of pre-lease: round i may run longer
-        # than lease_seconds, and an expired pre-lease re-delivers the
-        # task to another worker while we still hold it
-        self._hb.track(leased[1])
+      for leased in got:
+        members.append(leased)
+        if self._hb is not None:
+          # renew from the moment of pre-lease: round i may run longer
+          # than lease_seconds, and an expired pre-lease re-delivers the
+          # task to another worker while we still hold it
+          self._hb.track(leased[1])
     if not members:
       return members
     self.stats["prefetched_rounds"] += 1

@@ -23,6 +23,7 @@ from .registry import (
 from .local import LocalTaskQueue, MockTaskQueue
 from .filequeue import FileQueue, StaleLeaseError, TaskDeadlineError
 from .heartbeat import LeaseHeartbeat
+from .ranges import RangeLease, RangeSub
 from .queue import TaskQueue, copy_queue, move_queue, register_queue_protocol
 from .sqs import FakeSQSTransport, SQSQueue
 

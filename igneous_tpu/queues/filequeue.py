@@ -17,6 +17,21 @@ raising, overrunning its deadline, or losing its worker — moves to the
 dlq ls|retry|purge`` can inspect, requeue, or drop it. The default
 (``max_deliveries=None``) preserves the historical infinite-retry
 at-least-once semantics.
+
+Queue scale-out (ISSUE 15): the classic layout is one file + meta per
+task, which goes quadratic-ish on listings at the tens-of-millions-of-
+tasks campaigns the paper's grid sizes imply. ``insert_batch`` instead
+writes **sharded metadata segments** — ``seg_<segid>_<count>.jsonl``
+files holding up to ``IGNEOUS_QUEUE_SEG_TASKS`` tasks each (one line
+``<index>\\t<payload>`` per task), sized so a batch lands in about
+``IGNEOUS_QUEUE_SHARDS`` appends — and ``lease_batch`` leases a whole
+segment as ONE :class:`~.ranges.RangeLease`. Depth reads stay
+O(segments): task counts ride in the file names, completion tallies stay
+1-byte-per-task counter files, and delivery counts key on the segment id
+(stable across ack rewrites and splits). Per-task semantics survive
+through sub-task accounting — see :mod:`.ranges`. Classic per-task files
+and segments coexist freely in one queue directory, so pre-ISSUE-15
+layouts keep reading.
 """
 
 from __future__ import annotations
@@ -26,13 +41,51 @@ import os
 import random
 import time
 import uuid
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
+from .ranges import RangeLease, RangeSub
 from .registry import RegisteredTask, deserialize, serialize
 
 LEASE_SEP = "--"
 CONTENTION_WINDOW = 100
 MAX_RECORDED_FAILURES = 5  # per-task failure-reason ring (meta file bound)
+
+SEG_PREFIX = "seg_"
+SEG_SUFFIX = ".jsonl"
+# defaults mirrored by the knobs registry (analysis/knobs.py)
+DEFAULT_QUEUE_SHARDS = 16
+DEFAULT_SEG_TASKS = 1024
+DEFAULT_RECYCLE_SEC = 5.0
+
+
+def seg_parse(name: str) -> Optional[Tuple[str, int]]:
+  """``seg_<segid>_<count>.jsonl`` → (segid, count); None for classic
+  per-task file names. The count in the NAME is the task count in the
+  file (maintained across ack rewrites), so depth reads never open
+  segment files."""
+  if not name.startswith(SEG_PREFIX) or not name.endswith(SEG_SUFFIX):
+    return None
+  parts = name[len(SEG_PREFIX):-len(SEG_SUFFIX)].rsplit("_", 1)
+  if len(parts) != 2:
+    return None
+  try:
+    return parts[0], int(parts[1])
+  except ValueError:
+    return None
+
+
+def seg_name(segid: str, count: int) -> str:
+  return f"{SEG_PREFIX}{segid}_{int(count)}{SEG_SUFFIX}"
+
+
+def _name_tasks(name: str) -> int:
+  """Tasks a queue/lease file name represents (lease prefixes allowed)."""
+  parsed = seg_parse(name.split(LEASE_SEP, 1)[-1])
+  return parsed[1] if parsed else 1
+
+
+def _seg_content(entries) -> str:
+  return "".join(f"{int(i)}\t{p}\n" for i, p in entries)
 
 
 class TaskDeadlineError(Exception):
@@ -243,11 +296,24 @@ class FileQueue:
     os.makedirs(self.lease_dir, exist_ok=True)
     os.makedirs(self.dlq_dir, exist_ok=True)
     os.makedirs(self.meta_dir, exist_ok=True)
+    # cached per-shard pending index (lease picks from here instead of a
+    # full listdir+sort per acquisition) and the recycle-scan throttle
+    self._pending_cache: Optional[List[str]] = None
+    self._last_recycle = 0.0
 
   # -- per-task attempt metadata --------------------------------------------
 
   def _meta_path(self, name: str) -> str:
     return os.path.join(self.meta_dir, name)
+
+  @staticmethod
+  def _meta_key(name_or_lease: str) -> str:
+    """Meta file key for a queue/lease/dlq name. Segments key on the
+    SEGID (``seg_<segid>``) so ack rewrites — which change the count in
+    the file name — never orphan the delivery-count record."""
+    name = str(name_or_lease).split(LEASE_SEP, 1)[-1]
+    parsed = seg_parse(name)
+    return f"{SEG_PREFIX}{parsed[0]}" if parsed else name
 
   def _read_meta(self, name: str) -> dict:
     try:
@@ -286,11 +352,16 @@ class FileQueue:
     self._write_meta(name, meta)
     return meta
 
-  def delivery_count(self, name_or_lease: str) -> int:
-    """Deliveries so far for a task (by queue filename or lease id) —
-    the fq:// analogue of SQS's ApproximateReceiveCount."""
-    name = name_or_lease.split(LEASE_SEP, 1)[-1]
-    return int(self._read_meta(name).get("deliveries", 0))
+  def delivery_count(self, name_or_lease) -> int:
+    """Deliveries so far for a task (by queue filename, lease id, or
+    range-member handle) — the fq:// analogue of SQS's
+    ApproximateReceiveCount. Range members report the shared segment's
+    delivery count until a failure splits them out solo."""
+    if isinstance(name_or_lease, RangeSub):
+      key = f"{SEG_PREFIX}{name_or_lease.parent.segid}"
+    else:
+      key = self._meta_key(name_or_lease)
+    return int(self._read_meta(key).get("deliveries", 0))
 
   def _exhausted(self, name: str) -> bool:
     return (
@@ -352,6 +423,7 @@ class FileQueue:
       meta["deliveries"] = 0
       self._write_meta(name, meta)
       n += 1
+    self._pending_cache = None
     return n
 
   def dlq_purge(self) -> int:
@@ -389,11 +461,23 @@ class FileQueue:
 
   @property
   def enqueued(self) -> int:
-    return len(os.listdir(self.queue_dir)) + len(os.listdir(self.lease_dir))
+    """Tasks in rotation (queued + leased). O(segments) — segment task
+    counts ride in the file names, so no segment file is ever opened."""
+    return (
+      sum(_name_tasks(n) for n in os.listdir(self.queue_dir))
+      + sum(_name_tasks(n) for n in os.listdir(self.lease_dir))
+    )
 
   @property
   def leased(self) -> int:
-    return len(os.listdir(self.lease_dir))
+    return sum(_name_tasks(n) for n in os.listdir(self.lease_dir))
+
+  @property
+  def queue_files(self) -> int:
+    """Control-plane objects backing the pending pool — O(shards) per
+    batch-inserted campaign, vs O(tasks) for the classic layout (the
+    `queue status`/smoke-gate scalability signal)."""
+    return len(os.listdir(self.queue_dir))
 
   def lease_ages(self) -> List[float]:
     """Seconds until each outstanding lease expires (negative = overdue,
@@ -483,11 +567,35 @@ class FileQueue:
       except FileNotFoundError:
         pass
 
+    def segment_ok(path: str, count: int):
+      """None if raced; else whether every line deserializes AND the
+      task count in the name matches the file (depth reads trust it)."""
+      try:
+        entries = self._read_segment(path)
+      except FileNotFoundError:
+        return None
+      except Exception:
+        return False
+      if len(entries) != count:
+        return False
+      try:
+        for _i, p in entries:
+          deserialize(p)
+      except Exception:
+        return False
+      return True
+
     for name in list(os.listdir(self.queue_dir)):
       path = os.path.join(self.queue_dir, name)
-      result = payload_ok(path)
-      if result is None or result[0]:
-        continue
+      parsed = seg_parse(name)
+      if parsed is not None:
+        ok = segment_ok(path, parsed[1])
+        if ok is None or ok:
+          continue
+      else:
+        result = payload_ok(path)
+        if result is None or result[0]:
+          continue
       problems["malformed_tasks"].append(name)
       if repair:
         quarantine(path, name)
@@ -521,9 +629,51 @@ class FileQueue:
       except FileNotFoundError:
         pass
 
+  # -- segment I/O ----------------------------------------------------------
+
+  def _write_file(self, dirpath: str, name: str, content: str):
+    """tmp-write + atomic rename with the same turd-free contract as
+    insert()/_write_meta."""
+    tmp = os.path.join(self.path, f".tmp-{uuid.uuid4().hex}")
+    try:
+      with open(tmp, "w") as f:
+        f.write(content)
+      os.replace(tmp, os.path.join(dirpath, name))
+    except BaseException:
+      try:
+        os.remove(tmp)
+      except FileNotFoundError:
+        pass
+      raise
+
+  @staticmethod
+  def _read_segment(path: str) -> List[Tuple[int, str]]:
+    """Segment file → [(task_index, payload)] (payloads are single-line
+    JSON, so one line per task). Raises FileNotFoundError on lease races
+    like every other read here; malformed lines raise ValueError for
+    fsck to catch."""
+    entries = []
+    with open(path) as f:
+      for line in f:
+        line = line.rstrip("\n")
+        if not line:
+          continue
+        idx, payload = line.split("\t", 1)
+        entries.append((int(idx), payload))
+    return entries
+
+  def _copy_meta(self, src_segid: str, dst_segid: str):
+    """Splits inherit the parent segment's attempt record, so per-task
+    DLQ attribution survives any number of lease splits."""
+    meta = self._read_meta(f"{SEG_PREFIX}{src_segid}")
+    if meta.get("deliveries") or meta.get("failures"):
+      self._write_meta(f"{SEG_PREFIX}{dst_segid}", meta)
+
   # -- producer -------------------------------------------------------------
 
   def insert(self, tasks: Iterable, total: Optional[int] = None):
+    """Classic one-file-per-task insert (kept verbatim for layout
+    compatibility; batched producers should call :meth:`insert_batch`)."""
     del total
     n = 0
     for task in self._iter(tasks):
@@ -542,6 +692,52 @@ class FileQueue:
         raise
       n += 1
     self._tally("insertions", n)
+    self._pending_cache = None
+    return n
+
+  def insert_batch(self, tasks: Iterable, total: Optional[int] = None):
+    """Batched wire protocol (ISSUE 15): tasks land in segment files of
+    up to ``IGNEOUS_QUEUE_SEG_TASKS`` tasks each — one append per
+    segment instead of one file + meta per task. ``total`` (when the
+    producer knows it, e.g. a regular grid's task count) sizes segments
+    so the batch spreads across ~``IGNEOUS_QUEUE_SHARDS`` files for
+    lease-contention spread; unknown totals stream at the per-segment
+    cap. ``IGNEOUS_QUEUE_SEG_TASKS=0`` falls back to the classic
+    per-task layout."""
+    from ..analysis import knobs
+
+    seg_cap = knobs.get_int("IGNEOUS_QUEUE_SEG_TASKS")
+    seg_cap = DEFAULT_SEG_TASKS if seg_cap is None else int(seg_cap)
+    if seg_cap <= 0:
+      return self.insert(tasks, total=total)
+    shards = knobs.get_int("IGNEOUS_QUEUE_SHARDS")
+    shards = max(int(shards or DEFAULT_QUEUE_SHARDS), 1)
+    if total:
+      seg_size = min(max(-(-int(total) // shards), 1), seg_cap)
+    else:
+      seg_size = seg_cap
+    base = self.inserted   # global task indices continue across batches
+    n = 0
+    chunk: List[Tuple[int, str]] = []
+
+    def flush():
+      nonlocal chunk
+      if chunk:
+        self._write_file(
+          self.queue_dir, seg_name(uuid.uuid4().hex, len(chunk)),
+          _seg_content(chunk),
+        )
+        chunk = []
+
+    for task in self._iter(tasks):
+      payload = task if isinstance(task, str) else serialize(task)
+      chunk.append((base + n, payload))
+      n += 1
+      if len(chunk) >= seg_size:
+        flush()
+    flush()
+    self._tally("insertions", n)
+    self._pending_cache = None
     return n
 
   insert_all = insert
@@ -550,52 +746,178 @@ class FileQueue:
 
   # -- consumer -------------------------------------------------------------
 
-  def _recycle_expired(self):
+  def _recycle_expired(self, force: bool = False) -> int:
+    """Return expired leases to rotation. Throttled to one lease-dir scan
+    per ``IGNEOUS_QUEUE_RECYCLE_SEC`` (0 = scan on every call) — the full
+    scan dominated small-task lease latency. ``force=True`` bypasses the
+    throttle (used when the pending pool looks drained, so an
+    emptied-but-expired queue never reads as done). Returns the number of
+    files returned to the pool."""
+    from ..analysis import knobs
+
     now = time.time()
+    if not force:
+      interval = knobs.get_float("IGNEOUS_QUEUE_RECYCLE_SEC")
+      interval = DEFAULT_RECYCLE_SEC if interval is None else float(interval)
+      if interval > 0 and now - self._last_recycle < interval:
+        return 0
+    self._last_recycle = now
+    n = 0
     for name in os.listdir(self.lease_dir):
       try:
         deadline = float(name.split(LEASE_SEP, 1)[0])
       except ValueError:
         continue
-      if deadline < now:
-        orig = name.split(LEASE_SEP, 1)[1]
-        src = os.path.join(self.lease_dir, name)
-        if self._exhausted(orig):
-          # the worker that held this lease died (or never acked): the
-          # lease expiring IS the failure signal for its final delivery
-          self._quarantine_to_dlq(
-            src, orig,
-            f"lease expired after delivery {self.delivery_count(orig)} "
-            f"(worker lost or task hung)",
-          )
-          continue
-        try:
-          os.rename(src, os.path.join(self.queue_dir, orig))
-        except FileNotFoundError:
-          pass  # another worker recycled it first
-
-  def lease(self, seconds: float = 600) -> Optional[Tuple[RegisteredTask, str]]:
-    """Returns (task, lease_id) or None if the queue is drained."""
-    self._recycle_expired()
-    for _ in range(10):  # bounded retries under contention
-      names = sorted(os.listdir(self.queue_dir))
-      if not names:
-        return None
-      name = random.choice(names[:CONTENTION_WINDOW])
-      deadline = time.time() + seconds
-      lease_name = f"{deadline:.3f}{LEASE_SEP}{name}"
-      src = os.path.join(self.queue_dir, name)
-      dst = os.path.join(self.lease_dir, lease_name)
+      if deadline >= now:
+        continue
+      orig = name.split(LEASE_SEP, 1)[1]
+      src = os.path.join(self.lease_dir, name)
+      if self._exhausted(orig):
+        # the worker that held this lease died (or never acked): the
+        # lease expiring IS the failure signal for its final delivery
+        reason = (
+          f"lease expired after delivery {self.delivery_count(orig)} "
+          f"(worker lost or task hung)"
+        )
+        parsed = seg_parse(orig)
+        if parsed:
+          self._expire_segment_to_dlq(src, parsed[0], reason)
+        else:
+          self._quarantine_to_dlq(src, orig, reason)
+        continue
       try:
-        os.rename(src, dst)
+        os.rename(src, os.path.join(self.queue_dir, orig))
       except FileNotFoundError:
-        continue  # lost the race; try another
+        continue  # another worker recycled it first
+      n += 1
+      if self._pending_cache is not None:
+        self._pending_cache.append(orig)
+    return n
+
+  def _expire_segment_to_dlq(self, src: str, segid: str, reason: str):
+    """A segment that exhausted its delivery budget quarantines
+    per-index: every surviving member becomes its own ``dlq/`` entry
+    (``task_<segid>_<idx>.json``) carrying the shared attempt record, so
+    `dlq ls|retry` keep their per-task granularity. Deterministic names
+    make a racing double-expansion idempotent; dlq files land before the
+    lease file is removed, so a crash mid-expansion re-runs cleanly."""
+    from .. import telemetry
+
+    try:
+      entries = self._read_segment(src)
+    except FileNotFoundError:
+      return  # another worker expanded it first
+    seg_meta = self._read_meta(f"{SEG_PREFIX}{segid}")
+    for idx, payload in entries:
+      name = f"task_{segid}_{idx}.json"
+      meta = self._read_meta(name)
+      meta["deliveries"] = max(
+        int(meta.get("deliveries", 0)), int(seg_meta.get("deliveries", 0))
+      )
+      meta["failures"] = (
+        seg_meta.get("failures", []) + meta.get("failures", [])
+      )[-MAX_RECORDED_FAILURES:]
+      self._write_meta(name, meta)
+      self._record_failure(name, reason)
+      self._write_file(self.dlq_dir, name, payload)
+      telemetry.incr("dlq.promoted")
+    try:
+      os.remove(src)
+    except FileNotFoundError:
+      pass
+    self._drop_meta(f"{SEG_PREFIX}{segid}")
+
+  def _pop_pending(self) -> Optional[str]:
+    """Pick a pending name from the cached per-shard index — the random-
+    within-window contention dodge of the classic lease(), without the
+    listdir+sort per acquisition. The cache is reverse-sorted so the
+    window sits at the tail for O(1) pops."""
+    cache = self._pending_cache
+    if not cache:
+      return None
+    window = min(len(cache), CONTENTION_WINDOW)
+    return cache.pop(len(cache) - 1 - random.randrange(window))
+
+  def _lease_one(self, name: str, seconds: float, cap: int):
+    """Acquire one pending file (rename = the mutex). A classic per-task
+    file leases whole; a segment leases as a :class:`RangeLease`, split
+    at ``cap`` members — the remainder returns to the pool under a new
+    segid (attempt meta copied) BEFORE the lease shrinks, so a crash
+    between duplicates deliveries but never loses tasks. Returns a list
+    of (task, token) pairs, or None when the rename race was lost."""
+    deadline = time.time() + seconds
+    lease_name = f"{deadline:.3f}{LEASE_SEP}{name}"
+    src = os.path.join(self.queue_dir, name)
+    dst = os.path.join(self.lease_dir, lease_name)
+    try:
+      os.rename(src, dst)
+    except FileNotFoundError:
+      return None  # lost the race; caller tries another
+    parsed = seg_parse(name)
+    if parsed is None:
       meta = self._read_meta(name)
       meta["deliveries"] = int(meta.get("deliveries", 0)) + 1
       self._write_meta(name, meta)
       with open(dst) as f:
-        return deserialize(f.read()), lease_name
-    return None
+        return [(deserialize(f.read()), lease_name)]
+    segid = parsed[0]
+    entries = self._read_segment(dst)
+    cap = max(int(cap), 1)
+    if len(entries) > cap:
+      keep, rest = entries[:cap], entries[cap:]
+      rest_segid = uuid.uuid4().hex
+      self._copy_meta(segid, rest_segid)
+      rest_name = seg_name(rest_segid, len(rest))
+      self._write_file(self.queue_dir, rest_name, _seg_content(rest))
+      if self._pending_cache is not None:
+        # next pop likely continues the contiguous run on this worker
+        self._pending_cache.append(rest_name)
+      lease_name_new = f"{deadline:.3f}{LEASE_SEP}{seg_name(segid, len(keep))}"
+      self._write_file(self.lease_dir, lease_name_new, _seg_content(keep))
+      try:
+        os.remove(dst)
+      except FileNotFoundError:
+        pass
+      lease_name, entries = lease_name_new, keep
+    meta = self._read_meta(f"{SEG_PREFIX}{segid}")
+    meta["deliveries"] = int(meta.get("deliveries", 0)) + 1
+    self._write_meta(f"{SEG_PREFIX}{segid}", meta)
+    rl = RangeLease(self, lease_name, segid, dict(entries), deadline)
+    return [(deserialize(p), RangeSub(rl, i)) for i, p in entries]
+
+  def lease_batch(self, seconds: float = 600, max_tasks: int = 1):
+    """Lease up to ``max_tasks`` tasks in one call. Segments come back as
+    range members — (task, :class:`RangeSub`) pairs sharing one
+    underlying lease — classic files as (task, lease_id) pairs; the two
+    mix freely in one result. Returns [] when the queue is drained."""
+    self._recycle_expired()
+    out: List[Tuple[RegisteredTask, object]] = []
+    refreshed = False
+    races = 0
+    while len(out) < max_tasks and races < 10:
+      name = self._pop_pending()
+      if name is None:
+        if refreshed:
+          break
+        # cache drained: force a recycle pass (the throttle must not make
+        # an emptied-but-expired queue look drained), then re-list once
+        self._recycle_expired(force=True)
+        self._pending_cache = sorted(os.listdir(self.queue_dir), reverse=True)
+        refreshed = True
+        continue
+      got = self._lease_one(name, seconds, max_tasks - len(out))
+      if got is None:
+        races += 1
+        continue
+      out.extend(got)
+    return out
+
+  def lease(self, seconds: float = 600) -> Optional[Tuple[RegisteredTask, str]]:
+    """Returns (task, lease_id) or None if the queue is drained. On a
+    segmented queue the single task splits off its segment, so solo
+    pollers interoperate with batch producers."""
+    got = self.lease_batch(seconds, max_tasks=1)
+    return got[0] if got else None
 
   def _lease_deadline(self, lease_id: str) -> Optional[float]:
     try:
@@ -603,17 +925,22 @@ class FileQueue:
     except ValueError:
       return None
 
-  def renew(self, lease_id: str, seconds: float = 600) -> str:
+  def renew(self, lease_id, seconds: float = 600):
     """Extend a held lease's visibility timeout (the fq:// analogue of
     SQS ChangeMessageVisibility) by re-timestamping the lease name.
     Returns the NEW lease token — the old one is dead; callers (normally
-    a LeaseHeartbeat) must use the returned token from here on.
+    a LeaseHeartbeat) must use the returned token from here on. A range
+    member renews its parent's ONE lease and returns the same handle:
+    RangeSub tokens are stable across renewals (rotation is internal).
 
     Zombie fencing: renewal is refused (StaleLeaseError + ``zombie.renew``
     counter) once the lease has expired or the task was re-issued — a
     stalled worker that wakes up cannot re-acquire what it lost."""
     from .. import telemetry
 
+    if isinstance(lease_id, RangeSub):
+      self._range_renew(lease_id.parent, seconds)
+      return lease_id
     deadline = self._lease_deadline(lease_id)
     orig = str(lease_id).split(LEASE_SEP, 1)[-1]
     if deadline is None or deadline < time.time():
@@ -634,14 +961,17 @@ class FileQueue:
       ) from None
     return new_id
 
-  def delete(self, lease_id: str) -> bool:
+  def delete(self, lease_id) -> bool:
     """Complete a task. Zombie-fenced: the delete (and its completion
     tally) only lands while the lease token is current — a worker that
     stalled past its lease and woke after the task was re-issued gets
     False + a ``zombie.delete`` counter instead of double-completing
-    (the acceptance invariant: completions tally == task count)."""
+    (the acceptance invariant: completions tally == task count). A range
+    member acks its sub-range: the parent lease shrinks by one index."""
     from .. import telemetry
 
+    if isinstance(lease_id, RangeSub):
+      return self._range_ack(lease_id.parent, lease_id.index)
     deadline = self._lease_deadline(lease_id)
     if deadline is not None and deadline < time.time():
       telemetry.incr("zombie.delete")
@@ -655,16 +985,21 @@ class FileQueue:
     self._tally("completions")
     return True
 
-  def nack(self, lease_id: str, reason: str = "", requeue: bool = False):
+  def nack(self, lease_id, reason: str = "", requeue: bool = False):
     """Record a failed delivery. The failure reason persists with the
     task's metadata; once ``max_deliveries`` is exhausted the task moves
     to ``dlq/``. Otherwise the lease is left to recycle on its visibility
     timeout (at-least-once semantics unchanged) unless ``requeue=True``
-    returns it to rotation immediately.
+    returns it to rotation immediately. A range member nack SPLITS the
+    lease: only the failed index retries (or dead-letters).
 
     A nack whose lease was already re-issued (or completed) is dropped
     with a ``zombie.nack`` counter — recording it would resurrect meta
     for a task this worker no longer owns."""
+    if isinstance(lease_id, RangeSub):
+      return self._range_nack(
+        lease_id.parent, lease_id.index, reason, requeue=requeue
+      )
     orig = lease_id.split(LEASE_SEP, 1)[-1]
     src = os.path.join(self.lease_dir, lease_id)
     if not os.path.exists(src):
@@ -679,7 +1014,11 @@ class FileQueue:
       if requeue:
         self.release(lease_id)
 
-  def release(self, lease_id: str):
+  def release(self, lease_id):
+    """Return a lease to rotation immediately (undelivered). A range
+    member releases just its index back as a fresh one-task segment."""
+    if isinstance(lease_id, RangeSub):
+      return self._range_release(lease_id.parent, [lease_id.index])
     orig = lease_id.split(LEASE_SEP, 1)[1]
     try:
       os.rename(
@@ -687,12 +1026,219 @@ class FileQueue:
         os.path.join(self.queue_dir, orig),
       )
     except FileNotFoundError:
-      pass
+      return
+    if self._pending_cache is not None:
+      self._pending_cache.append(orig)
 
   def release_all(self):
     for name in list(os.listdir(self.lease_dir)):
       if LEASE_SEP in name:
         self.release(name)
+    self._pending_cache = None
+
+  # -- batched completion ----------------------------------------------------
+
+  def ack_batch(self, tokens) -> List[bool]:
+    """Complete many tasks at once. Range members sharing a parent lease
+    collapse into ONE lease-file rewrite; classic tokens delete one by
+    one. Results align positionally with ``tokens`` (False = zombie-
+    fenced, exactly as the scalar ops report it)."""
+    tokens = list(tokens)
+    results = [False] * len(tokens)
+    by_parent: Dict[int, Tuple[RangeLease, List[Tuple[int, int]]]] = {}
+    for pos, tok in enumerate(tokens):
+      if isinstance(tok, RangeSub):
+        by_parent.setdefault(id(tok.parent), (tok.parent, []))[1].append(
+          (pos, tok.index)
+        )
+      else:
+        results[pos] = self.delete(tok)
+    for parent, members in by_parent.values():
+      acked = self._range_ack_many(parent, [i for _, i in members])
+      for pos, i in members:
+        results[pos] = bool(acked.get(int(i)))
+    return results
+
+  def nack_batch(self, tokens, reason: str = "", requeue: bool = False):
+    """Fail many deliveries with one call (per-token semantics identical
+    to scalar ``nack``: range members split, exhausted tasks DLQ)."""
+    for tok in tokens:
+      self.nack(tok, reason, requeue=requeue)
+
+  # -- range-lease mechanics (handles live in .ranges) -----------------------
+
+  def _range_rewrite(self, rl: RangeLease, new_entries: Dict[int, str],
+                     new_deadline: Optional[float] = None) -> bool:
+    """Swap the range's lease file for one holding ``new_entries``
+    (removed outright when empty). Write-new-then-remove-old: a crash in
+    between re-delivers, never loses. False = the old lease file was
+    gone (expired + re-issued, or completed elsewhere) — the new file is
+    withdrawn and the caller is a zombie for this range. Caller holds
+    ``rl.lock``."""
+    deadline = rl.deadline if new_deadline is None else float(new_deadline)
+    old = os.path.join(self.lease_dir, rl.token)
+    if not new_entries:
+      try:
+        os.remove(old)
+      except FileNotFoundError:
+        return False
+      rl.entries = {}
+      return True
+    new_token = f"{deadline:.3f}{LEASE_SEP}{seg_name(rl.segid, len(new_entries))}"
+    if new_token == rl.token:
+      rl.entries = dict(new_entries)
+      return True
+    self._write_file(
+      self.lease_dir, new_token, _seg_content(sorted(new_entries.items()))
+    )
+    try:
+      os.remove(old)
+    except FileNotFoundError:
+      try:
+        os.remove(os.path.join(self.lease_dir, new_token))
+      except FileNotFoundError:
+        pass
+      return False
+    rl.token = new_token
+    rl.entries = dict(new_entries)
+    rl.deadline = deadline
+    return True
+
+  def _range_ack_many(self, rl: RangeLease, indices) -> Dict[int, bool]:
+    """Complete several members of one range with a single rewrite."""
+    from .. import telemetry
+
+    todo = [int(i) for i in indices]
+    with rl.lock:
+      if rl.deadline < time.time():
+        telemetry.incr("zombie.delete", len(todo))
+        return {i: False for i in todo}
+      hit = sorted({i for i in todo if i in rl.entries})
+      miss = [i for i in todo if i not in rl.entries]
+      if miss:
+        telemetry.incr("zombie.delete", len(miss))
+      if not hit:
+        return {i: False for i in todo}
+      remaining = {i: p for i, p in rl.entries.items() if i not in set(hit)}
+      if not self._range_rewrite(rl, remaining):
+        telemetry.incr("zombie.delete", len(hit))
+        return {i: False for i in todo}
+      self._tally("completions", len(hit))
+      if not remaining:
+        self._drop_meta(f"{SEG_PREFIX}{rl.segid}")
+      hitset = set(hit)
+      return {i: i in hitset for i in todo}
+
+  def _range_ack(self, rl: RangeLease, index: int) -> bool:
+    return self._range_ack_many(rl, [index])[int(index)]
+
+  def _range_nack(self, rl: RangeLease, index: int, reason: str = "",
+                  requeue: bool = False):
+    """Mid-range failure: carve the failed index out as a classic
+    single-task lease (``task_<segid>_<idx>.json``) inheriting the
+    range's attempt record, shrink the range, then hand the carve to the
+    classic nack machinery — so reason recording, DLQ promotion, and
+    retry budgets apply to ONLY the failed index while the rest of the
+    range proceeds untouched."""
+    from .. import telemetry
+
+    index = int(index)
+    with rl.lock:
+      if rl.deadline < time.time() or index not in rl.entries:
+        telemetry.incr("zombie.nack")
+        return
+      carve = f"task_{rl.segid}_{index}.json"
+      seg_meta = self._read_meta(f"{SEG_PREFIX}{rl.segid}")
+      meta = self._read_meta(carve)
+      meta["deliveries"] = max(
+        int(meta.get("deliveries", 0)), int(seg_meta.get("deliveries", 0))
+      )
+      meta["failures"] = (
+        seg_meta.get("failures", []) + meta.get("failures", [])
+      )[-MAX_RECORDED_FAILURES:]
+      self._write_meta(carve, meta)
+      carve_lease = f"{rl.deadline:.3f}{LEASE_SEP}{carve}"
+      self._write_file(self.lease_dir, carve_lease, rl.entries[index])
+      remaining = {i: p for i, p in rl.entries.items() if i != index}
+      if not self._range_rewrite(rl, remaining):
+        # the whole range is being redelivered; withdraw the carve so the
+        # index isn't duplicated
+        try:
+          os.remove(os.path.join(self.lease_dir, carve_lease))
+        except FileNotFoundError:
+          pass
+        telemetry.incr("zombie.nack")
+        return
+    return self.nack(carve_lease, reason, requeue=requeue)
+
+  def _range_release(self, rl: RangeLease, indices=None) -> int:
+    """Return members (all surviving ones when ``indices`` is None) to
+    the pool immediately as a fresh segment under a new segid (attempt
+    meta copied, deliveries kept — matching classic release)."""
+    with rl.lock:
+      if indices is None:
+        chosen = sorted(rl.entries)
+      else:
+        chosen = sorted({int(i) for i in indices} & set(rl.entries))
+      if not chosen or rl.deadline < time.time():
+        return 0  # expired: the recycler owns these now
+      released = {i: rl.entries[i] for i in chosen}
+      new_segid = uuid.uuid4().hex
+      self._copy_meta(rl.segid, new_segid)
+      new_name = seg_name(new_segid, len(released))
+      self._write_file(
+        self.queue_dir, new_name, _seg_content(sorted(released.items()))
+      )
+      remaining = {i: p for i, p in rl.entries.items() if i not in set(chosen)}
+      if not self._range_rewrite(rl, remaining):
+        try:
+          os.remove(os.path.join(self.queue_dir, new_name))
+        except FileNotFoundError:
+          pass
+        return 0
+      if self._pending_cache is not None:
+        self._pending_cache.append(new_name)
+      return len(released)
+
+  def _range_renew(self, rl: RangeLease, seconds: float) -> str:
+    """Extend the range's ONE lease. Internally the token rotates (the
+    deadline rides in the file name) but RangeSub handles stay valid.
+    Freshness guard: when the deadline already covers ~the requested
+    extension, this is a no-op — K heartbeat-tracked members cost one
+    rename per beat, not K."""
+    from .. import telemetry
+
+    with rl.lock:
+      now = time.time()
+      if not rl.entries:
+        # fully completed: a heartbeat racing the final ack — not a zombie
+        raise StaleLeaseError(
+          f"range {rl.segid!r} fully completed; nothing left to renew"
+        )
+      if rl.deadline < now:
+        telemetry.incr("zombie.renew")
+        raise StaleLeaseError(
+          f"range lease {rl.segid!r} already expired; due for re-issue"
+        )
+      if rl.deadline >= now + float(seconds) * 0.9:
+        return rl.token
+      new_deadline = now + float(seconds)
+      new_token = (
+        f"{new_deadline:.3f}{LEASE_SEP}{seg_name(rl.segid, len(rl.entries))}"
+      )
+      try:
+        os.rename(
+          os.path.join(self.lease_dir, rl.token),
+          os.path.join(self.lease_dir, new_token),
+        )
+      except FileNotFoundError:
+        telemetry.incr("zombie.renew")
+        raise StaleLeaseError(
+          f"range lease {rl.segid!r} was re-issued by another worker"
+        ) from None
+      rl.token = new_token
+      rl.deadline = new_deadline
+      return rl.token
 
   def purge(self):
     for d in (self.queue_dir, self.lease_dir, self.dlq_dir, self.meta_dir):
@@ -701,6 +1247,7 @@ class FileQueue:
           os.remove(os.path.join(d, name))
         except FileNotFoundError:
           pass
+    self._pending_cache = None
     self.rezero()
 
   # -- worker loop ----------------------------------------------------------

@@ -233,6 +233,9 @@ class LocalTaskQueue:
       bar.close()
 
   insert_all = insert
+  # batched wire protocol (ISSUE 15): local execution has no wire, so the
+  # batch entry point IS the streaming insert
+  insert_batch = insert
 
   @staticmethod
   def _iter(tasks):
@@ -262,6 +265,7 @@ class MockTaskQueue:
       task.execute()
 
   insert_all = insert
+  insert_batch = insert
 
   def wait(self, *args, **kw):
     return self

@@ -29,23 +29,46 @@ def _require_filequeue(q, spec):
 
 
 def _snapshot_payloads(src, delete: bool):
-  """Yield pending payloads, tolerating workers leasing files mid-walk
-  (the same FileNotFoundError races lease()/release() absorb)."""
+  """Yield (name, [payloads]) per pending FILE — one payload for a
+  classic per-task file, every member payload for a segment — tolerating
+  workers leasing files mid-walk (the same FileNotFoundError races
+  lease()/release() absorb). With ``delete=True`` the file is removed
+  only after its payloads were yielded back to the consumer."""
   import os
+
+  from .filequeue import seg_parse
 
   for name in sorted(os.listdir(src.queue_dir)):
     path = os.path.join(src.queue_dir, name)
-    try:
-      with open(path) as f:
-        payload = f.read()
-    except FileNotFoundError:
-      continue  # a worker leased it between listing and reading
-    yield name, payload
+    if seg_parse(name) is not None:
+      try:
+        payloads = [p for _i, p in src._read_segment(path)]
+      except FileNotFoundError:
+        continue  # a worker leased it between listing and reading
+    else:
+      try:
+        with open(path) as f:
+          payloads = [f.read()]
+      except FileNotFoundError:
+        continue
+    yield name, payloads
     if delete:
       try:
         os.remove(path)
       except FileNotFoundError:
         pass
+
+
+def _batched_insert(dest, payloads) -> int:
+  ins = getattr(dest, "insert_batch", None)
+  if ins is None:
+    for p in payloads:
+      dest.insert(p)
+  else:
+    # no total= hint: a source segment moves as ONE dest segment instead
+    # of re-sharding per file
+    ins(payloads)
+  return len(payloads)
 
 
 def copy_queue(src_spec: str, dest_spec: str) -> int:
@@ -54,22 +77,20 @@ def copy_queue(src_spec: str, dest_spec: str) -> int:
   src = _require_filequeue(TaskQueue(src_spec), src_spec)
   dest = TaskQueue(dest_spec)
   n = 0
-  for _name, payload in _snapshot_payloads(src, delete=False):
-    dest.insert(payload)
-    n += 1
+  for _name, payloads in _snapshot_payloads(src, delete=False):
+    n += _batched_insert(dest, payloads)
   return n
 
 
 def move_queue(src_spec: str, dest_spec: str) -> int:
   """Move all pending tasks (`igneous queue mv`). Each file is deleted
-  only AFTER its copy lands, so tasks inserted concurrently are never
+  only AFTER its copies land, so tasks inserted concurrently are never
   dropped (they simply stay in the source)."""
   src = _require_filequeue(TaskQueue(src_spec), src_spec)
   dest = TaskQueue(dest_spec)
   n = 0
-  for _name, payload in _snapshot_payloads(src, delete=True):
-    dest.insert(payload)
-    n += 1
+  for _name, payloads in _snapshot_payloads(src, delete=True):
+    n += _batched_insert(dest, payloads)
   return n
 
 

@@ -28,6 +28,7 @@ from .registry import RegisteredTask, deserialize, serialize
 
 EMPTY_CONFIRMATION_SEC = 120.0  # reference cli.py:858-861
 EMPTY_SAMPLES = 3
+SQS_BATCH = 10  # hard AWS cap on entries per *Batch API call
 
 
 class FakeSQSTransport:
@@ -88,6 +89,26 @@ class FakeSQSTransport:
       return False
     self._visible_at[mid] = self._now() + timeout
     return True
+
+  # -- batch entry points (same shapes the boto3 transport exposes) ---------
+
+  def send_message_batch(self, bodies) -> list:
+    return [self.send_message(b) for b in bodies]
+
+  def receive_messages(self, max_messages: int, visibility_timeout: float):
+    out = []
+    for _ in range(int(max_messages)):
+      got = self.receive_message(visibility_timeout)
+      if got is None:
+        break
+      out.append(got)
+    return out
+
+  def delete_message_batch(self, receipts) -> list:
+    return [self.delete_message(r) for r in receipts]
+
+  def change_visibility_batch(self, receipts, timeout: float) -> list:
+    return [self.change_visibility(r, timeout) for r in receipts]
 
   def approximate_counts(self) -> Tuple[int, int]:
     now = self._now()
@@ -155,6 +176,80 @@ def _boto3_transport(spec: str):
         QueueUrl=url, ReceiptHandle=receipt, VisibilityTimeout=int(timeout)
       )
       return True
+
+    # -- batched wire protocol (ISSUE 15): one API call per <= 10 entries.
+    # Each *Batch response splits into Successful/Failed; Failed entries
+    # get ONE retry (SQS batch failures are routinely partial/transient)
+    # before erroring (sends) or reporting False (deletes/visibility).
+
+    def send_message_batch(self, bodies):
+      bodies = list(bodies)
+      out = []
+      for i in range(0, len(bodies), SQS_BATCH):
+        chunk = bodies[i:i + SQS_BATCH]
+        entries = [
+          {"Id": str(j), "MessageBody": b} for j, b in enumerate(chunk)
+        ]
+        resp = sqs.send_message_batch(QueueUrl=url, Entries=entries)
+        got = {e["Id"]: e["MessageId"] for e in resp.get("Successful", [])}
+        failed = [e["Id"] for e in resp.get("Failed", [])]
+        if failed:
+          resp = sqs.send_message_batch(QueueUrl=url, Entries=[
+            {"Id": fid, "MessageBody": chunk[int(fid)]} for fid in failed
+          ])
+          got.update(
+            {e["Id"]: e["MessageId"] for e in resp.get("Successful", [])}
+          )
+          still = [e["Id"] for e in resp.get("Failed", [])]
+          if still:
+            raise RuntimeError(
+              f"SendMessageBatch: {len(still)} entries failed after retry"
+            )
+        out.extend(got[str(j)] for j in range(len(chunk)))
+      return out
+
+    def receive_messages(self, max_messages, visibility_timeout):
+      resp = sqs.receive_message(
+        QueueUrl=url,
+        MaxNumberOfMessages=max(1, min(int(max_messages), SQS_BATCH)),
+        VisibilityTimeout=int(visibility_timeout), WaitTimeSeconds=1,
+        AttributeNames=["ApproximateReceiveCount"],
+      )
+      return [
+        (m["Body"], m["ReceiptHandle"], m.get("Attributes", {}))
+        for m in resp.get("Messages", [])
+      ]
+
+    def _receipt_batch(self, api, receipts, extra):
+      receipts = list(receipts)
+      ok = [False] * len(receipts)
+      for i in range(0, len(receipts), SQS_BATCH):
+        chunk = receipts[i:i + SQS_BATCH]
+        entries = [
+          {"Id": str(j), "ReceiptHandle": r, **extra}
+          for j, r in enumerate(chunk)
+        ]
+        resp = api(QueueUrl=url, Entries=entries)
+        failed = [e["Id"] for e in resp.get("Failed", [])]
+        if failed:
+          resp = api(QueueUrl=url, Entries=[
+            {"Id": fid, "ReceiptHandle": chunk[int(fid)], **extra}
+            for fid in failed
+          ])
+          failed = [e["Id"] for e in resp.get("Failed", [])]
+        bad = {int(fid) for fid in failed}
+        for j in range(len(chunk)):
+          ok[i + j] = j not in bad
+      return ok
+
+    def delete_message_batch(self, receipts):
+      return self._receipt_batch(sqs.delete_message_batch, receipts, {})
+
+    def change_visibility_batch(self, receipts, timeout):
+      return self._receipt_batch(
+        sqs.change_message_visibility_batch, receipts,
+        {"VisibilityTimeout": int(timeout)},
+      )
 
     def approximate_counts(self):
       attrs = sqs.get_queue_attributes(
@@ -267,22 +362,107 @@ class SQSQueue:
     self._inserted += n
     return n
 
+  def insert_batch(self, tasks: Iterable, total=None):
+    """Batched enqueue: SendMessageBatch at the 10-entry API cap — one
+    wire round-trip per 10 tasks instead of per task. Transports without
+    a batch entry point fall back to per-task sends."""
+    del total
+    send_batch = getattr(self.transport, "send_message_batch", None)
+    if send_batch is None:
+      return self.insert(tasks)
+    n = 0
+    chunk = []
+    for task in iter_tasks(tasks):
+      chunk.append(task if isinstance(task, str) else serialize(task))
+      if len(chunk) >= SQS_BATCH:
+        send_batch(chunk)
+        n += len(chunk)
+        chunk = []
+    if chunk:
+      send_batch(chunk)
+      n += len(chunk)
+    self._inserted += n
+    return n
+
+  def _admit(self, got):
+    """Shared receive gate: route exhausted redeliveries to the DLQ,
+    register the receipt->body mapping, deserialize. None = promoted."""
+    body, receipt = got[0], got[1]
+    attrs = got[2] if len(got) > 2 else {}
+    count = int(attrs.get("ApproximateReceiveCount", 0) or 0)
+    self.last_receive_count = count
+    if self.max_deliveries is not None and count > self.max_deliveries:
+      # redelivery budget exhausted BEFORE this delivery: quarantine
+      # instead of handing a poison task to yet another worker
+      self._promote_to_dlq(body, receipt, count)
+      return None
+    self._receipt_body[receipt] = body
+    return deserialize(body), receipt
+
   def lease(self, seconds: float = 600):
     while True:
       got = self.transport.receive_message(seconds)
       if got is None:
         return None
-      body, receipt = got[0], got[1]
-      attrs = got[2] if len(got) > 2 else {}
-      count = int(attrs.get("ApproximateReceiveCount", 0) or 0)
-      self.last_receive_count = count
-      if self.max_deliveries is not None and count > self.max_deliveries:
-        # redelivery budget exhausted BEFORE this delivery: quarantine
-        # instead of handing a poison task to yet another worker
-        self._promote_to_dlq(body, receipt, count)
-        continue
-      self._receipt_body[receipt] = body
-      return deserialize(body), receipt
+      admitted = self._admit(got)
+      if admitted is not None:
+        return admitted
+
+  def lease_batch(self, seconds: float = 600, max_tasks: int = 1):
+    """Lease up to ``max_tasks`` in ReceiveMessage batches of 10.
+    Returns a list of (task, receipt) pairs — [] when drained."""
+    recv = getattr(self.transport, "receive_messages", None)
+    out = []
+    while len(out) < max_tasks:
+      want = max_tasks - len(out)
+      if recv is not None:
+        batch = recv(min(want, SQS_BATCH), seconds)
+      else:
+        got = self.transport.receive_message(seconds)
+        batch = [] if got is None else [got]
+      if not batch:
+        break
+      for got in batch:
+        admitted = self._admit(got)
+        if admitted is not None:
+          out.append(admitted)
+    return out
+
+  def ack_batch(self, tokens):
+    """Complete many tasks via DeleteMessageBatch. Results align with
+    ``tokens``; False = stale receipt (zombie-fenced, not a completion)."""
+    from .. import telemetry
+
+    tokens = list(tokens)
+    del_batch = getattr(self.transport, "delete_message_batch", None)
+    if del_batch is None:
+      return [self.delete(t) for t in tokens]
+    for t in tokens:
+      body = self._receipt_body.pop(t, None)
+      if body is not None:
+        self._failure_reasons.pop(body, None)
+    results = [bool(r) for r in del_batch(tokens)]
+    ok = sum(results)
+    self._completed += ok
+    if ok < len(results):
+      telemetry.incr("zombie.delete", len(results) - ok)
+    return results
+
+  def nack_batch(self, tokens, reason: str = "", requeue: bool = False):
+    """Record many failed deliveries; with ``requeue=True`` the messages
+    return to visibility via ChangeMessageVisibilityBatch(0)."""
+    tokens = list(tokens)
+    for t in tokens:
+      body = self._receipt_body.pop(t, None)
+      if body is not None:
+        self._failure_reasons[body] = str(reason)[:2000]
+    if requeue:
+      cvb = getattr(self.transport, "change_visibility_batch", None)
+      if cvb is None:
+        for t in tokens:
+          self.release(t)
+      else:
+        cvb(tokens, 0)
 
   def _promote_to_dlq(self, body: str, receipt: str, count: int):
     from .. import telemetry

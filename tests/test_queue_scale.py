@@ -1,0 +1,342 @@
+"""Queue scale-out (ISSUE 15): batched wire protocol + range leases.
+
+The edge semantics the design rides on: mid-range failure splits the
+lease so only the failed index retries/dead-letters, heartbeat renewal
+stays valid for the surviving sub-range, zombie fencing rejects acks on
+expired range tokens, and classic per-task layouts keep working next to
+segments in the same queue directory.
+"""
+
+import os
+import time
+
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.queues import (
+  FileQueue,
+  PrintTask,
+  RangeSub,
+  StaleLeaseError,
+  TaskQueue,
+  copy_queue,
+  move_queue,
+  serialize,
+)
+from igneous_tpu.queues.filequeue import seg_name, seg_parse
+from igneous_tpu.tasks import TouchFileTask
+
+
+@pytest.fixture(autouse=True)
+def _fast_recycle(monkeypatch):
+  """Default the recycle throttle off so expiry-timing tests are exact;
+  the throttle itself is tested explicitly below."""
+  monkeypatch.setenv("IGNEOUS_QUEUE_RECYCLE_SEC", "0")
+
+
+def make_queue(tmp_path, n=0, total=None, max_deliveries=None, **env):
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=max_deliveries)
+  if n:
+    q.insert_batch([PrintTask(f"t{i}") for i in range(n)], total=total)
+  return q
+
+
+# -- segment layout ----------------------------------------------------------
+
+def test_insert_batch_shards_by_total(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_QUEUE_SHARDS", "8")
+  q = make_queue(tmp_path, n=64, total=64)
+  # ceil(64/8) = 8 tasks per segment -> 8 control-plane files for 64 tasks
+  assert q.queue_files == 8
+  assert q.enqueued == 64
+  assert q.inserted == 64
+  names = os.listdir(q.queue_dir)
+  assert all(seg_parse(n) is not None for n in names)
+  # the count rides in the name: depth never opens segment files
+  assert sum(seg_parse(n)[1] for n in names) == 64
+
+
+def test_insert_batch_without_total_uses_cap(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_QUEUE_SEG_TASKS", "10")
+  q = make_queue(tmp_path, n=25)
+  assert q.queue_files == 3  # 10 + 10 + 5
+  assert q.enqueued == 25
+
+
+def test_seg_tasks_zero_falls_back_to_classic(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_QUEUE_SEG_TASKS", "0")
+  q = make_queue(tmp_path, n=5, total=5)
+  assert q.queue_files == 5
+  assert all(seg_parse(n) is None for n in os.listdir(q.queue_dir))
+
+
+def test_global_indices_continue_across_batches(tmp_path):
+  q = make_queue(tmp_path, n=6)
+  q.insert_batch([PrintTask("late")], total=None)
+  indices = set()
+  for name in os.listdir(q.queue_dir):
+    for i, _p in q._read_segment(os.path.join(q.queue_dir, name)):
+      indices.add(i)
+  assert indices == set(range(7))
+
+
+# -- range lease lifecycle ---------------------------------------------------
+
+def test_lease_batch_returns_shared_range(tmp_path):
+  q = make_queue(tmp_path, n=8)   # no total: one 8-task segment
+  got = q.lease_batch(60, max_tasks=8)
+  assert len(got) == 8
+  toks = [tok for _t, tok in got]
+  assert all(isinstance(t, RangeSub) for t in toks)
+  assert len({id(t.parent) for t in toks}) == 1  # ONE lease file
+  assert len(os.listdir(q.lease_dir)) == 1
+  assert q.ack_batch(toks) == [True] * 8
+  assert q.completed == 8
+  assert q.is_empty()
+  assert os.listdir(q.meta_dir) == []  # drained range drops its meta
+
+
+def test_lease_split_at_cap(tmp_path):
+  q = make_queue(tmp_path, n=10)
+  got = q.lease_batch(60, max_tasks=4)
+  assert len(got) == 4
+  # remainder returned to the pool under a new segid, leasable next
+  assert q.enqueued == 10
+  assert q.leased == 4
+  rest = q.lease_batch(60, max_tasks=10)
+  assert len(rest) == 6
+  assert {id(t.parent) for _x, t in got}.isdisjoint(
+    {id(t.parent) for _x, t in rest}
+  )
+  q.ack_batch([t for _x, t in got] + [t for _x, t in rest])
+  assert q.completed == 10 and q.is_empty()
+
+
+def test_partial_ack_shrinks_lease(tmp_path):
+  q = make_queue(tmp_path, n=6)
+  got = q.lease_batch(60, max_tasks=6)
+  toks = [tok for _t, tok in got]
+  assert q.ack_batch(toks[:2]) == [True, True]
+  assert q.completed == 2
+  assert q.leased == 4    # lease file name now carries the shrunk count
+  assert q.enqueued == 4
+  # double-ack of a completed member is fenced, not double-tallied
+  telemetry.reset_counters()
+  assert q.delete(toks[0]) is False
+  assert telemetry.counters_snapshot().get("zombie.delete", 0) == 1
+  assert q.completed == 2
+  assert all(q.ack_batch(toks[2:]))
+  assert q.completed == 6
+
+
+def test_mid_range_failure_dead_letters_only_failed_index(tmp_path):
+  q = make_queue(tmp_path, n=5, max_deliveries=1)
+  got = q.lease_batch(60, max_tasks=5)
+  victim = got[2][1]
+  survivors = [tok for _t, tok in got if tok is not victim]
+  q.nack(victim, "boom: index 2 only")
+  # only the carved index dead-letters; the rest of the range is intact
+  assert q.dlq_count == 1
+  (entry,) = q.dlq_ls()
+  assert entry["name"] == f"task_{victim.parent.segid}_{victim.index}.json"
+  assert "boom: index 2 only" in str(entry["failures"])
+  assert entry["deliveries"] >= 1
+  assert all(q.ack_batch(survivors))
+  assert q.completed == 4
+  assert q.enqueued == 0
+
+
+def test_carved_task_retries_as_classic(tmp_path):
+  q = make_queue(tmp_path, n=4, max_deliveries=3)
+  got = q.lease_batch(60, max_tasks=4)
+  victim = got[0][1]
+  q.nack(victim, "first failure", requeue=True)
+  assert all(q.ack_batch([tok for _t, tok in got[1:]]))
+  # the failed index is back in rotation as a classic one-task file
+  leased = q.lease(60)
+  assert leased is not None
+  task, lid = leased
+  assert isinstance(lid, str)
+  assert q.delivery_count(lid) >= 2  # range delivery + this one
+  assert q.delete(lid) is True
+  assert q.completed == 4 and q.is_empty()
+
+
+def test_range_release_requeues_rest(tmp_path):
+  q = make_queue(tmp_path, n=6)
+  got = q.lease_batch(60, max_tasks=6)
+  toks = [tok for _t, tok in got]
+  assert all(q.ack_batch(toks[:2]))
+  q.release(toks[2])              # one member back solo
+  assert q.enqueued == 4 and q.leased == 3
+  for tok in toks[3:]:            # remaining members released via parent
+    q.release(tok)
+  assert q.leased == 0
+  assert q.enqueued == 4
+  # the released work is leasable and completable
+  rest = q.lease_batch(60, max_tasks=10)
+  assert len(rest) == 4
+  assert all(q.ack_batch([tok for _t, tok in rest]))
+  assert q.completed == 6
+
+
+# -- heartbeat renewal + zombie fencing --------------------------------------
+
+def test_renew_valid_for_surviving_subrange(tmp_path):
+  q = make_queue(tmp_path, n=5)
+  got = q.lease_batch(seconds=2, max_tasks=5)
+  toks = [tok for _t, tok in got]
+  parent = toks[0].parent
+  assert all(q.ack_batch(toks[:3]))
+  old_deadline = parent.deadline
+  # renew through a surviving member: parent's ONE lease rotates, the
+  # member handle stays the same token (heartbeat contract)
+  assert q.renew(toks[3], 60) is toks[3]
+  assert parent.deadline > old_deadline
+  # freshness guard: an immediate second renew is a no-op rename-wise
+  tok_before = parent.token
+  q.renew(toks[4], 60)
+  assert parent.token == tok_before
+  assert all(q.ack_batch(toks[3:]))
+  assert q.completed == 5 and q.is_empty()
+
+
+def test_expired_range_token_is_fenced(tmp_path):
+  q = make_queue(tmp_path, n=3)
+  got = q.lease_batch(seconds=0.05, max_tasks=3)
+  toks = [tok for _t, tok in got]
+  time.sleep(0.1)
+  telemetry.reset_counters()
+  assert q.ack_batch(toks) == [False, False, False]
+  assert telemetry.counters_snapshot().get("zombie.delete", 0) == 3
+  with pytest.raises(StaleLeaseError):
+    q.renew(toks[0], 60)
+  assert telemetry.counters_snapshot().get("zombie.renew", 0) == 1
+  assert q.completed == 0
+  # the expired range recycles whole and completes under a fresh lease
+  fresh = q.lease_batch(60, max_tasks=3)
+  assert len(fresh) == 3
+  assert all(q.ack_batch([tok for _t, tok in fresh]))
+  assert q.completed == 3
+
+
+def test_exhausted_segment_expands_to_per_task_dlq(tmp_path):
+  q = make_queue(tmp_path, n=3, max_deliveries=1)
+  got = q.lease_batch(seconds=0.05, max_tasks=3)
+  segid = got[0][1].parent.segid
+  time.sleep(0.1)
+  assert q.lease_batch(60, max_tasks=3) == []
+  # each surviving index got its own dlq entry with the shared record
+  assert q.dlq_count == 3
+  names = {e["name"] for e in q.dlq_ls()}
+  assert names == {f"task_{segid}_{i}.json" for i in range(3)}
+  assert all(e["deliveries"] >= 1 for e in q.dlq_ls())
+  # dlq retry grants fresh budgets and the tasks complete as classics
+  assert q.dlq_retry() == 3
+  done = 0
+  while (leased := q.lease(60)) is not None:
+    assert q.delete(leased[1])
+    done += 1
+  assert done == 3 and q.completed == 3
+
+
+# -- recycle throttle --------------------------------------------------------
+
+def test_recycle_scan_is_throttled(tmp_path, monkeypatch):
+  monkeypatch.setenv("IGNEOUS_QUEUE_RECYCLE_SEC", "3600")
+  q = make_queue(tmp_path, n=2)
+  q._recycle_expired()               # consumes the interval budget
+  got = q.lease_batch(seconds=0.05, max_tasks=2)
+  time.sleep(0.1)
+  assert q._recycle_expired() == 0   # throttled: no scan, nothing moves
+  assert q.leased == 2
+  # but a drained-looking pool forces the scan (force=True bypass), so
+  # an emptied-but-expired queue never reads as done
+  fresh = q.lease_batch(60, max_tasks=2)
+  assert len(fresh) == 2
+  assert all(q.ack_batch([tok for _t, tok in fresh]))
+
+
+# -- legacy layout compatibility ---------------------------------------------
+
+def test_classic_and_segment_files_coexist(tmp_path):
+  q = make_queue(tmp_path, n=4)
+  q.insert([PrintTask("classic-a"), PrintTask("classic-b")])
+  assert q.enqueued == 6
+  seen_classic = seen_range = 0
+  while (got := q.lease_batch(60, max_tasks=3)):
+    for _task, tok in got:
+      if isinstance(tok, RangeSub):
+        seen_range += 1
+      else:
+        seen_classic += 1
+    assert all(q.ack_batch([tok for _t, tok in got]))
+  assert (seen_classic, seen_range) == (2, 4)
+  assert q.completed == 6 and q.is_empty()
+  assert q.fsck()["counter_drift"] == 0
+
+
+def test_poll_loop_drains_segmented_queue(tmp_path):
+  q = make_queue(tmp_path)
+  paths = [str(tmp_path / "out" / f"t{i}") for i in range(12)]
+  q.insert_batch([TouchFileTask(path=p) for p in paths], total=12)
+  executed = q.poll(
+    lease_seconds=30,
+    stop_fn=lambda executed, empty: empty,
+    heartbeat_seconds=0,
+  )
+  assert executed == 12
+  assert all(os.path.exists(p) for p in paths)
+  assert q.completed == 12 and q.is_empty()
+
+
+def test_copy_and_move_preserve_segments(tmp_path):
+  src = make_queue(tmp_path, n=9, total=9)
+  dst_spec = f"fq://{tmp_path}/copy"
+  assert copy_queue(f"fq://{tmp_path}/q", dst_spec) == 9
+  dst = TaskQueue(dst_spec)
+  assert dst.enqueued == 9
+  mv_spec = f"fq://{tmp_path}/moved"
+  assert move_queue(dst_spec, mv_spec) == 9
+  moved = TaskQueue(mv_spec)
+  assert moved.enqueued == 9 and dst.enqueued == 0
+  got = moved.lease_batch(60, max_tasks=9)
+  assert len(got) == 9
+
+
+def test_fsck_validates_segment_counts(tmp_path):
+  q = make_queue(tmp_path, n=4)
+  (name,) = os.listdir(q.queue_dir)
+  segid, _count = seg_parse(name)
+  # lie about the count: depth reads trust the name, fsck must catch it
+  os.rename(
+    os.path.join(q.queue_dir, name),
+    os.path.join(q.queue_dir, seg_name(segid, 9)),
+  )
+  report = q.fsck(repair=True)
+  assert seg_name(segid, 9) in report["malformed_tasks"]
+  assert q.queue_files == 0
+  assert os.path.exists(os.path.join(q.path, "quarantine", seg_name(segid, 9)))
+
+
+# -- producer plumbing -------------------------------------------------------
+
+def test_insert_batch_accepts_raw_payloads(tmp_path):
+  q = FileQueue(f"fq://{tmp_path}/q")
+  q.insert_batch([serialize(PrintTask("pre-serialized")), PrintTask("obj")])
+  got = q.lease_batch(60, max_tasks=2)
+  assert len(got) == 2
+
+
+def test_grid_iterator_num_pending_matches_slice():
+  from igneous_tpu.lib import Bbox
+  from igneous_tpu.task_creation.common import GridTaskIterator
+
+  it = GridTaskIterator(
+    Bbox((0, 0, 0), (256, 256, 64)), (64, 64, 64), lambda s, o: (s, o)
+  )
+  assert it.num_pending() == len(it) == 16
+  sliced = it[4:10]
+  assert len(sliced) == 16        # __getitem__ still resolves full-grid
+  assert sliced.num_pending() == 6
+  assert len(list(sliced)) == 6

@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Queue scale-out smoke (ISSUE 15): batched wire protocol + range leases.
+
+CI acceptance in three acts:
+
+1. **scale** — enqueue a 100k-task campaign through the batched wire
+   protocol and gate the rate at >= 20k tasks/s AND >= 10x the classic
+   one-file-per-task baseline; `igneous queue status` must answer from
+   O(shards) control-plane files (counted, capped) in bounded wall time
+   without listing per-task objects;
+2. **chaos** — the same downsample campaign run classic-per-task vs
+   range-leased under a stale-lease storm (leases expire mid-flight,
+   zombie acks fenced) plus a preempt-style drain (one member acked, one
+   nacked, the rest released mid-range): output bytes identical,
+   completions tally == task count, DLQ empty;
+3. **sim** — mine the range-leased campaign's journal (range_sizes must
+   be present), re-simulate it with `IGNEOUS_SIM_RANGE_LEASE` semantics,
+   and require the predicted completion time within +/-20% of the
+   measured wall-clock, bit-identical across same-seed reruns.
+
+Writes queue-report.json next to the CWD for the CI artifact upload.
+Exit 0 = all gates passed.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+from click.testing import CliRunner  # noqa: E402
+
+from igneous_tpu import task_creation as tc  # noqa: E402
+from igneous_tpu.analysis import discovery  # noqa: E402
+from igneous_tpu.cli import main as cli_main  # noqa: E402
+from igneous_tpu.observability import replay, sim  # noqa: E402
+from igneous_tpu.queues import (  # noqa: E402
+  FileQueue,
+  PrintTask,
+  TaskQueue,
+  serialize,
+)
+from igneous_tpu.tasks import SleepTask  # noqa: E402
+from igneous_tpu.volume import Volume  # noqa: E402
+
+SCALE_TASKS = 100_000
+ENQUEUE_RATE_GATE = 20_000       # tasks/s, absolute floor
+SPEEDUP_GATE = 10.0              # vs the classic per-task layout
+BASELINE_TASKS = 2_000
+STATUS_WALL_SEC = 2.0            # `queue status` on the 100k queue
+QUEUE_FILES_CAP = 256            # control-plane objects for 100k tasks
+SEED = 1234
+SIM_TASKS = 48
+SIM_SLEEP_SEC = 0.02
+SIM_BATCH = 4
+TOLERANCE = 0.20
+
+report = {"gates": {}, "ok": False}
+failures = []
+
+
+def gate(name, ok, **detail):
+  report["gates"][name] = {"ok": bool(ok), **detail}
+  status = "PASS" if ok else "FAIL"
+  print(f"[queue_smoke] {status} {name}: {detail}")
+  if not ok:
+    failures.append(name)
+
+
+def journal_digest(path):
+  h = hashlib.sha256()
+  for full in discovery.walk_files(path):
+    h.update(os.path.basename(full).encode())
+    with open(full, "rb") as f:
+      h.update(f.read())
+  return h.hexdigest()
+
+
+def layer_bytes(root):
+  """Chunk/info objects under a layer dir (provenance excluded: it embeds
+  wall-clock dates by design; in-flight .tmp.* atomic-write files too)."""
+  out = {}
+  for full in discovery.walk_files(root):
+    if ".tmp." in os.path.basename(full):
+      continue
+    rel = os.path.relpath(full, root)
+    if rel.startswith("provenance"):
+      continue
+    with open(full, "rb") as f:
+      out[rel] = f.read()
+  return out
+
+
+def drain(queue):
+  def stop(executed, empty):
+    return empty and queue.enqueued == 0
+
+  return queue.poll(lease_seconds=30, stop_fn=stop, verbose=False,
+                    max_backoff_window=0.2)
+
+
+def act_scale(workdir, runner):
+  """100k-task enqueue rate + O(shards) status reads."""
+  payload = serialize(PrintTask("scale"))
+
+  base_q = FileQueue(f"fq://{workdir}/baseline")
+  t0 = time.monotonic()
+  base_q.insert(payload for _ in range(BASELINE_TASKS))
+  base_rate = BASELINE_TASKS / max(time.monotonic() - t0, 1e-9)
+
+  qspec = f"fq://{workdir}/scale"
+  t0 = time.monotonic()
+  TaskQueue(qspec).insert_batch(
+    (payload for _ in range(SCALE_TASKS)), total=SCALE_TASKS,
+  )
+  batch_rate = SCALE_TASKS / max(time.monotonic() - t0, 1e-9)
+
+  speedup = batch_rate / max(base_rate, 1e-9)
+  gate("enqueue_rate",
+       batch_rate >= ENQUEUE_RATE_GATE and speedup >= SPEEDUP_GATE,
+       batch_tasks_per_sec=round(batch_rate),
+       classic_tasks_per_sec=round(base_rate),
+       speedup=round(speedup, 1),
+       gates={"abs": ENQUEUE_RATE_GATE, "speedup": SPEEDUP_GATE})
+
+  q = TaskQueue(qspec)
+  t0 = time.monotonic()
+  res = runner.invoke(cli_main, ["queue", "status", qspec])
+  status_wall = time.monotonic() - t0
+  gate("status_o_shards",
+       res.exit_code == 0
+       and status_wall <= STATUS_WALL_SEC
+       and q.queue_files <= QUEUE_FILES_CAP
+       and q.enqueued == SCALE_TASKS
+       and f"enqueued: {SCALE_TASKS}" in res.output,
+       exit_code=res.exit_code, wall_sec=round(status_wall, 3),
+       queue_files=q.queue_files, tasks=SCALE_TASKS)
+  if res.exit_code != 0:
+    print(res.output[-2000:])
+
+
+def act_chaos(workdir, runner):
+  """Classic vs range-leased campaign under a stale-lease storm +
+  preempt-style drain: byte-identical output, exact completions tally."""
+  rng = np.random.default_rng(SEED)
+  img = rng.integers(0, 255, (160, 160, 64)).astype(np.uint8)
+
+  def make_tasks(layer):
+    # fans out to an 18-task grid at this memory target
+    return list(tc.create_downsampling_tasks(
+      layer, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
+    ))
+
+  # clean reference: classic one-file-per-task layout, solo leases
+  classic_dir = os.path.join(workdir, "classic")
+  classic_layer = f"file://{classic_dir}/layer"
+  Volume.from_numpy(img, classic_layer, chunk_size=(32, 32, 32),
+                    compress="gzip")
+  cq = FileQueue(f"fq://{classic_dir}/q", max_deliveries=25)
+  n_tasks = cq.insert(make_tasks(classic_layer))
+  drain(cq)
+  assert cq.is_empty() and cq.dlq_count == 0
+  clean = layer_bytes(os.path.join(classic_dir, "layer"))
+
+  # range-leased run, stormed
+  range_dir = os.path.join(workdir, "ranged")
+  range_layer = f"file://{range_dir}/layer"
+  Volume.from_numpy(img, range_layer, chunk_size=(32, 32, 32),
+                    compress="gzip")
+  rq = FileQueue(f"fq://{range_dir}/q", max_deliveries=25)
+  assert rq.insert_batch(make_tasks(range_layer)) == n_tasks
+
+  # stale-lease storm: a worker leases a range, does SOME of the work,
+  # then stalls past its lease — every late ack must be fenced
+  doomed = rq.lease_batch(seconds=0.2, max_tasks=6)
+  for task, _tok in doomed[:2]:
+    task.execute()     # work done but never acked: at-least-once re-runs it
+  time.sleep(0.3)
+  fenced = rq.ack_batch([tok for _t, tok in doomed])
+  gate("stale_lease_storm", len(doomed) == 6 and not any(fenced),
+       leased=len(doomed), fenced_acks=sum(not ok for ok in fenced))
+
+  # preempt-style drain mid-range: one member completes, one fails and
+  # is requeued solo, the rest release back to the pool undelivered
+  got = rq.lease_batch(seconds=60, max_tasks=6)
+  task, tok = got[0]
+  task.execute()
+  acked = rq.delete(tok)
+  rq.nack(got[1][1], "chaos: injected mid-range failure", requeue=True)
+  for _t, tok in got[2:]:
+    rq.release(tok)
+  # the manipulated range is fully relinquished; the only lease left in
+  # the dir is the expired storm lease awaiting recycle
+  gate("preempt_drain",
+       acked and len(got[0][1].parent) == 0 and rq.leased == len(doomed),
+       acked=acked, range_left=len(got[0][1].parent),
+       awaiting_recycle=rq.leased)
+
+  # drain the survivors through the real batched worker loop
+  res = runner.invoke(cli_main, [
+    "execute", f"fq://{range_dir}/q", "-x", "--quiet",
+    "--batch", str(SIM_BATCH),
+  ])
+  stormed = layer_bytes(os.path.join(range_dir, "layer"))
+  gate("chaos_byte_identity",
+       res.exit_code == 0 and stormed == clean,
+       exit_code=res.exit_code, tasks=n_tasks,
+       files=len(stormed), mismatched=sorted(
+         k for k in set(clean) | set(stormed)
+         if clean.get(k) != stormed.get(k))[:5])
+  gate("completions_exact",
+       rq.is_empty() and rq.completed == n_tasks and rq.dlq_count == 0,
+       completed=rq.completed, tasks=n_tasks, dlq=rq.dlq_count)
+  if res.exit_code != 0:
+    print(res.output[-2000:])
+
+
+def act_sim(workdir, runner):
+  """Range-lease journal mines range_sizes; range-mode simulation lands
+  within the sim-smoke tolerance of the measured wall-clock."""
+  qpath = os.path.join(workdir, "simcampaign")
+  qspec = f"fq://{qpath}"
+  TaskQueue(qspec).insert_batch(
+    [SleepTask(seconds=SIM_SLEEP_SEC) for _ in range(SIM_TASKS)],
+  )
+  t0 = time.monotonic()
+  res = runner.invoke(cli_main, [
+    "execute", qspec, "-x", "--quiet", "--batch", str(SIM_BATCH),
+  ])
+  actual_sec = time.monotonic() - t0
+  if res.exit_code != 0:
+    print(res.output[-2000:])
+    gate("range_campaign", False, exit_code=res.exit_code)
+    return
+
+  model = replay.mine_journal(f"file://{qpath}/journal")
+  gate("range_mining",
+       model.total_tasks() >= SIM_TASKS
+       and len(model.range_sizes) > 0
+       and max(model.range_sizes) >= 2,
+       tasks_mined=model.total_tasks(),
+       range_rounds=len(model.range_sizes),
+       sizes=sorted(set(model.range_sizes)))
+  # range_sizes survive the model's serialization round-trip
+  rt = replay.WorkloadModel.from_dict(
+    json.loads(json.dumps(model.to_dict()))
+  )
+  gate("model_roundtrip", rt.range_sizes == model.range_sizes,
+       n=len(rt.range_sizes))
+
+  def run_sim(outdir):
+    cfg = sim.SimConfig(
+      workers=1, seed=SEED, batch_size=SIM_BATCH, poll_sec=0.5,
+      range_lease=1,
+    )
+    s = sim.FleetSimulator(model, cfg)
+    results = s.run()
+    s.write_journal(f"file://{outdir}")
+    return results
+
+  sim_a = os.path.join(workdir, "sim_a")
+  sim_b = os.path.join(workdir, "sim_b")
+  ra = run_sim(sim_a)
+  rb = run_sim(sim_b)
+  err = abs(ra["makespan_sec"] - actual_sec) / actual_sec
+  gate("range_sim_prediction", err <= TOLERANCE,
+       predicted_sec=ra["makespan_sec"], actual_sec=round(actual_sec, 3),
+       relative_error=round(err, 4), tolerance=TOLERANCE,
+       range_rounds=ra["range_rounds"])
+  gate("range_sim_determinism",
+       ra == rb and ra["range_rounds"] > 0
+       and journal_digest(sim_a) == journal_digest(sim_b),
+       digest=journal_digest(sim_a)[:16])
+  report["forecast"] = ra
+
+
+def main():
+  workdir = tempfile.mkdtemp(prefix="queue_smoke_")
+  runner = CliRunner()
+  try:
+    act_scale(workdir, runner)
+    act_chaos(workdir, runner)
+    act_sim(workdir, runner)
+  finally:
+    report["ok"] = not failures
+    with open("queue-report.json", "w") as f:
+      json.dump(report, f, indent=2)
+    shutil.rmtree(workdir, ignore_errors=True)
+  if failures:
+    print(f"[queue_smoke] FAILED gates: {failures}")
+    return 1
+  print("[queue_smoke] all gates passed")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())
