@@ -357,6 +357,42 @@ def bench_trace_overhead(img, seg):
   )
 
 
+def bench_integrity_overhead(img, seg):
+  """integrity_overhead_pct — ISSUE 16 acceptance: the checksummed
+  write envelope (blake2b digest per put + batched manifest flushes)
+  must cost <=5% of e2e_pipeline wall time on the clean path. Same
+  interleaved-pair methodology as bench_trace_overhead: back-to-back
+  off/on runs, median of paired ratios."""
+  from igneous_tpu import integrity
+
+  prev = knobs.raw("IGNEOUS_INTEGRITY")
+
+  def restore():
+    if prev is None:
+      os.environ.pop("IGNEOUS_INTEGRITY", None)
+    else:
+      os.environ["IGNEOUS_INTEGRITY"] = prev
+
+  off_rates, on_rates = [], []
+  try:
+    os.environ["IGNEOUS_INTEGRITY"] = "1"
+    _timed_e2e(img, seg)  # discarded: pools/codecs/compiles all warm
+    for _ in range(5):
+      os.environ["IGNEOUS_INTEGRITY"] = "off"
+      off_rates.append(_timed_e2e(img, seg))
+      os.environ["IGNEOUS_INTEGRITY"] = "1"
+      on_rates.append(_timed_e2e(img, seg))
+  finally:
+    restore()
+    integrity.flush_all(swallow=True)
+  ratios = sorted(
+    off / on - 1.0 for off, on in zip(off_rates, on_rates) if on
+  )
+  if not ratios:
+    return _skip("no successful envelope-on/off rate pairs")
+  return round(ratios[len(ratios) // 2] * 100.0, 2)
+
+
 def _run_batched(img, seg, mesh=None):
   from igneous_tpu.parallel.batch_runner import batched_downsample
   from igneous_tpu.storage import clear_memory_storage
@@ -1178,6 +1214,7 @@ def run_bench(platform: str):
   cpu8 = cpu1 * 8.0
   e2e_serial, e2e = bench_e2e(img, seg)
   trace_overhead_pct, stage_spans = bench_trace_overhead(img, seg)
+  integrity_overhead_pct = bench_integrity_overhead(img, seg)
   e2e_batched, e2e_batched_device, batched_path = bench_e2e_batched(img, seg)
   inflate = measure_inflate_MBps(seg)
   up, down = measure_transfer_MBps()
@@ -1271,6 +1308,10 @@ def run_bench(platform: str):
       # wall time went, by span name
       "trace_overhead_pct": trace_overhead_pct,
       "stage_spans": stage_spans,
+      # ISSUE 16: clean-path cost of the checksummed write envelope
+      # (digest per put + manifest flushes) vs IGNEOUS_INTEGRITY=off;
+      # acceptance gate is <=5% (negative = host drift noise)
+      "integrity_overhead_pct": integrity_overhead_pct,
       "e2e_batched_voxps": round(e2e_batched, 1),
       "e2e_batched_device_voxps": (
         round(e2e_batched_device, 1) if e2e_batched_device

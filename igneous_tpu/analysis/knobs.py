@@ -60,7 +60,8 @@ _REGISTRY: Dict[str, Knob] = {}
 # section display order for the generated README table
 SECTIONS = (
   "pipeline", "chunk cache", "device kernels", "paged batching",
-  "multihost", "worker lifecycle", "retry", "queue", "storage", "serve",
+  "multihost", "worker lifecycle", "retry", "queue", "storage",
+  "integrity", "serve",
   "journal", "trace / metrics / profile", "health / SLO", "autoscale",
   "simulator", "misc",
 )
@@ -193,6 +194,26 @@ _knob("IGNEOUS_TRANSFER_PASSTHROUGH", "bool", True,
       "`0|off` forces eligible transfers down the decode/re-encode "
       "path (debug + bench A/B)", "storage")
 
+# --- integrity ------------------------------------------------------------
+_knob("IGNEOUS_INTEGRITY", "bool", True,
+      "checksummed write envelope: record a blake2b digest of every "
+      "stored task-output object into `integrity/` manifest sidecars "
+      "(`0|off` restores the bytes-only write path)", "integrity")
+_knob("IGNEOUS_INTEGRITY_BATCH", "int", 256,
+      "manifest records buffered per layer before a write-once JSONL "
+      "segment is flushed", "integrity")
+_knob("IGNEOUS_INTEGRITY_VERIFY_AFTER_WRITE", "bool", False,
+      "read every put back and compare digests before it returns "
+      "(turns a torn write into an immediate, retryable task failure)",
+      "integrity")
+_knob("IGNEOUS_INTEGRITY_SERVE_VERIFY", "bool", True,
+      "serve fill path: validate the wire compression of an origin "
+      "fetch before admitting it to any cache tier", "integrity")
+_knob("IGNEOUS_INTEGRITY_SSD_VERIFY", "bool", True,
+      "serve SSD tier: spot-verify stored-byte digests on SSD->RAM "
+      "promotion for entries seeded from a restart index scan",
+      "integrity")
+
 # --- serve ----------------------------------------------------------------
 _knob("IGNEOUS_SERVE_RAM_MB", "float", 256.0,
       "RAM cache budget", "serve")
@@ -294,6 +315,9 @@ _knob("IGNEOUS_SERVE_MISS_RATIO", "float", 0.9,
 _knob("IGNEOUS_SERVE_MIN_REQUESTS", "int", 50,
       "min in-window requests before serve detectors fire",
       "health / SLO")
+_knob("IGNEOUS_HEALTH_INTEGRITY_MAX", "float", 0.0,
+      "corrupt-read / failed-verify / quarantine count ceiling "
+      "(default: any corruption is an anomaly)", "health / SLO")
 
 # --- autoscale ------------------------------------------------------------
 _knob("IGNEOUS_AUTOSCALE_MIN", "int", 1,

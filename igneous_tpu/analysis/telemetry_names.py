@@ -31,9 +31,9 @@ PASS_ID = "telemetry"
 
 SUBSYSTEMS = frozenset({
   "autoscale", "chaos", "chunk_cache", "device", "dlq", "drain",
-  "fleet", "health", "infer", "journal", "metrics", "pipeline",
-  "queue", "retries", "rollup", "serve", "sim", "slo", "storage",
-  "tasks", "transfer", "worker", "zombie",
+  "fleet", "health", "infer", "integrity", "journal", "metrics",
+  "pipeline", "queue", "retries", "rollup", "serve", "sim", "slo",
+  "storage", "tasks", "transfer", "worker", "zombie",
 })
 
 # the telemetry implementation itself forwards caller-supplied names

@@ -59,6 +59,14 @@ class ChaosConfig:
   storm: transient 503 on ANY operation (get/put/list/exists/size/delete).
   crash_put: hard ChaosWorkerCrash on put — compute done, upload partial,
     worker gone. Not retryable in place; only redelivery recovers.
+  torn_write: the put "succeeds" but only a prefix of the bytes lands at
+    rest (truncated object) — the task, the queue, and the campaign all
+    see success; only the integrity audit can catch it (ISSUE 16).
+  bit_flip: the put "succeeds" with one bit flipped at rest — same
+    silent-success contract as torn_write.
+  corrupt_key_re: regex; torn_write/bit_flip only fire on matching keys
+    (empty = all). Lets a soak corrupt chunk payloads without breaking
+    info/provenance metadata the campaign needs to run at all.
   drop_delete: queue.delete silently dropped (ack lost; task redelivers
     after its lease expires even though its work completed).
   clock_skew: a lease is granted already-expired from the queue's point
@@ -80,12 +88,18 @@ class ChaosConfig:
   drop_delete: float = 0.0
   clock_skew: float = 0.0
   stalled_worker: float = 0.0
+  torn_write: float = 0.0
+  bit_flip: float = 0.0
+  corrupt_key_re: str = ""
   max_faults_per_key: int = 2
   permanent: str = ""
   # occurrence counters, keyed (op, key) — instance state so two configs
   # never share schedules
   _counts: dict = field(default_factory=dict, repr=False)
   _faults: dict = field(default_factory=dict, repr=False)
+  # (op, key) pairs actually corrupted at rest — the soak's ground truth
+  # for "the audit must find exactly these"
+  injected: list = field(default_factory=list, repr=False)
 
   def roll(self, op: str, key: str) -> float:
     """Deterministic uniform [0,1) draw for this (op, key) occurrence."""
@@ -143,7 +157,32 @@ class ChaosStorage:
     if self.config.should_fault("put", key, self.config.put_fail):
       raise HttpError(503, f"chaos://{self.path}/{key}", b"injected put fail")
     self._storm("put", key)
+    data = self._corrupt_at_rest(key, data)
     return self.inner.put(key, data)
+
+  def _corrupt_at_rest(self, key: str, data: bytes) -> bytes:
+    """Silent-success corruption (ISSUE 16): the bytes that land differ
+    from the bytes the writer handed over, but the put reports success —
+    exactly what a torn multipart upload or storage-medium bit rot looks
+    like. The write envelope records the WRITER's digest (CloudFiles
+    computes it above this wrapper), so the manifest holds the truth the
+    audit compares against."""
+    cfg = self.config
+    if (cfg.torn_write <= 0.0 and cfg.bit_flip <= 0.0) or len(data) < 2:
+      return data
+    if cfg.corrupt_key_re:
+      import re
+
+      if not re.search(cfg.corrupt_key_re, key):
+        return data
+    if cfg.should_fault("torn_write", key, cfg.torn_write):
+      cfg.injected.append(("torn_write", key))
+      return data[: max(1, len(data) // 2)]
+    if cfg.should_fault("bit_flip", key, cfg.bit_flip):
+      cfg.injected.append(("bit_flip", key))
+      i = len(data) // 2
+      return data[:i] + bytes([data[i] ^ 0x10]) + data[i + 1:]
+    return data
 
   def get(self, key: str):
     self._storm("get", key)

@@ -1632,6 +1632,12 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
     journal_mod.fire_last_will("crash", {"queue": queue_spec})
     raise
   finally:
+    # write-envelope durability (ISSUE 16): buffered manifest records
+    # land with the same urgency as the journal's last-will batch — an
+    # audit must see digests for everything this worker uploaded
+    from . import integrity as integrity_mod
+
+    integrity_mod.flush_all(swallow=True)
     watcher.stop()
     restore()
   if flag.is_set():
@@ -1644,6 +1650,182 @@ def _execute_worker(queue_spec, lease_sec, num_tasks, exit_on_empty, min_sec,
   # clean exit: flush the journal without the counters line (stdout
   # contract unchanged for healthy drains)
   journal_mod.disarm_last_will()
+
+
+# ---------------------------------------------------------------------------
+# integrity audit (ISSUE 16)
+
+
+def _audit_round(path, mips, report_dir, queue_spec, parallel,
+                 check_digest, require_present, lease_sec, drain_sec):
+  """One audit pass: fan the grid out, drain it, collect findings."""
+  from . import integrity
+  from .task_creation.audit import create_integrity_audit_tasks, load_findings
+
+  integrity.flush_all()
+  for mip in mips:
+    tasks = create_integrity_audit_tasks(
+      path, mip=mip, report_dir=report_dir,
+      check_digest=check_digest, require_present=require_present,
+    )
+    enqueue(queue_spec, tasks, parallel)
+  if queue_spec is not None:
+    _drain_inline(queue_spec, lease_sec, drain_sec)
+  return load_findings(report_dir)
+
+
+def _drain_inline(queue_spec, lease_sec, deadline_sec):
+  """Lease→execute→delete the queue to empty from this process (the
+  audit CLI doubles as a worker so `--queue fq://…` needs no separate
+  fleet; external workers leasing the same ranges just finish sooner)."""
+  import time as time_mod
+
+  from .queues import TaskQueue
+
+  tq = TaskQueue(queue_spec)
+  deadline = time_mod.monotonic() + deadline_sec
+
+  def stop_fn(executed, empty):
+    return (empty and tq.enqueued == 0) or time_mod.monotonic() > deadline
+
+  tq.poll(lease_seconds=lease_sec, verbose=False, stop_fn=stop_fn,
+          max_backoff_window=0.25)
+  if tq.enqueued > 0:
+    raise click.ClickException(
+      f"audit queue failed to drain within {deadline_sec:.0f}s "
+      f"({tq.enqueued} tasks left)"
+    )
+
+
+@main.command("audit")
+@click.argument("path")
+@click.option("--queue", "-q", "queue_spec", default=None,
+              help="fq:// queue to fan the audit grid through (range "
+                   "leases); runs locally if omitted.")
+@click.option("--mip", "mips", multiple=True, type=int,
+              help="Mip level(s) to audit. Default: every mip the "
+                   "layer's recorded downsample campaign produced.")
+@click.option("--report-dir", default=None,
+              help="Findings/report location "
+                   "[default: <path>/integrity/audit]")
+@click.option("--out", default=None, type=click.Path(),
+              help="Also write the completeness report JSON to this "
+                   "local file.")
+@click.option("--heal", is_flag=True,
+              help="Re-enqueue the producing task for each damaged "
+                   "cell and loop audit→repair→re-audit to convergence.")
+@click.option("--max-rounds", default=5, show_default=True,
+              help="Heal convergence bound.")
+@click.option("--no-digest", is_flag=True,
+              help="Skip manifest digest checks (presence+decode only).")
+@click.option("--allow-missing", is_flag=True,
+              help="Missing chunks are not findings (sparse campaigns "
+                   "with delete_black_uploads).")
+@click.option("--lease-sec", default=60.0, show_default=True)
+@click.option("--drain-sec", default=600.0, show_default=True,
+              help="Deadline for each queued round to drain.")
+@click.pass_context
+def audit(ctx, path, queue_spec, mips, report_dir, out, heal, max_rounds,
+          no_digest, allow_missing, lease_sec, drain_sec):
+  """Verify a campaign's outputs: presence, decode, manifest digests.
+
+  Replays the expected chunk grid of PATH against the write envelope
+  (ISSUE 16) and reports every missing, undecodable, or
+  digest-mismatched chunk. Exit 0 = complete and intact; exit 2 =
+  findings remain (each is named on stdout). With --heal, findings
+  re-enqueue the producing DownsampleTask for exactly the damaged
+  cells and the audit loops until clean or --max-rounds.
+  """
+  import json as json_mod
+  import time as time_mod
+
+  from . import chunk_cache, integrity
+  from .observability import trace
+  from .task_creation.audit import (
+    downsample_provenance,
+    downsample_repair_tasks,
+  )
+  from .volume import Volume
+
+  parallel = ctx.obj["parallel"]
+  path = path.rstrip("/")
+  report_dir = report_dir or f"{path}/{integrity.INTEGRITY_PREFIX}/audit"
+  vol = Volume(path, mip=0)
+  prov = downsample_provenance(vol)
+  if mips:
+    mips = sorted(set(int(m) for m in mips))
+  elif prov is not None:
+    src = int(prov["mip"])
+    mips = list(range(src + 1, src + int(prov["num_mips"]) + 1))
+  else:
+    raise click.ClickException(
+      "no recorded downsample campaign in provenance: pass --mip "
+      "explicitly to name the levels to audit"
+    )
+
+  findings, totals = _audit_round(
+    path, mips, report_dir, queue_spec, parallel,
+    not no_digest, not allow_missing, lease_sec, drain_sec,
+  )
+  rounds = 1
+  repaired = 0
+  while findings and heal and rounds <= max_rounds:
+    tasks, unhealable = downsample_repair_tasks(path, findings, prov)
+    if unhealable:
+      for f in unhealable:
+        click.echo(f"UNHEALABLE {f['kind']} mip={f['mip']} {f['key']}")
+      break
+    if not tasks:
+      break
+    click.echo(
+      f"heal round {rounds}: {len(findings)} findings -> "
+      f"{len(tasks)} repair tasks"
+    )
+    # repairs carry the audit's trace lineage through the queue, the
+    # same way any enqueued campaign does
+    with trace.activate(trace.SpanContext(trace.new_id(), None, True)):
+      enqueue(queue_spec, tasks, parallel)
+    if queue_spec is not None:
+      _drain_inline(queue_spec, lease_sec, drain_sec)
+    repaired += len(tasks)
+    # repaired chunks re-enter reads fresh: drop any decoded chunks the
+    # damaged bytes may have neighbored
+    for mip in mips:
+      chunk_cache.invalidate(path, mip)
+    findings, totals = _audit_round(
+      path, mips, report_dir, queue_spec, parallel,
+      not no_digest, not allow_missing, lease_sec, drain_sec,
+    )
+    rounds += 1
+
+  report = {
+    "layer": path,
+    "mips": list(mips),
+    "rounds": rounds,
+    "repair_tasks": repaired,
+    "chunks_checked": totals["chunks"],
+    "unmanifested": totals["unmanifested"],
+    "findings": findings,
+    "complete": not findings,
+    "ts": time_mod.time(),
+  }
+  from .storage import CloudFiles
+
+  CloudFiles(report_dir).put_json("report.json", report)
+  if out:
+    with open(out, "w") as f:
+      json_mod.dump(report, f, indent=2, sort_keys=True)
+
+  for f in findings:
+    click.echo(f"CORRUPT {f['kind']} mip={f['mip']} {f['key']}")
+  click.echo(
+    f"audited {totals['chunks']} chunks across mips {list(mips)}: "
+    + ("complete and intact"
+       if not findings else f"{len(findings)} findings")
+    + (f" ({repaired} repair tasks over {rounds} rounds)" if repaired else "")
+  )
+  if findings:
+    raise SystemExit(2)
 
 
 @main.group("queue")

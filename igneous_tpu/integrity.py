@@ -1,0 +1,293 @@
+"""Data-integrity plane (ISSUE 16): the checksummed write envelope.
+
+Campaign outputs live in object storage for months between the write
+and the read that discovers a torn upload or a bit-flipped block — by
+which point the producing task, its queue, and its worker are long
+gone. This module closes that loop:
+
+* **Write envelope** — every task-output put records a blake2b-128
+  digest of the *stored wire bytes* (post-compression, the exact bytes
+  at rest) into per-prefix manifest sidecars under
+  ``<layer>/integrity/manifests/<top-level-dir>/``. Records are
+  buffered per layer and flushed as write-once JSONL segments, the same
+  append-only discipline as journal segments: a segment is never
+  rewritten, merges are last-writer-wins on the record timestamp.
+  ``IGNEOUS_INTEGRITY=off`` restores the bytes-only write path.
+
+* **Quarantine ledger** — read-path corruption (decode failures,
+  digest mismatches) files the bad object reference under
+  ``integrity/quarantine/`` immediately (no batching: a corrupt read
+  is rare and must survive a crash) and ticks ``integrity.*``
+  counters. Quarantine never raises: it rides exception paths.
+
+* **Verify-after-write** — ``IGNEOUS_INTEGRITY_VERIFY_AFTER_WRITE=1``
+  reads every put back and compares digests before the put returns,
+  converting a torn write into an immediate task failure that the
+  retry/DLQ machinery already knows how to handle.
+
+``igneous audit`` (tasks/audit.py) replays the campaign's chunk grid
+against these manifests; audit findings feed repair-task creation
+(task_creation/audit.py) so a damaged campaign heals itself.
+
+Exemptions: the envelope covers payload objects, not metadata.
+``integrity/`` sidecars themselves (recursion), ``info``/``provenance``
+singletons (rewritten in place — a "latest digest" is meaningless for
+a write-once envelope), and ``.json``/``.jsonl`` keys (journal
+segments, reports — append-structured, self-describing) are skipped.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from hashlib import blake2b
+from typing import Dict, List, Optional
+
+from . import telemetry
+from .analysis import knobs
+
+# every envelope artifact lives under this top-level prefix inside the
+# layer; byte-compare tooling (chaos soak, transfers) excludes it
+INTEGRITY_PREFIX = "integrity"
+
+
+def digest_hex(data) -> str:
+  """blake2b-128 hex of the stored wire bytes — same digest family as
+  the chunk decode cache key and serve's strong ETag, so one digest
+  value is comparable across all three planes."""
+  return blake2b(bytes(data), digest_size=16).hexdigest()
+
+
+class CorruptChunkError(Exception):
+  """A stored object failed decode or digest verification.
+
+  Deliberately NOT an ``EmptyVolumeError``/``IOError`` subclass: callers
+  that tolerate missing chunks (fill_missing) must not accidentally
+  tolerate corrupt ones."""
+
+  def __init__(self, cloudpath: str, key: str, reason: str,
+               expected: Optional[str] = None, actual: Optional[str] = None):
+    self.cloudpath = cloudpath
+    self.key = key
+    self.reason = reason
+    self.expected = expected
+    self.actual = actual
+    msg = f"corrupt object {key} in {cloudpath}: {reason}"
+    if expected is not None:
+      msg += f" (expected digest {expected}, got {actual})"
+    super().__init__(msg)
+
+
+def enabled() -> bool:
+  return knobs.get_bool("IGNEOUS_INTEGRITY")
+
+
+def exempt(key: str) -> bool:
+  """True for keys the envelope does not cover (see module docstring)."""
+  if key.startswith(INTEGRITY_PREFIX + "/"):
+    return True
+  base = os.path.basename(key)
+  if base in ("info", "provenance") or base.startswith("provenance"):
+    return True
+  return base.endswith(".json") or base.endswith(".jsonl")
+
+
+class ManifestRecorder:
+  """Buffers (stored key → digest) records per layer, flushing them as
+  write-once JSONL segments grouped by the key's top-level directory
+  (the mip dir for image layers) so an audit of one mip loads only that
+  prefix. One process-global instance; thread-safe."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._buf: Dict[str, List[dict]] = {}
+    self._seq = 0
+
+  def record(self, cloudpath: str, stored_key: str, payload: bytes) -> Optional[str]:
+    """Buffer a manifest record for a completed put. Returns the digest
+    hex (for verify-after-write) or None if the key is exempt."""
+    if not enabled() or exempt(stored_key):
+      return None
+    dig = digest_hex(payload)
+    rec = {
+      "key": stored_key,
+      "digest": dig,
+      "n": len(payload),
+      "ts": round(time.time(), 6),
+    }
+    telemetry.incr("integrity.records")
+    flush_now = None
+    cloudpath = cloudpath.rstrip("/")
+    with self._lock:
+      buf = self._buf.setdefault(cloudpath, [])
+      buf.append(rec)
+      if len(buf) >= max(1, knobs.get_int("IGNEOUS_INTEGRITY_BATCH")):
+        flush_now, self._buf[cloudpath] = buf, []
+    if flush_now:
+      self._write_segments(cloudpath, flush_now, swallow=False)
+    return dig
+
+  def flush(self, cloudpath: Optional[str] = None, swallow: bool = False):
+    """Flush buffered records (one layer, or all). ``swallow=True`` is
+    the atexit/backstop mode: a layer whose file:// root is gone (tests
+    tearing down tempdirs) is dropped, and write errors are ignored —
+    the backstop must never turn a clean exit into a traceback."""
+    with self._lock:
+      if cloudpath is not None:
+        items = [(cloudpath.rstrip("/"), self._buf.pop(cloudpath.rstrip("/"), []))]
+      else:
+        items = list(self._buf.items())
+        self._buf = {}
+    for path, records in items:
+      if not records:
+        continue
+      if swallow and _file_root_gone(path):
+        continue
+      self._write_segments(path, records, swallow=swallow)
+
+  def _write_segments(self, cloudpath: str, records: List[dict], swallow: bool):
+    from .storage import CloudFiles
+
+    groups: Dict[str, List[dict]] = {}
+    for rec in records:
+      top = rec["key"].split("/", 1)[0] if "/" in rec["key"] else "_root"
+      groups.setdefault(top, []).append(rec)
+    try:
+      cf = CloudFiles(cloudpath)
+      for top, recs in groups.items():
+        with self._lock:
+          self._seq += 1
+          seq = self._seq
+        name = (
+          f"{INTEGRITY_PREFIX}/manifests/{top}/"
+          f"seg_w{os.getpid()}_{seq:06d}.jsonl"
+        )
+        body = "".join(json.dumps(r, sort_keys=True) + "\n" for r in recs)
+        cf.put(name, body.encode("utf8"), compress=None)
+        telemetry.incr("integrity.manifest_segments")
+    except Exception:
+      if not swallow:
+        raise
+
+
+def _file_root_gone(cloudpath: str) -> bool:
+  from .storage import extract_path
+
+  pth = extract_path(cloudpath)
+  return pth.protocol == "file" and not os.path.isdir(pth.path)
+
+
+_RECORDER = ManifestRecorder()
+
+
+def record_put(cloudpath: str, stored_key: str, payload: bytes, backend=None):
+  """Storage-layer hook: called by ``CloudFiles.put``/``put_stored``
+  after a successful backend write. Records the manifest entry and,
+  under ``IGNEOUS_INTEGRITY_VERIFY_AFTER_WRITE``, reads the object back
+  to prove the stored bytes match before the put returns."""
+  dig = _RECORDER.record(cloudpath, stored_key, payload)
+  if dig is None or backend is None:
+    return
+  if not knobs.get_bool("IGNEOUS_INTEGRITY_VERIFY_AFTER_WRITE"):
+    return
+  back = backend.get(stored_key)
+  actual = digest_hex(back) if back is not None else None
+  if actual != dig:
+    telemetry.incr("integrity.verify_failed")
+    quarantine(cloudpath, stored_key, "verify-after-write mismatch")
+    raise CorruptChunkError(
+      cloudpath, stored_key, "verify-after-write mismatch",
+      expected=dig, actual=actual,
+    )
+
+
+def flush_all(swallow: bool = False):
+  """Flush every buffered manifest record. Workers call this on drain
+  (alongside the journal last-will); audits call it before reading."""
+  _RECORDER.flush(swallow=swallow)
+
+
+def flush(cloudpath: str):
+  _RECORDER.flush(cloudpath)
+
+
+atexit.register(flush_all, True)
+
+
+def load_manifest(cloudpath: str, prefix: Optional[str] = None) -> Dict[str, dict]:
+  """Merge manifest segments into {stored key → record}, last-writer-wins
+  on the record timestamp (a healed chunk's re-put supersedes the
+  original digest). ``prefix`` restricts the load to one top-level key
+  directory (e.g. a mip dir)."""
+  from .storage import CloudFiles
+
+  cf = CloudFiles(cloudpath)
+  base = f"{INTEGRITY_PREFIX}/manifests/"
+  if prefix:
+    base += prefix.strip("/") + "/"
+  out: Dict[str, dict] = {}
+  for seg in sorted(cf.list(base)):
+    if not seg.endswith(".jsonl"):
+      continue
+    raw = cf.get(seg)
+    if raw is None:
+      continue
+    for line in raw.splitlines():
+      if not line.strip():
+        continue
+      rec = json.loads(line)
+      prev = out.get(rec["key"])
+      if prev is None or rec["ts"] >= prev["ts"]:
+        out[rec["key"]] = rec
+  return out
+
+
+_QUARANTINE_LOCK = threading.Lock()
+_QUARANTINE_SEQ = 0
+
+
+def quarantine(cloudpath: str, key: str, reason: str):
+  """File a corrupt-object reference under ``integrity/quarantine/``.
+  Written immediately (one record per file — corruption is rare, and the
+  ledger must survive the crash the corrupt read may be about to cause)
+  and never raises: this rides exception paths."""
+  global _QUARANTINE_SEQ
+  if not enabled():
+    return
+  from .storage import CloudFiles
+
+  telemetry.incr("integrity.quarantined")
+  with _QUARANTINE_LOCK:
+    _QUARANTINE_SEQ += 1
+    seq = _QUARANTINE_SEQ
+  rec = {
+    "key": key,
+    "reason": reason,
+    "ts": round(time.time(), 6),
+  }
+  try:
+    CloudFiles(cloudpath).put(
+      f"{INTEGRITY_PREFIX}/quarantine/q_w{os.getpid()}_{seq:06d}.jsonl",
+      (json.dumps(rec, sort_keys=True) + "\n").encode("utf8"),
+      compress=None,
+    )
+  except Exception:
+    pass
+
+
+def load_quarantine(cloudpath: str) -> List[dict]:
+  from .storage import CloudFiles
+
+  cf = CloudFiles(cloudpath)
+  out = []
+  for seg in sorted(cf.list(f"{INTEGRITY_PREFIX}/quarantine/")):
+    raw = cf.get(seg)
+    if raw is None:
+      continue
+    for line in raw.splitlines():
+      if line.strip():
+        out.append(json.loads(line))
+  return out

@@ -96,6 +96,11 @@ class HealthConfig:
   serve_p99_ms: Optional[float] = None
   serve_miss_ratio_max: float = 0.9
   serve_min_requests: int = 50
+  # data integrity (ISSUE 16): corrupt reads + failed write-verifies +
+  # quarantined objects above this count is an anomaly — the default 0
+  # means ANY detected corruption alerts (it should: every one names a
+  # damaged object that needs an audit/heal pass)
+  integrity_corrupt_max: float = 0.0
 
   _ENV = {
     "window_sec": "IGNEOUS_HEALTH_WINDOW_SEC",
@@ -119,6 +124,7 @@ class HealthConfig:
     "serve_p99_ms": "IGNEOUS_SERVE_SLO_P99_MS",
     "serve_miss_ratio_max": "IGNEOUS_SERVE_MISS_RATIO",
     "serve_min_requests": "IGNEOUS_SERVE_MIN_REQUESTS",
+    "integrity_corrupt_max": "IGNEOUS_HEALTH_INTEGRITY_MAX",
   }
 
   @classmethod
@@ -362,6 +368,23 @@ class HealthEngine:
         "kind": "zombie_rate", "zombie_fences": zombies,
         "rate": round(zombies / denom, 3), "max": cfg.zombie_rate_max,
       })
+    # data integrity (ISSUE 16): every corrupt read / failed
+    # verify-after-write / quarantined object names at-rest damage that
+    # retries cannot fix — only an audit/heal pass can
+    corrupt_reads = counters.get("integrity.corrupt_reads", 0)
+    verify_failed = counters.get("integrity.verify_failed", 0)
+    quarantined = counters.get("integrity.quarantined", 0)
+    audit_findings = counters.get("integrity.audit.findings", 0)
+    corrupt_total = corrupt_reads + verify_failed + quarantined
+    if corrupt_total > cfg.integrity_corrupt_max or audit_findings > 0:
+      anomalies.append({
+        "kind": "integrity",
+        "corrupt_reads": corrupt_reads,
+        "verify_failed": verify_failed,
+        "quarantined": quarantined,
+        "audit_findings": audit_findings,
+        "max": cfg.integrity_corrupt_max,
+      })
     stall_total, work_total = scan["stall_total"], scan["work_total"]
     stall_ratio = (
       stall_total / (stall_total + work_total)
@@ -562,6 +585,13 @@ class HealthEngine:
         ),
         "p99_target_ms": cfg.serve_p99_ms,
       }
+    if corrupt_total or audit_findings:
+      report["integrity"] = {
+        "corrupt_reads": corrupt_reads,
+        "verify_failed": verify_failed,
+        "quarantined": quarantined,
+        "audit_findings": audit_findings,
+      }
     from . import device as device_mod
 
     report["devices"] = device_mod.fleet_summary(device_ledgers)
@@ -596,6 +626,13 @@ def publish_gauges(report: dict) -> None:
     metrics.gauge_set("fleet.serve_p99_ms", srv["p99_ms"])
     if srv.get("miss_ratio") is not None:
       metrics.gauge_set("fleet.serve_miss_ratio", srv["miss_ratio"])
+  integ = report.get("integrity")
+  if integ:
+    # rendered by observability.prom as igneous_integrity_* — the
+    # deployment.yaml igneous-integrity PrometheusRule alerts on these
+    metrics.gauge_set("integrity.corrupt_reads", integ["corrupt_reads"])
+    metrics.gauge_set("integrity.quarantined", integ["quarantined"])
+    metrics.gauge_set("integrity.audit_findings", integ["audit_findings"])
 
 
 def health_events(report: dict) -> List[dict]:

@@ -462,9 +462,34 @@ class ServeApp:
       )
     if data is None:
       return None
+    if not synthesized and not self._fill_verify(layer, key, data, method):
+      # corrupt origin object: never admitted to any cache tier, never
+      # served — the client sees a 404 and the reference is quarantined
+      return None
     if len(data) <= int(self.config.max_object_mb * 1e6):
       return self._cache.put(layer.name, key, data, method)
     return Entry(bytes(data), method, strong_etag(data))
+
+  def _fill_verify(self, layer: LayerHandle, key: str, data: bytes,
+                   method: Optional[str]) -> bool:
+    """Fill-path corruption guard (ISSUE 16): validate the wire
+    compression of an origin fetch before it can reach a cache tier or
+    a client. Raw-stored objects carry no checkable redundancy here;
+    they are covered by the manifest-digest audit instead."""
+    if method is None or not knobs.get_bool("IGNEOUS_INTEGRITY_SERVE_VERIFY"):
+      return True
+    try:
+      decompress_bytes(data, method)
+      return True
+    except Exception as e:
+      from .. import integrity
+
+      metrics.incr("integrity.corrupt_reads")
+      metrics.incr("serve.fetch.corrupt")
+      integrity.quarantine(
+        layer.cf.cloudpath, key, f"serve fill: {type(e).__name__}: {e}"
+      )
+      return False
 
   # -- on-the-fly mip synthesis ----------------------------------------------
 

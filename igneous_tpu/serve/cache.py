@@ -32,8 +32,14 @@ import threading
 from collections import OrderedDict
 from typing import Optional, Tuple
 
+from ..analysis import knobs
 from ..observability import metrics
-from ..storage import COMPRESSION_EXTS, method_for_ext, stored_exts
+from ..storage import (
+  COMPRESSION_EXTS,
+  decompress_bytes,
+  method_for_ext,
+  stored_exts,
+)
 
 
 def strong_etag(data: bytes) -> str:
@@ -130,6 +136,9 @@ class SsdTier:
     # access-ordered index: relpath -> size (seeded from disk by mtime so
     # restart eviction order approximates the predecessor's LRU)
     self._index: "OrderedDict[str, int]" = OrderedDict()  # guarded-by: self._lock
+    # relpath -> expected ETag for entries written (or verified once)
+    # by THIS process; restart-seeded entries start absent here
+    self._etags: dict = {}  # guarded-by: self._lock
     self._bytes = 0  # guarded-by: self._lock
     os.makedirs(root, exist_ok=True)
     self._seed_index()
@@ -161,6 +170,7 @@ class SsdTier:
       rel = self._relpath(key, ext)
       with self._lock:
         known = rel in self._index
+        expected = self._etags.get(rel)
       if not known:
         continue
       try:
@@ -169,13 +179,56 @@ class SsdTier:
       except OSError:
         with self._lock:
           size = self._index.pop(rel, None)
+          self._etags.pop(rel, None)
           if size is not None:
             self._bytes -= size
         continue
+      etag = strong_etag(data)
+      if not self._promotable(ext, data, etag, expected):
+        # never serve (or promote to RAM) bytes that fail verification:
+        # evict and fall through to an origin refetch
+        self._evict_corrupt(rel)
+        continue
       with self._lock:
         self._index.move_to_end(rel)
-      return Entry(data, method_for_ext(ext), strong_etag(data))
+        self._etags[rel] = etag
+      return Entry(data, method_for_ext(ext), etag)
     return None
+
+  def _promotable(self, ext: str, data: bytes, etag: str,
+                  expected: Optional[str]) -> bool:
+    """Integrity gate on SSD→RAM promotion (ISSUE 16). Entries this
+    process wrote carry a recorded ETag — any on-disk drift is a
+    mismatch. Entries seeded from a restart index scan predate the
+    process (the old blind-trust path): spot-verify their wire
+    compression once before first promotion; raw-stored entries carry
+    no redundancy to check, so their derived ETag is recorded as-is."""
+    if expected is not None:
+      return etag == expected
+    if not knobs.get_bool("IGNEOUS_INTEGRITY_SSD_VERIFY"):
+      return True
+    method = method_for_ext(ext)
+    if method is None:
+      return True
+    try:
+      decompress_bytes(data, method)
+    except Exception:
+      return False
+    return True
+
+  def _evict_corrupt(self, rel: str) -> None:
+    metrics.incr("serve.cache.ssd.verify_failed")
+    metrics.incr("integrity.corrupt_reads")
+    with self._lock:
+      size = self._index.pop(rel, None)
+      self._etags.pop(rel, None)
+      if size is not None:
+        self._bytes -= size
+      metrics.gauge_set("serve.cache.ssd.bytes", self._bytes)
+    try:
+      os.remove(os.path.join(self.root, rel))
+    except OSError:
+      pass
 
   def put(self, key: tuple, entry: Entry) -> None:
     n = len(entry.data)
@@ -200,10 +253,12 @@ class SsdTier:
       if old is not None:
         self._bytes -= old
       self._index[rel] = n
+      self._etags[rel] = entry.etag
       self._bytes += n
       doomed = []
       while self._bytes > self.budget and self._index:
         old_rel, old_size = self._index.popitem(last=False)
+        self._etags.pop(old_rel, None)
         self._bytes -= old_size
         doomed.append(old_rel)
       metrics.gauge_set("serve.cache.ssd.bytes", self._bytes)
@@ -224,6 +279,7 @@ class SsdTier:
       ]
       for rel in doomed:
         self._bytes -= self._index.pop(rel)
+        self._etags.pop(rel, None)
       metrics.gauge_set("serve.cache.ssd.bytes", self._bytes)
     for rel in doomed:
       try:

@@ -39,6 +39,7 @@ try:
 except ImportError:  # zstd stays readable/writable only where the codec ships
   zstandard = None
 
+from . import integrity
 from .lib import jsonify
 from .observability import trace as _trace
 
@@ -426,10 +427,12 @@ class CloudFiles:
     if isinstance(content, str):
       content = content.encode("utf8")
     ext = COMPRESSION_EXTS[compress]
+    payload = compress_bytes(bytes(content), compress)
     # storage spans only materialize under a sampled task trace
     # (observability.trace.maybe_span is a thread-local check otherwise)
     with _trace.maybe_span("storage.put", protocol=self.pth.protocol):
-      self.backend.put(key + ext, compress_bytes(bytes(content), compress))
+      self.backend.put(key + ext, payload)
+    integrity.record_put(self.cloudpath, key + ext, payload, backend=self.backend)
 
   def puts(self, files: Iterable, compress=None, **kw):
     total = 0
@@ -487,8 +490,11 @@ class CloudFiles:
     """Store already-wire-compressed bytes verbatim under the extension
     ``method`` implies — the zero-decode transfer's write half. ``method``
     must name the compression the bytes actually carry."""
+    stored_key = key + COMPRESSION_EXTS[method]
+    payload = bytes(data)
     with _trace.maybe_span("storage.put", protocol=self.pth.protocol):
-      self.backend.put(key + COMPRESSION_EXTS[method], bytes(data))
+      self.backend.put(stored_key, payload)
+    integrity.record_put(self.cloudpath, stored_key, payload, backend=self.backend)
 
   def get_range(self, key: str, start: int, length: int) -> Optional[bytes]:
     """Ranged read of an UNCOMPRESSED object (sharded-format reads).

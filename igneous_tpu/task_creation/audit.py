@@ -1,0 +1,168 @@
+"""Audit + repair factories (ISSUE 16).
+
+``create_integrity_audit_tasks`` fans an :class:`IntegrityAuditTask`
+grid over one mip of a layer — grid cells are a whole multiple of the
+chunk size, resolved through the same :func:`get_bounds` math the
+creation factories use, so the audited universe IS the produced one.
+
+The heal half turns findings back into producing tasks:
+``downsample_repair_tasks`` reads the campaign parameters the
+downsample factory recorded in provenance, maps each damaged chunk
+(at whatever mip it was found) back to the source-mip task-grid cell
+that produced it, dedups cells, and re-mints the original
+``DownsampleTask`` for exactly those cells. Repairs ride the normal
+queue/DLQ/trace machinery — a repair that keeps failing quarantines
+like any other task.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from ..lib import Bbox, Vec
+from ..storage import CloudFiles
+from ..tasks.audit import IntegrityAuditTask
+from ..tasks.image import DownsampleTask
+from ..volume import Volume
+from .common import GridTaskIterator, get_bounds
+
+# audit grid cells span this many chunks per axis by default: big enough
+# to amortize the per-task manifest load, small enough to range-lease
+DEFAULT_CELL_CHUNKS = (8, 8, 4)
+
+
+def create_integrity_audit_tasks(
+  layer_path: str,
+  mip: int,
+  report_dir: str,
+  bounds: Optional[Bbox] = None,
+  bounds_mip: int = 0,
+  shape: Optional[Sequence[int]] = None,
+  check_digest: bool = True,
+  require_present: bool = True,
+):
+  """Task iterator auditing ``mip`` of ``layer_path``; findings land
+  under ``report_dir`` (one deterministic JSONL file per grid cell)."""
+  vol = Volume(layer_path, mip=mip)
+  if shape is None:
+    shape = vol.meta.chunk_size(mip) * Vec(*DEFAULT_CELL_CHUNKS)
+  shape = Vec(*shape)
+  task_bounds = get_bounds(vol, bounds, mip, bounds_mip)
+
+  def make_task(shape_: Vec, offset: Vec):
+    return IntegrityAuditTask(
+      layer_path=layer_path,
+      mip=mip,
+      shape=shape_.tolist(),
+      offset=offset.tolist(),
+      report_dir=report_dir,
+      check_digest=check_digest,
+      require_present=require_present,
+    )
+
+  return GridTaskIterator(task_bounds, shape, make_task)
+
+
+def load_findings(report_dir: str) -> Tuple[List[dict], dict]:
+  """Merge every per-cell report under ``report_dir`` into
+  (findings, totals). Reports are deterministic-named and overwritten
+  per audit round, so this always reflects the latest round."""
+  cf = CloudFiles(report_dir)
+  findings: List[dict] = []
+  totals = {"chunks": 0, "findings": 0, "unmanifested": 0, "cells": 0}
+  for name in sorted(cf.list("")):
+    base = name.rsplit("/", 1)[-1]
+    if not (base.startswith("findings_") and base.endswith(".jsonl")):
+      continue
+    raw = cf.get(name)
+    if raw is None:
+      continue
+    for line in raw.splitlines():
+      if not line.strip():
+        continue
+      rec = json.loads(line)
+      if rec.get("kind") == "summary":
+        totals["cells"] += 1
+        for field in ("chunks", "findings", "unmanifested"):
+          totals[field] += int(rec.get(field, 0))
+      else:
+        findings.append(rec)
+  # dedup by (mip, key): at-least-once delivery can double-report a cell
+  seen = set()
+  unique = []
+  for f in sorted(findings, key=lambda f: (f["mip"], f["key"], f["kind"])):
+    k = (f["mip"], f["key"])
+    if k not in seen:
+      seen.add(k)
+      unique.append(f)
+  return unique, totals
+
+
+def downsample_provenance(vol: Volume) -> Optional[dict]:
+  """Latest DownsampleTask campaign record from the layer's provenance
+  (the parameter set ``create_downsampling_tasks`` wrote on finish)."""
+  prov = vol.meta.refresh_provenance()
+  for entry in reversed(prov.get("processing", [])):
+    method = entry.get("method", {})
+    if isinstance(method, dict) and method.get("task") == "DownsampleTask":
+      return method
+  return None
+
+
+def downsample_repair_tasks(
+  layer_path: str,
+  findings: Iterable[dict],
+  provenance: Optional[dict] = None,
+) -> Tuple[List[DownsampleTask], List[dict]]:
+  """(repair tasks, unhealable findings).
+
+  Each finding's chunk bbox is converted to source-mip coordinates and
+  floored onto the producing campaign's task grid; one repair task per
+  damaged cell re-runs the original downsample over that cell, which
+  rewrites every output mip of the cell — byte-identically, since the
+  downsample device pass and gzip (mtime=0) encode are deterministic.
+  Findings at or below the source mip have no recorded producer here
+  and come back as unhealable."""
+  vol = Volume(layer_path, mip=0, bounded=False)
+  prov = provenance if provenance is not None else downsample_provenance(vol)
+  if prov is None:
+    return [], list(findings)
+
+  src_mip = int(prov["mip"])
+  shape = Vec(*prov["shape"])
+  task_bounds = Bbox.from_list(prov["bounds"])
+  cells = set()
+  unhealable = []
+  for f in findings:
+    fmip = int(f["mip"])
+    if fmip <= src_mip or fmip > src_mip + int(prov["num_mips"]):
+      unhealable.append(f)
+      continue
+    fbox = Bbox.from_list(f["bbox"])
+    at_src = vol.meta.bbox_to_mip(fbox, fmip, src_mip)
+    lo = (at_src.minpt - task_bounds.minpt) // shape
+    hi = (at_src.maxpt - Vec(1, 1, 1) - task_bounds.minpt) // shape
+    for x in range(int(lo.x), int(hi.x) + 1):
+      for y in range(int(lo.y), int(hi.y) + 1):
+        for z in range(int(lo.z), int(hi.z) + 1):
+          cells.add((x, y, z))
+
+  tasks = []
+  for cell in sorted(cells):
+    offset = task_bounds.minpt + Vec(*cell) * shape
+    tasks.append(DownsampleTask(
+      layer_path=layer_path,
+      mip=src_mip,
+      shape=shape.tolist(),
+      offset=offset.tolist(),
+      fill_missing=bool(prov.get("fill_missing", False)),
+      sparse=bool(prov.get("sparse", False)),
+      delete_black_uploads=bool(prov.get("delete_black_uploads", False)),
+      background_color=int(prov.get("background_color", 0)),
+      compress=prov.get("compress", "gzip"),
+      downsample_method=prov.get("method", "auto"),
+      num_mips=int(prov["num_mips"]),
+      factor=tuple(prov["factor"]),
+    ))
+  return tasks, unhealable

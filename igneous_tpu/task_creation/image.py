@@ -209,6 +209,9 @@ def create_downsampling_tasks(
     )
 
   def finish():
+    # the full task-constructor parameter set rides along so `igneous
+    # audit --heal` can re-mint the producing task for a damaged cell
+    # from provenance alone (task_creation/audit.py)
     _provenance(vol, {
       "task": "DownsampleTask",
       "mip": mip,
@@ -218,6 +221,10 @@ def create_downsampling_tasks(
       "sparse": sparse,
       "bounds": task_bounds.to_list(),
       "method": downsample_method,
+      "fill_missing": fill_missing,
+      "compress": compress,
+      "delete_black_uploads": delete_black_uploads,
+      "background_color": background_color,
     })
 
   return GridTaskIterator(task_bounds, shape, make_task, finish)

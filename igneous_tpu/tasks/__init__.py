@@ -6,6 +6,7 @@ Worker processes import this module before deserializing payloads.
 """
 
 from ..queues.registry import PrintTask, RegisteredTask
+from .audit import IntegrityAuditTask
 from .image import (
   BlackoutTask,
   DeleteTask,

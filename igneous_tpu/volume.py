@@ -19,7 +19,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from . import chunk_cache, codecs
+from . import chunk_cache, codecs, integrity, telemetry
 from .lib import Bbox, Vec, chunk_bboxes, jsonify
 from .meta import PrecomputedMetadata
 from .storage import CloudFiles, decompress_bytes
@@ -270,9 +270,7 @@ class Volume:
       method is not None or encoding != "raw"
     )
     if not cacheable:
-      return self._decode_chunk(
-        decompress_bytes(data, method), chunk_bbx, mip, writable=False
-      )
+      return self._guarded_decode(data, method, chunk_bbx, mip)
     bbox_key = (
       tuple(int(v) for v in chunk_bbx.minpt),
       tuple(int(v) for v in chunk_bbx.maxpt),
@@ -280,10 +278,33 @@ class Volume:
     key, arr = chunk_cache.lookup(self.cloudpath, mip, bbox_key, data)
     if arr is not None:
       return arr
-    arr = self._decode_chunk(
-      decompress_bytes(data, method), chunk_bbx, mip, writable=False
-    )
+    # a corrupt chunk raises out of the guarded decode BEFORE
+    # chunk_cache.store — no cache tier ever holds bytes that failed
+    # to decode, and the digest-keyed lookup above cannot alias a
+    # corrupt wire body onto a previously-cached clean decode
+    arr = self._guarded_decode(data, method, chunk_bbx, mip)
     return chunk_cache.store(key, arr)
+
+  def _guarded_decode(
+    self, data: bytes, method: Optional[str], chunk_bbx: Bbox, mip: int
+  ) -> np.ndarray:
+    """Inflate + codec-decode with the read-path corruption guard: a
+    torn or bit-flipped object at rest surfaces as a typed
+    :class:`~igneous_tpu.integrity.CorruptChunkError` (never an opaque
+    zlib/codec traceback), ticks ``integrity.corrupt_reads``, and files
+    the object reference in the layer's quarantine ledger."""
+    import zlib
+
+    try:
+      return self._decode_chunk(
+        decompress_bytes(data, method), chunk_bbx, mip, writable=False
+      )
+    except (OSError, EOFError, ValueError, zlib.error) as e:
+      key = self.meta.chunk_name(mip, chunk_bbx)
+      telemetry.incr("integrity.corrupt_reads")
+      reason = f"{type(e).__name__}: {e}"
+      integrity.quarantine(self.cloudpath, key, reason)
+      raise integrity.CorruptChunkError(self.cloudpath, key, reason) from e
 
   def download(
     self,

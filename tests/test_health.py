@@ -265,6 +265,36 @@ class TestDetectors:
     assert "dlq_rate" in kinds
     assert "journal_stalled" in kinds  # every writer silent + backlog
 
+  def test_integrity_anomaly_from_corrupt_reads(self):
+    # ISSUE 16: any corrupt read / quarantine / audit finding is
+    # at-rest damage retries cannot fix — `fleet check` must flag it
+    now = time.time()
+    records = [
+      _span("w0", "task", now - 300, 0.1),
+      {"kind": "counters", "worker": "w0", "ts": now - 300,
+       "event": "interval",
+       "counters": {"integrity.corrupt_reads": 2,
+                    "integrity.quarantined": 2,
+                    "integrity.audit.findings": 1}},
+    ]
+    rep = health.HealthEngine(_cfg()).evaluate(records, now=now)
+    anomaly = next(a for a in rep["anomalies"] if a["kind"] == "integrity")
+    assert anomaly["corrupt_reads"] == 2
+    assert anomaly["audit_findings"] == 1
+    assert rep["integrity"]["quarantined"] == 2
+    assert not rep["healthy"]
+    health.publish_gauges(rep)
+    text = prom.render()
+    assert "igneous_integrity_corrupt_reads 2" in text
+    assert "igneous_integrity_audit_findings 1" in text
+
+  def test_no_integrity_anomaly_when_clean(self):
+    now = time.time()
+    records = [_span("w0", "task", now - 30, 0.1)]
+    rep = health.HealthEngine(_cfg()).evaluate(records, now=now)
+    assert all(a["kind"] != "integrity" for a in rep["anomalies"])
+    assert "integrity" not in rep
+
   def test_slo_burn(self):
     now = time.time()
     records = [_span("w", "task", now - 30 + i, 0.1) for i in range(8)]

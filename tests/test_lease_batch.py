@@ -34,6 +34,11 @@ def _tree(root):
     for f in files:
       p = os.path.join(dirpath, f)
       rel = os.path.relpath(p, root)
+      if rel.startswith("integrity" + os.sep):
+        # write-envelope sidecars (ISSUE 16): segment names and record
+        # timestamps are run-specific by design; byte identity is a
+        # claim about the chunk payloads
+        continue
       with open(p, "rb") as fh:
         out[rel] = fh.read()
   return out

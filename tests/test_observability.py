@@ -97,6 +97,31 @@ def test_trace_survives_filequeue_redelivery_and_dlq(tmp_path):
   assert json.loads(rec["payload"])["trace"]["trace_id"] == tid
 
 
+def test_trace_survives_dlq_retry_back_to_rotation(tmp_path):
+  """Regression (ISSUE 16 satellite): `queue dlq retry` returns the
+  quarantined payload to rotation VERBATIM — the re-leased task still
+  carries the trace id minted at enqueue, so `fleet trace` follows ONE
+  id across enqueue → failures → DLQ → retry → completion."""
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  task = FailTask()
+  tid = task._trace["trace_id"]
+  q.insert(task)
+
+  got = q.lease(seconds=0.01)
+  q.nack(got[1], "boom")  # budget exhausted -> DLQ
+  assert q.dlq_count == 1
+
+  assert q.dlq_retry() == 1
+  got = q.lease(seconds=30)
+  assert got is not None
+  retried, token = got
+  # same trace identity AND a fresh delivery budget
+  assert retried._trace["trace_id"] == tid
+  assert serialize(retried) == serialize(task)
+  assert q.delete(token)
+  assert q.dlq_count == 0 and q.enqueued == 0
+
+
 def test_sampling_zero_disables_span_allocation(tmp_path, monkeypatch):
   monkeypatch.setenv("IGNEOUS_TRACE_SAMPLE", "0")
   t = TouchFileTask(path=str(tmp_path / "f"))

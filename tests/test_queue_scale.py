@@ -240,6 +240,32 @@ def test_exhausted_segment_expands_to_per_task_dlq(tmp_path):
   assert done == 3 and q.completed == 3
 
 
+def test_segment_dlq_retry_preserves_trace_lineage(tmp_path):
+  """Regression (ISSUE 16 satellite): segment expansion to per-task DLQ
+  entries and the subsequent `dlq retry` both move payloads VERBATIM —
+  every re-leased task carries the trace id minted at enqueue, so
+  `fleet trace` follows one id per task across the range-lease path,
+  quarantine, and retry."""
+  q = FileQueue(f"fq://{tmp_path}/q", max_deliveries=1)
+  tasks = [PrintTask(f"t{i}") for i in range(3)]
+  tids = {t._trace["trace_id"] for t in tasks}
+  assert len(tids) == 3
+  q.insert_batch(tasks, total=3)
+
+  got = q.lease_batch(seconds=0.05, max_tasks=3)
+  assert len(got) == 3
+  time.sleep(0.1)
+  assert q.lease_batch(60, max_tasks=3) == []  # budget spent -> DLQ
+  assert q.dlq_count == 3
+
+  assert q.dlq_retry() == 3
+  seen = set()
+  while (leased := q.lease(60)) is not None:
+    seen.add(leased[0]._trace["trace_id"])
+    assert q.delete(leased[1])
+  assert seen == tids
+
+
 # -- recycle throttle --------------------------------------------------------
 
 def test_recycle_scan_is_throttled(tmp_path, monkeypatch):

@@ -8,6 +8,7 @@ storage round-trips)."""
 import gzip
 import http.client
 import json
+import os
 import threading
 
 import numpy as np
@@ -182,6 +183,89 @@ def test_ssd_spill_roundtrip_identity(rng, tmp_path):
     _, h2, b2 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
     assert h2["X-Igneous-Cache"] == "ssd"
     assert b1 == b2 == stored
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# integrity (ISSUE 16): corrupt bytes never served, never cached
+
+
+def test_ssd_restart_spot_verify_evicts_corrupt_spill(rng, tmp_path):
+  """The SSD tier trusts its mtime-seeded index on restart — unless the
+  spilled bytes fail the spot-verify on promotion, in which case the
+  entry is evicted and the chunk refetched from origin (satellite of
+  ISSUE 16; a node crash mid-spill must not poison every restart)."""
+  from igneous_tpu import telemetry
+
+  path = "mem://serve/ssdverify"
+  _seed(path, rng)
+  stored, _ = CloudFiles(path).get_stored(CHUNK)
+  ssd = str(tmp_path / "spill")
+
+  srv = _serve({"sv": path}, ram_mb=0.0, ssd_dir=ssd, ssd_mb=64.0)
+  try:
+    port = srv.server_address[1]
+    _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})  # spill
+    _, h, _ = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h["X-Igneous-Cache"] == "ssd"
+  finally:
+    srv.shutdown()
+
+  # corrupt the spilled file at rest (torn write / bit rot on the node)
+  spilled = [
+    os.path.join(root, name)
+    for root, _dirs, names in os.walk(ssd) for name in names
+  ]
+  assert spilled, "nothing spilled to the SSD tier"
+  for full in spilled:
+    raw = open(full, "rb").read()
+    with open(full, "wb") as f:
+      f.write(raw[: max(1, len(raw) // 2)])
+
+  before = telemetry.counters_snapshot().get(
+    "serve.cache.ssd.verify_failed", 0)
+  srv = _serve({"sv": path}, ram_mb=0.0, ssd_dir=ssd, ssd_mb=64.0)
+  try:
+    port = srv.server_address[1]
+    status, h1, b1 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    # the corrupt spill was evicted, the chunk refetched from origin —
+    # the client sees the true bytes, never the damaged ones
+    assert status == 200 and b1 == stored
+    assert h1["X-Igneous-Cache"] == "origin"
+    after = telemetry.counters_snapshot()["serve.cache.ssd.verify_failed"]
+    assert after > before
+    # the refetch respilled a GOOD copy: next hit serves from ssd again
+    _, h2, b2 = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert h2["X-Igneous-Cache"] == "ssd" and b2 == stored
+  finally:
+    srv.shutdown()
+
+
+def test_corrupt_origin_chunk_is_404_not_cached(rng):
+  """A chunk that fails decompression on the fill path must 404 without
+  populating any cache tier — and once the origin heals, the next
+  request serves the good bytes (nothing poisoned)."""
+  from igneous_tpu import telemetry
+
+  path = "mem://serve/fillguard"
+  _seed(path, rng)
+  cf = CloudFiles(path)
+  stored, method = cf.get_stored(CHUNK)
+  assert method == "gzip"
+  cf.put_stored(CHUNK, stored[: len(stored) // 2], "gzip")  # torn origin
+
+  srv = _serve({"fg": path})
+  try:
+    port = srv.server_address[1]
+    before = telemetry.counters_snapshot().get("serve.fetch.corrupt", 0)
+    status, _h, _b = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert status == 404
+    assert telemetry.counters_snapshot()["serve.fetch.corrupt"] > before
+
+    cf.put_stored(CHUNK, stored, "gzip")  # origin healed
+    status, _h, body = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+    assert status == 200 and body == stored
   finally:
     srv.shutdown()
 

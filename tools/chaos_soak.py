@@ -77,6 +77,11 @@ def layer_bytes(root):
     rel = os.path.relpath(full, root)
     if rel.startswith("provenance"):
       continue
+    if rel.startswith("integrity"):
+      # write-envelope sidecars (ISSUE 16): manifest segment names and
+      # record timestamps are run-specific by design; chunk bytes are
+      # the identity claim
+      continue
     with open(full, "rb") as f:
       out[rel] = f.read()
   return out
@@ -198,6 +203,110 @@ def run_faults_scenario(scratch, img, seed):
     "faults_injected": injected,
     "dlq_poison_deliveries": poison["deliveries"],
     "byte_identical": True,
+  }
+
+
+def run_corruption_scenario(scratch, img, seed):
+  """ISSUE 16 acceptance: seeded torn writes + bit flips land silently
+  mid-campaign (the producing tasks succeed; nothing reads the damage
+  back during the run), then `igneous audit` must name EVERY injected
+  fault — no more, no less — heal must converge, and the healed layer
+  must be byte-identical to a clean run."""
+  from igneous_tpu import integrity
+  from igneous_tpu.queues import LocalTaskQueue
+  from igneous_tpu.storage import COMPRESSION_EXTS
+  from igneous_tpu.task_creation.audit import (
+    create_integrity_audit_tasks,
+    downsample_provenance,
+    downsample_repair_tasks,
+    load_findings,
+  )
+  from igneous_tpu.volume import Volume as Vol
+
+  with pipeline_disabled():
+    _, clean = run_pipeline(
+      os.path.join(scratch, "cor-clean"), img, tag="cor-clean"
+    )
+
+  # Deterministic injection: the regex picks the x=0,y=0 column of output
+  # chunks (only task outputs look like "<mip dir>/<bbox>"; queue/journal/
+  # provenance writes don't match, and the mip-0 ingest runs before chaos
+  # wraps the backends anyway). torn_write=0.5 + bit_flip=1.0 means every
+  # matching put is corrupted — a seeded mix of the two modes — while
+  # off-column chunks stay clean, so the exact-match assert below tests
+  # both completeness (every fault found) AND precision (no false
+  # positives on clean chunks). max_faults_per_key=1: each damaged key is
+  # damaged exactly once, so `injected` is the exact ground truth.
+  cfg = ChaosConfig(
+    seed=seed,
+    torn_write=0.5,
+    bit_flip=1.0,
+    corrupt_key_re=r"^\d+_\d+_\d+/0-\d+_0-\d+_",
+    max_faults_per_key=1,
+  )
+  workdir = os.path.join(scratch, "cor-chaos")
+  _, _ = run_pipeline(workdir, img, chaos_cfg=cfg, tag="cor-chaos")
+  integrity.flush_all()
+
+  assert cfg.injected, "corruption scenario injected nothing — re-seed"
+  exts = tuple(e for e in COMPRESSION_EXTS.values() if e)
+  injected_keys = set()
+  for _op, key in cfg.injected:
+    for ext in exts:
+      if key.endswith(ext):
+        key = key[: -len(ext)]
+        break
+    injected_keys.add(key)
+
+  layer = f"file://{workdir}/layer"
+  report_dir = f"{layer}/integrity/audit"
+  prov = downsample_provenance(Vol(layer, mip=0))
+  assert prov is not None, "downsample campaign left no provenance"
+  mips = range(int(prov["mip"]) + 1, int(prov["mip"]) + int(prov["num_mips"]) + 1)
+
+  def audit_round():
+    for mip in mips:
+      LocalTaskQueue(parallel=1, progress=False).insert(
+        create_integrity_audit_tasks(layer, mip, report_dir)
+      )
+    return load_findings(report_dir)
+
+  findings, totals = audit_round()
+  detected = {f["key"] for f in findings}
+  assert detected == injected_keys, (
+    f"audit missed or invented faults: "
+    f"missed={sorted(injected_keys - detected)[:5]} "
+    f"extra={sorted(detected - injected_keys)[:5]}"
+  )
+
+  repairs, unhealable = downsample_repair_tasks(layer, findings)
+  assert not unhealable, f"unhealable findings: {unhealable[:3]}"
+  assert repairs, "findings produced no repair tasks"
+  LocalTaskQueue(parallel=1, progress=False).insert(repairs)
+  integrity.flush_all()  # repair puts must reach the manifests pre-re-audit
+
+  refindings, _ = audit_round()
+  assert not refindings, f"heal did not converge: {refindings[:3]}"
+
+  chaos = layer_bytes(os.path.join(workdir, "layer"))
+  missing = sorted(set(clean) - set(chaos))
+  extra = sorted(set(chaos) - set(clean))
+  assert not missing and not extra, (
+    f"key sets differ after heal: missing={missing[:5]} extra={extra[:5]}"
+  )
+  diff = [k for k in clean if clean[k] != chaos[k]]
+  assert not diff, f"{len(diff)} objects differ post-heal: {diff[:5]}"
+
+  counters = telemetry.counters_snapshot()
+  return {
+    "objects_compared": len(clean),
+    "faults_injected": len(cfg.injected),
+    "torn_writes": counters.get("chaos.torn_write", 0),
+    "bit_flips": counters.get("chaos.bit_flip", 0),
+    "findings": len(findings),
+    "repair_tasks": len(repairs),
+    "audited_chunks": totals["chunks"],
+    "healed_byte_identical": True,
   }
 
 
@@ -527,12 +636,16 @@ def main():
   ap.add_argument("--keep", action="store_true",
                   help="keep the scratch dir for inspection")
   ap.add_argument("--scenario",
-                  choices=("faults", "preemption", "stall", "all"),
+                  choices=("faults", "preemption", "stall", "corruption",
+                           "all"),
                   default="faults",
                   help="faults: ISSUE 1 storage/queue fault storm; "
                        "preemption: ISSUE 2 worker kill storm + zombie; "
                        "stall: ISSUE 6 stalled worker + backlog -> "
-                       "`fleet check` must flag it")
+                       "`fleet check` must flag it; "
+                       "corruption: ISSUE 16 silent at-rest damage -> "
+                       "audit names every fault, heal converges "
+                       "byte-identically")
   ap.add_argument("--trace-out", default=None,
                   help="write a Perfetto/Chrome trace JSON of the "
                        "preemption storm's merged journal here (CI "
@@ -578,6 +691,8 @@ def main():
       report["stall"] = run_stall_health_scenario(
         scratch, args.seed, health_out=args.health_out
       )
+    if args.scenario in ("corruption", "all"):
+      report["corruption"] = run_corruption_scenario(scratch, img, args.seed)
     report["counters"] = telemetry.counters_snapshot()
     report["wall_s"] = round(time.monotonic() - t0, 2)
     print(json.dumps(report, indent=2))
