@@ -1141,6 +1141,145 @@ def bench_queue():
   )
 
 
+def bench_campaign_survival():
+  """Campaign survival (ISSUE 17): end-to-end voxel throughput of a
+  range-leased downsample campaign under the closed-loop driver — a
+  clean run vs a hostile one where a live range holder is frozen
+  mid-lease (SIGSTOP) and its tail is rescued by straggler speculation
+  before the zombie wakes into the fence. Identical task grids and
+  fleet policy, so hostile/clean is the measured price of the storm
+  WITH survival on. Returns (hostile_voxps, clean_voxps, spec_issued)."""
+  import shutil
+  import signal
+  import tempfile
+
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.observability import autoscale, campaign, fleet, health
+  from igneous_tpu.observability import journal as journal_mod
+  from igneous_tpu.queues import FileQueue
+  from igneous_tpu.tasks import SleepTask
+  from igneous_tpu.volume import Volume
+
+  edge = 96 if QUICK else 128
+  img = np.random.default_rng(17).integers(
+    0, 255, (edge, edge, 64)
+  ).astype(np.uint8)
+  n_sleeps = 8 if QUICK else 16
+
+  def run_campaign(root, hostile):
+    layer = f"file://{root}/layer"
+    Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
+    tasks = list(tc.create_downsampling_tasks(
+      layer, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
+    ))
+    # interleaved SleepTasks stretch the campaign across enough driver
+    # ticks for the freeze to land mid-range (same trick as the soak)
+    tasks += [SleepTask(seconds=0.4) for _ in range(n_sleeps)]
+    spec = f"fq://{root}/q"
+    prev_shards = knobs.raw("IGNEOUS_QUEUE_SHARDS")
+    os.environ["IGNEOUS_QUEUE_SHARDS"] = "3"
+    try:
+      q = FileQueue(spec, max_deliveries=25)
+      n_tasks = q.insert_batch(tasks, total=len(tasks))
+    finally:
+      if prev_shards is None:
+        os.environ.pop("IGNEOUS_QUEUE_SHARDS", None)
+      else:
+        os.environ["IGNEOUS_QUEUE_SHARDS"] = prev_shards
+    jpath = journal_mod.journal_path_for(q, spec)
+    env = {
+      "JAX_PLATFORMS": "cpu",
+      "PYTHONPATH": (
+        _REPO_DIR + os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else _REPO_DIR
+      ),
+      "IGNEOUS_JOURNAL_FLUSH_SEC": "0.2",
+      "IGNEOUS_STEAL": "1",
+      "IGNEOUS_STEAL_MIN_HELD_SEC": "1.0",
+      "IGNEOUS_SPECULATE_MIN_HELD_SEC": "0",
+    }
+    actuator = autoscale.LocalPoolActuator(
+      spec, worker_args=["--lease-sec", "20", "--batch", "4"],
+      env=env, grace_sec=60.0,
+    )
+    runner = campaign.CampaignRunner(
+      jpath, q, actuator,
+      policy=autoscale.AutoscalePolicy(
+        min_workers=2, max_workers=3, horizon_sec=5.0,
+        hysteresis=0.2, cooldown_sec=1.0, step_max=2,
+      ),
+      health_config=health.HealthConfig(stall_sec=3.0),
+      tick_sec=1.0, speculate=True, max_wall_sec=120.0,
+    )
+    state = {"tick": 0, "stalled": 0, "stopped": None, "resume_at": 0}
+
+    def chaos_sleep(dt):
+      state["tick"] += 1
+      actuator.reap()
+      procs = [p for p in actuator.procs if p.poll() is None]
+      if procs and not state["stalled"]:
+        holders = set()
+        for r in q.range_leases():
+          h = r.get("holder") or ""
+          if not r.get("expired") and "-" in h:
+            try:
+              holders.add(int(h.rsplit("-", 1)[1]))
+            except ValueError:
+              pass
+        victims = [p for p in procs if p.pid in holders]
+        if victims:
+          victims[0].send_signal(signal.SIGSTOP)
+          state.update(stalled=1, stopped=victims[0],
+                       resume_at=state["tick"] + 6)
+      if state["stopped"] is not None and state["tick"] >= state["resume_at"]:
+        state["stopped"].send_signal(signal.SIGCONT)
+        state["stopped"] = None
+      time.sleep(dt)
+
+    prev_spec = knobs.raw("IGNEOUS_SPECULATE_MIN_HELD_SEC")
+    os.environ["IGNEOUS_SPECULATE_MIN_HELD_SEC"] = "0"
+    try:
+      runner.run(sleep_fn=chaos_sleep if hostile else time.sleep)
+    finally:
+      if state["stopped"] is not None:
+        state["stopped"].send_signal(signal.SIGCONT)
+      if prev_spec is None:
+        os.environ.pop("IGNEOUS_SPECULATE_MIN_HELD_SEC", None)
+      else:
+        os.environ["IGNEOUS_SPECULATE_MIN_HELD_SEC"] = prev_spec
+    assert q.completed == n_tasks, (
+      f"completions drifted: tally={q.completed} tasks={n_tasks}"
+    )
+    if hostile:
+      assert state["stalled"], "freeze never landed: hostile == clean"
+    records = fleet.load_effective(jpath)
+    task_spans = [
+      r for r in records
+      if r.get("kind") == "span" and r.get("name") == "task"
+    ]
+    # completions-tally mtime is the instant the last FIRST-resolution
+    # landed; the waking zombie's fenced acks never touch it
+    makespan = (
+      os.path.getmtime(os.path.join(q.path, "completions"))
+      - min(r["ts"] for r in task_spans)
+    )
+    counters = fleet.status(records)["counters"]
+    return img.size / max(makespan, 1e-9), counters
+
+  root = tempfile.mkdtemp(prefix="bench_campaign_")
+  try:
+    clean_rate, _ = run_campaign(os.path.join(root, "clean"), hostile=False)
+    hostile_rate, counters = run_campaign(
+      os.path.join(root, "hostile"), hostile=True
+    )
+  finally:
+    shutil.rmtree(root, ignore_errors=True)
+  return (
+    round(hostile_rate, 1), round(clean_rate, 1),
+    int(counters.get("speculation.issued", 0)),
+  )
+
+
 def _skip(reason: str) -> dict:
   """Explicit not-run marker (ISSUE 6 satellite): a gated metric records
   WHY it has no number, so the BENCH trajectory distinguishes "skipped
@@ -1249,6 +1388,8 @@ def run_bench(platform: str):
   cseg_speedup = bench_cseg_speedup()
   (queue_enqueue_rate, queue_lease_rate,
    queue_status_ms, queue_classic_rate) = bench_queue()
+  (campaign_hostile_rate, campaign_clean_rate,
+   campaign_spec_issued) = bench_campaign_survival()
   xfer_passthrough, xfer_decode = bench_transfer_passthrough(seg)
   serve_stats = bench_serve(seg)
 
@@ -1350,6 +1491,18 @@ def run_bench(platform: str):
         round(queue_enqueue_rate / queue_classic_rate, 1)
         if queue_classic_rate else _skip("classic enqueue measured zero")
       ),
+      # ISSUE 17: campaign survival — identical range-leased downsample
+      # campaigns under the closed-loop driver, clean vs hostile (a
+      # range holder frozen mid-lease, tail rescued by speculation);
+      # the ratio is the storm's measured throughput tax with survival on
+      "campaign_hostile_voxps": campaign_hostile_rate,
+      "campaign_clean_voxps": campaign_clean_rate,
+      "campaign_survival_retention": (
+        round(campaign_hostile_rate / campaign_clean_rate, 3)
+        if campaign_clean_rate
+        else _skip("clean campaign measured zero")
+      ),
+      "campaign_speculation_issued": campaign_spec_issued,
       "transfer_passthrough_voxps": xfer_passthrough,
       "transfer_decode_voxps": xfer_decode,
       "transfer_passthrough_speedup": (

@@ -60,8 +60,8 @@ _REGISTRY: Dict[str, Knob] = {}
 # section display order for the generated README table
 SECTIONS = (
   "pipeline", "chunk cache", "device kernels", "paged batching",
-  "multihost", "worker lifecycle", "retry", "queue", "storage",
-  "integrity", "serve",
+  "multihost", "worker lifecycle", "retry", "queue", "campaign survival",
+  "storage", "integrity", "serve",
   "journal", "trace / metrics / profile", "health / SLO", "autoscale",
   "simulator", "misc",
 )
@@ -176,6 +176,51 @@ _knob("IGNEOUS_QUEUE_SEG_TASKS", "int", 1024,
 _knob("IGNEOUS_QUEUE_RECYCLE_SEC", "float", 5.0,
       "min interval between expired-lease scans on lease(); 0 scans "
       "every call (forced when the pending pool looks drained)", "queue")
+
+# --- campaign survival (ISSUE 17) ------------------------------------------
+_knob("IGNEOUS_SPECULATE_MIN_TASKS", "int", 1,
+      "smallest range-lease tail worth double-issuing as a speculative "
+      "twin", "campaign survival")
+_knob("IGNEOUS_SPECULATE_MAX_TWINS", "int", 4,
+      "max new speculation pairs per `speculate_flagged` sweep",
+      "campaign survival")
+_knob("IGNEOUS_SPECULATE_MIN_HELD_SEC", "float", 0.0,
+      "a flagged worker's lease must be at least this old before its "
+      "tail is twinned", "campaign survival")
+_knob("IGNEOUS_SPECULATE_TAIL_RATIO", "float", 1.5,
+      "campaign runner: speculate a lease whose projected finish (tail "
+      "size / holder rate) exceeds ratio x the fleet p95 projection",
+      "campaign survival")
+_knob("IGNEOUS_SPECULATE_WASTE_MAX", "float", 0.5,
+      "`speculation_storm` health anomaly: fenced/issued wasted-work "
+      "ratio ceiling", "campaign survival")
+_knob("IGNEOUS_SPECULATE_MIN_ISSUED", "int", 8,
+      "min issued speculations before the storm detector fires",
+      "campaign survival")
+_knob("IGNEOUS_STEAL", "bool", False,
+      "idle lease-batcher workers claim unstarted sub-ranges off "
+      "long-held range leases (pull-model work stealing)",
+      "campaign survival")
+_knob("IGNEOUS_STEAL_MIN_TASKS", "int", 2,
+      "smallest unstarted tail a holder will grant (and the smallest "
+      "foreign range a thief will claim)", "campaign survival")
+_knob("IGNEOUS_STEAL_MIN_HELD_SEC", "float", 2.0,
+      "a range must be held this long before a thief may claim it",
+      "campaign survival")
+_knob("IGNEOUS_STEAL_FRACTION", "float", 0.5,
+      "fraction of the holder's unstarted tail a serviced claim "
+      "releases", "campaign survival")
+_knob("IGNEOUS_STEAL_CLAIM_TTL_SEC", "float", 300.0,
+      "unserviced steal claims recycle after this long (holder died "
+      "before its heartbeat saw the claim)", "campaign survival")
+_knob("IGNEOUS_CAMPAIGN_TICK_SEC", "float", 5.0,
+      "`igneous campaign run` control-loop period", "campaign survival")
+_knob("IGNEOUS_CAMPAIGN_MAX_WALL_SEC", "float", 0.0,
+      "campaign runner wall-clock safety valve (0 = unlimited)",
+      "campaign survival")
+_knob("IGNEOUS_CAMPAIGN_SPECULATE", "bool", True,
+      "campaign runner double-issues flagged/slow-tail leases",
+      "campaign survival")
 
 # --- storage --------------------------------------------------------------
 _knob("IGNEOUS_SCRATCH_COMPRESS", "str", "",
@@ -355,6 +400,12 @@ _knob("IGNEOUS_SIM_MAX_SEC", "float", 30 * 24 * 3600.0,
 _knob("IGNEOUS_SIM_RANGE_LEASE", "int", 0,
       "1 = simulate range-lease rounds (one shared lease per batch); "
       "0 = classic per-member leases", "simulator")
+_knob("IGNEOUS_SIM_SPECULATE", "int", 0,
+      "1 = model straggler speculation (duplicate-issue + first-ack-"
+      "wins fencing) in range-lease mode", "simulator")
+_knob("IGNEOUS_SIM_STEAL", "int", 0,
+      "1 = model idle-worker steal splits of long-held ranges in "
+      "range-lease mode", "simulator")
 
 # --- misc -----------------------------------------------------------------
 _knob("IGNEOUS_TPU_NO_NATIVE", "bool", False,

@@ -30,10 +30,11 @@ from .findings import Context, Finding, filter_suppressed
 PASS_ID = "telemetry"
 
 SUBSYSTEMS = frozenset({
-  "autoscale", "chaos", "chunk_cache", "device", "dlq", "drain",
-  "fleet", "health", "infer", "integrity", "journal", "metrics",
-  "pipeline", "queue", "retries", "rollup", "serve", "sim", "slo",
-  "storage", "tasks", "transfer", "worker", "zombie",
+  "autoscale", "campaign", "chaos", "chunk_cache", "device", "dlq",
+  "drain", "fleet", "health", "infer", "integrity", "journal",
+  "metrics", "pipeline", "queue", "retries", "rollup", "serve", "sim",
+  "slo", "speculation", "steal", "storage", "tasks", "transfer",
+  "worker", "zombie",
 })
 
 # the telemetry implementation itself forwards caller-supplied names

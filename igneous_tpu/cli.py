@@ -2125,6 +2125,15 @@ def fleet_status(queue_spec, journal_path, as_json):
     click.echo(f"stall ratio: {st['stall_ratio']}")
   click.echo(f"zombie fences: {st['zombie_fences']}  "
              f"dlq promoted: {st['dlq_promoted']}")
+  surv = {
+    k: v for k, v in st["counters"].items()
+    if k.startswith(("speculation.", "steal."))
+  }
+  if surv:
+    click.echo("campaign survival: " + "  ".join(
+      f"{k.split('.', 1)[1] if k.startswith('speculation.') else k} {v}"
+      for k, v in sorted(surv.items())
+    ))
   click.echo("stage                                count   total_s  "
              "p50_ms   p95_ms")
   for name, s in st["stages"].items():
@@ -2499,6 +2508,13 @@ def _policy_from_opts(min_workers, max_workers, as_horizon_sec, hysteresis,
                    "'preempt=1,kill=1,stragglers=2,stall=1'. Keys: "
                    "preempt, preempt_at, kill, kill_at, stragglers, "
                    "straggler_factor, stall, stall_at.")
+@click.option("--speculate", "sim_speculate", is_flag=True, default=False,
+              help="Model cross-host straggler speculation (duplicate-issue "
+                   "with first-ack-wins fencing) [default: "
+                   "$IGNEOUS_SIM_SPECULATE].")
+@click.option("--steal", "sim_steal", is_flag=True, default=False,
+              help="Model idle-worker work stealing (unstarted tails carved "
+                   "off long-held rounds) [default: $IGNEOUS_SIM_STEAL].")
 @click.option("--what-if", "what_if_spec", default=None,
               help="Comma-separated alternative worker counts to forecast "
                    "alongside the base run, e.g. '1,8,32'.")
@@ -2519,8 +2535,9 @@ def fleet_simulate(queue_spec, journal_path, mine_path, model_path,
                    save_model_path, workers, tasks, seed, batch_size,
                    fail_scale, policy_mode, min_workers, max_workers,
                    as_horizon_sec, hysteresis, cooldown_sec, step_max,
-                   chaos_spec, what_if_spec, cost_per_worker_hour,
-                   emit_path, base_ts, as_json, out_path):
+                   chaos_spec, sim_speculate, sim_steal, what_if_spec,
+                   cost_per_worker_hour, emit_path, base_ts, as_json,
+                   out_path):
   """Forecast a campaign on virtual workers from mined journal history.
 
   Mines per-task-type empirical distributions (durations with their
@@ -2553,6 +2570,8 @@ def fleet_simulate(queue_spec, journal_path, mine_path, model_path,
     workers=workers, seed=seed, tasks=tasks, batch_size=batch_size,
     fail_scale=fail_scale, base_ts=base_ts,
     cost_per_worker_hour=cost_per_worker_hour,
+    speculate=1 if sim_speculate else None,
+    steal=1 if sim_steal else None,
   )
   cfg.chaos = chaos
   cfg.autoscale = policy_mode == "auto"
@@ -2606,6 +2625,14 @@ def fleet_simulate(queue_spec, journal_path, mine_path, model_path,
     f"released {r['released']}"
     + (f"  cost ${r['cost_usd']}" if r["cost_usd"] is not None else "")
   )
+  spec, steals = r.get("speculation") or {}, r.get("steals") or {}
+  if spec.get("issued") or steals.get("claims"):
+    click.echo(
+      f"  campaign survival: speculated {spec.get('issued', 0)} "
+      f"(won {spec.get('won', 0)}, fenced {spec.get('fenced', 0)})  "
+      f"steals {steals.get('claims', 0)} "
+      f"({steals.get('tasks', 0)} task(s))"
+    )
   if r["scale_events"]:
     click.echo(f"  scale events: {len(r['scale_events'])} "
                f"(peak {r['peak_workers']} workers)")
@@ -2773,6 +2800,127 @@ def fleet_autoscale(queue_spec, journal_path, min_workers, max_workers,
     summary["drained"] = actuator.stats["drained"]
     summary["exits"] = actuator.stats["exits"]
   click.echo(json_mod.dumps(summary))
+
+
+# closed-loop campaign driver (ISSUE 17)
+
+
+@main.group("campaign")
+def campaign_group():
+  """Closed-loop campaign survival: autoscale + speculation + stealing.
+
+  One driver process per campaign: each tick sizes the fleet from the
+  journal (the `fleet autoscale` loop), publishes straggler flags, and
+  twins the unfinished tails of range leases held by flagged or
+  journal-projected-slow workers (first ack wins, losers are zombie-
+  fenced, completions never double-count)."""
+
+
+@campaign_group.command("run")
+@_journal_opts
+@_autoscale_policy_opts
+@click.option("--tick-sec", default=None, type=float,
+              help="Seconds between driver ticks "
+                   "[default: $IGNEOUS_CAMPAIGN_TICK_SEC or 5].")
+@click.option("--max-wall-sec", default=None, type=float,
+              help="Abort (gracefully) after this much wall clock "
+                   "[default: $IGNEOUS_CAMPAIGN_MAX_WALL_SEC; 0 = never].")
+@click.option("--iterations", default=None, type=int,
+              help="Tick N times then exit [default: until drained].")
+@click.option("--speculate/--no-speculate", "speculate", default=None,
+              help="Twin the tails of flagged/slow holders' range leases "
+                   "[default: $IGNEOUS_CAMPAIGN_SPECULATE or on].")
+@click.option("--steal/--no-steal", "steal", default=None,
+              help="Let idle workers claim unstarted sub-ranges off "
+                   "long-held range leases [default: $IGNEOUS_STEAL "
+                   "or off].")
+@click.option("--worker-arg", "worker_args", multiple=True,
+              help="Extra args for spawned workers, repeatable "
+                   "(e.g. --worker-arg=--batch-size=4).")
+@click.option("--actuator", "actuator_kind",
+              type=click.Choice(["local", "textfile", "command"]),
+              default="local", show_default=True,
+              help="How scale actions reach the fleet (see fleet "
+                   "autoscale).")
+@click.option("--target-file", default=None,
+              help="Path for --actuator textfile.")
+@click.option("--scale-command", default=None,
+              help="Template for --actuator command with {n}.")
+@click.option("--json", "as_json", is_flag=True,
+              help="One JSON object per tick + a summary object.")
+def campaign_run(queue_spec, journal_path, min_workers, max_workers,
+                 as_horizon_sec, hysteresis, cooldown_sec, step_max,
+                 tick_sec, max_wall_sec, iterations, speculate, steal,
+                 worker_args, actuator_kind, target_file, scale_command,
+                 as_json):
+  """Run a campaign to completion on a hostile fleet.
+
+  Glues the survival layer into one loop: autoscale sizes the fleet,
+  health flags route queue depth away from stragglers, speculation
+  twins their unfinished tails, and (with --steal) idle workers carve
+  unstarted sub-ranges off long-held leases. Exits when the queue is
+  drained — no backlog, no outstanding leases, pool at the floor."""
+  import json as json_mod
+  import time as time_mod
+
+  from . import secrets
+  from .observability import autoscale, campaign as campaign_mod
+  from .queues import TaskQueue
+
+  queue_spec = queue_spec or secrets.queue_url()
+  if not queue_spec:
+    raise click.UsageError("campaign run needs a queue (-q or $QUEUE_URL)")
+  path = _journal_location(queue_spec, journal_path)
+  policy = _policy_from_opts(min_workers, max_workers, as_horizon_sec,
+                             hysteresis, cooldown_sec, step_max)
+  worker_env = {}
+  if steal is not None:
+    # ship the steal knob into every worker this driver spawns; the
+    # driver process itself never steals (it holds no leases)
+    knobs.set_env("IGNEOUS_STEAL", "1" if steal else "0")
+    worker_env["IGNEOUS_STEAL"] = "1" if steal else "0"
+  if actuator_kind == "local":
+    actuator = autoscale.LocalPoolActuator(
+      queue_spec, worker_args=list(worker_args), env=worker_env or None,
+    )
+  elif actuator_kind == "textfile":
+    if not target_file:
+      raise click.UsageError("--actuator textfile needs --target-file")
+    actuator = autoscale.TextfileActuator(target_file)
+  else:
+    if not scale_command:
+      raise click.UsageError("--actuator command needs --scale-command")
+    actuator = autoscale.CommandActuator(scale_command)
+
+  runner = campaign_mod.CampaignRunner(
+    path, TaskQueue(queue_spec), actuator,
+    policy=policy, tick_sec=tick_sec, speculate=speculate,
+    max_wall_sec=max_wall_sec,
+  )
+
+  def narrate(sleep_sec):
+    d = runner.history[-1]
+    if as_json:
+      click.echo(json_mod.dumps(d))
+    else:
+      extras = ""
+      if d["speculated"]:
+        extras += f"  speculated {d['speculated']}"
+      if d["flagged"]:
+        extras += f"  flagged {','.join(d['flagged'])}"
+      click.echo(
+        f"[{time_mod.strftime('%H:%M:%S')}] backlog {d['backlog']}  "
+        f"workers {d['current']} -> {d['target']} ({d['reason']})"
+        + extras
+      )
+    time_mod.sleep(sleep_sec)
+
+  summary = runner.run(iterations=iterations, sleep_fn=narrate)
+  click.echo(json_mod.dumps(summary if as_json else {
+    k: v for k, v in summary.items() if k != "fleet_status"
+  }))
+  if summary["timed_out"] or summary["queue"].get("enqueued", 0) > 0:
+    raise SystemExit(3)
 
 
 # on-demand profiler capture (ISSUE 7)

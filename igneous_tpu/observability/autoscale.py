@@ -392,6 +392,11 @@ class AutoscaleController:
     if records:
       report = self.engine.evaluate(records, queue_stats, now=now)
       per_worker_rate = report["autoscale"]["per_worker_tasks_per_sec"]
+    # the last health report + raw records, for composers (the campaign
+    # runner writes flags / drives speculation off the SAME evaluation
+    # this tick actuated on, without a second journal load)
+    self.last_report = report
+    self.last_records = records
     current = self.actuator.current()
     decision = self.loop.decide(backlog, per_worker_rate, current, now)
     decision["ts"] = now

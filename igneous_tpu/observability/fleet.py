@@ -239,6 +239,36 @@ def render_trace(spans: List[dict]) -> List[str]:
   return lines
 
 
+def worker_rates(records: Iterable[dict], window_sec: Optional[float] = None,
+                 now: Optional[float] = None) -> dict:
+  """Per-worker throughput (successful task spans per BUSY second, i.e.
+  1/mean task duration) mined from the journal — the relative-speed
+  signal behind throughput-weighted partitioning (ISSUE 17):
+  ``page_partition(weights=...)`` hands a slow host proportionally less
+  of the page table up front, and the campaign runner projects a range
+  lease's tail against the fleet p95 of these rates to decide when to
+  speculate. Busy-time (not wall-clock) rates, so an idle-but-fast
+  worker isn't mistaken for a straggler. ``window_sec`` restricts to
+  recent spans (skew-guarded like :func:`journal_throughput`)."""
+  now = time.time() if now is None else now
+  per: dict = defaultdict(lambda: [0, 0.0])  # worker -> [n_ok, busy_s]
+  for rec in iter_task_spans(records):
+    if rec.get("error"):
+      continue
+    ts, dur = rec.get("ts"), rec.get("dur")
+    if ts is None or dur is None or dur <= 0:
+      continue
+    if window_sec is not None:
+      if ts < now - window_sec or ts > now + CLOCK_SKEW_TOLERANCE_SEC:
+        continue
+    acc = per[rec.get("worker") or "local"]
+    acc[0] += 1
+    acc[1] += float(dur)
+  return {
+    w: n / busy for w, (n, busy) in per.items() if n > 0 and busy > 0
+  }
+
+
 # a segment timestamped further than this into the future is a skewed
 # worker clock, not data: counting it would stretch the throughput
 # window to a time that hasn't happened yet

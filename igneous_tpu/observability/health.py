@@ -101,6 +101,12 @@ class HealthConfig:
   # means ANY detected corruption alerts (it should: every one names a
   # damaged object that needs an audit/heal pass)
   integrity_corrupt_max: float = 0.0
+  # campaign survival (ISSUE 17): speculation storm = the fenced share
+  # of issued twins above this ceiling (the fleet keeps double-running
+  # work the original holder finishes first — insurance premiums with
+  # no payout), once at least min_issued twins give the ratio meaning
+  speculate_waste_max: float = 0.5
+  speculate_min_issued: int = 8
 
   _ENV = {
     "window_sec": "IGNEOUS_HEALTH_WINDOW_SEC",
@@ -125,6 +131,8 @@ class HealthConfig:
     "serve_miss_ratio_max": "IGNEOUS_SERVE_MISS_RATIO",
     "serve_min_requests": "IGNEOUS_SERVE_MIN_REQUESTS",
     "integrity_corrupt_max": "IGNEOUS_HEALTH_INTEGRITY_MAX",
+    "speculate_waste_max": "IGNEOUS_SPECULATE_WASTE_MAX",
+    "speculate_min_issued": "IGNEOUS_SPECULATE_MIN_ISSUED",
   }
 
   @classmethod
@@ -148,6 +156,7 @@ class HealthConfig:
     cfg.min_workers = int(cfg.min_workers)
     cfg.max_workers = int(cfg.max_workers)
     cfg.serve_min_requests = int(cfg.serve_min_requests)
+    cfg.speculate_min_issued = int(cfg.speculate_min_issued)
     return cfg
 
 
@@ -368,6 +377,26 @@ class HealthEngine:
         "kind": "zombie_rate", "zombie_fences": zombies,
         "rate": round(zombies / denom, 3), "max": cfg.zombie_rate_max,
       })
+    # campaign survival (ISSUE 17): speculation is insurance against
+    # stragglers — a fenced twin means the original holder resolved
+    # first and the duplicate issue bought nothing. A high fenced share
+    # is a storm: the driver keeps paying premiums with no payout
+    # (mis-tuned tail ratio, or flags firing on healthy workers)
+    spec_issued = counters.get("speculation.issued", 0)
+    spec_won = counters.get("speculation.won", 0)
+    spec_fenced = counters.get("speculation.fenced", 0)
+    spec_waste = (spec_fenced / spec_issued) if spec_issued else None
+    if (
+      spec_issued >= cfg.speculate_min_issued
+      and spec_waste is not None and spec_waste > cfg.speculate_waste_max
+    ):
+      anomalies.append({
+        "kind": "speculation_storm",
+        "issued": spec_issued, "won": spec_won, "fenced": spec_fenced,
+        "waste_ratio": round(spec_waste, 3),
+        "max": cfg.speculate_waste_max,
+        "wasted_ms": counters.get("speculation.wasted_ms", 0),
+      })
     # data integrity (ISSUE 16): every corrupt read / failed
     # verify-after-write / quarantined object names at-rest damage that
     # retries cannot fix — only an audit/heal pass can
@@ -585,6 +614,19 @@ class HealthEngine:
         ),
         "p99_target_ms": cfg.serve_p99_ms,
       }
+    if spec_issued or counters.get("steal.claims", 0):
+      report["speculation"] = {
+        "issued": spec_issued,
+        "won": spec_won,
+        "fenced": spec_fenced,
+        "waste_ratio": (
+          round(spec_waste, 3) if spec_waste is not None else None
+        ),
+        "wasted_ms": counters.get("speculation.wasted_ms", 0),
+        "steal_claims": counters.get("steal.claims", 0),
+        "steal_granted": counters.get("steal.granted", 0),
+        "steal_tasks": counters.get("steal.tasks", 0),
+      }
     if corrupt_total or audit_findings:
       report["integrity"] = {
         "corrupt_reads": corrupt_reads,
@@ -626,6 +668,17 @@ def publish_gauges(report: dict) -> None:
     metrics.gauge_set("fleet.serve_p99_ms", srv["p99_ms"])
     if srv.get("miss_ratio") is not None:
       metrics.gauge_set("fleet.serve_miss_ratio", srv["miss_ratio"])
+  spec = report.get("speculation")
+  if spec:
+    # rendered by observability.prom as igneous_speculation_* — the
+    # deployment.yaml igneous-campaign PrometheusRule alerts on these
+    metrics.gauge_set("speculation.issued", spec["issued"])
+    metrics.gauge_set("speculation.won", spec["won"])
+    metrics.gauge_set("speculation.fenced", spec["fenced"])
+    if spec.get("waste_ratio") is not None:
+      metrics.gauge_set("speculation.waste_ratio", spec["waste_ratio"])
+    metrics.gauge_set("steal.claims", spec["steal_claims"])
+    metrics.gauge_set("steal.tasks", spec["steal_tasks"])
   integ = report.get("integrity")
   if integ:
     # rendered by observability.prom as igneous_integrity_* — the
@@ -785,6 +838,21 @@ def render_dashboard(report: dict, queue_stats: Optional[dict] = None,
       + (
         f"  fastpath {fp.get('batched', 0)}/{fp_total} batched"
         if fp_total else ""
+      )
+    )
+  spec = report.get("speculation")
+  if spec:
+    lines.append(
+      f"speculation: issued {spec['issued']}  won {spec['won']}  "
+      f"fenced {spec['fenced']}"
+      + (
+        f"  waste {spec['waste_ratio']}"
+        if spec.get("waste_ratio") is not None else ""
+      )
+      + (
+        f"  steal {spec['steal_granted']}/{spec['steal_claims']} grants"
+        f" ({spec['steal_tasks']} tasks)"
+        if spec["steal_claims"] else ""
       )
     )
   lines.append("")

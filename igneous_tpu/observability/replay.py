@@ -106,7 +106,7 @@ class WorkloadModel:
 
     types: Dict[str, dict] = {}
     trace_to_type: Dict[str, str] = {}
-    per_worker_durs: Dict[str, List[float]] = defaultdict(list)
+    per_worker_durs: Dict[str, List[tuple]] = defaultdict(list)
     overhead = {"count": 0, "sum": 0.0, "durs": []}
     range_sizes: List[int] = []
 
@@ -141,7 +141,7 @@ class WorkloadModel:
       st["sum"] += d
       if len(st["durs"]) < sample_cap:
         st["durs"].append(d)
-      per_worker_durs[rec.get("worker", "local")].append(d)
+      per_worker_durs[rec.get("worker", "local")].append((name, d))
 
     # second pass: byte movement + round overhead (non-task spans live
     # only in raw segments and rollup stage aggregates; bytes need the
@@ -191,17 +191,30 @@ class WorkloadModel:
     overhead["sum"] = round(overhead["sum"], 6)
     overhead["durs"] = sorted(round(d, 6) for d in overhead["durs"])
 
-    fleet_durs = sorted(
-      d for durs in per_worker_durs.values() for d in durs
-    )
-    fleet_median = _percentile(fleet_durs, 0.50)
+    # worker speed compares SAME-TYPE durations only: on a heterogeneous
+    # mix, a worker that happened to draw the quick task types is not a
+    # faster machine (one downsample-heavy worker once mined as "84×
+    # fleet speed" and poisoned every forecast built on the model). Each
+    # worker's per-type median is normalized by the fleet median for
+    # that type; its speed is the sample-count-weighted mean of ratios.
+    fleet_type_median = {
+      name: _percentile(t["durs"], 0.50)
+      for name, t in task_types.items() if t["durs"]
+    }
     speeds = []
-    if fleet_median > 0:
-      for durs in per_worker_durs.values():
-        if len(durs) >= 2:
-          speeds.append(
-            round(_percentile(sorted(durs), 0.50) / fleet_median, 4)
-          )
+    for samples in per_worker_durs.values():
+      by_type: Dict[str, List[float]] = defaultdict(list)
+      for name, d in samples:
+        by_type[name].append(d)
+      num = den = 0.0
+      for name, durs in by_type.items():
+        fm = fleet_type_median.get(name, 0.0)
+        if fm <= 0 or len(durs) < 2:
+          continue
+        num += (_percentile(sorted(durs), 0.50) / fm) * len(durs)
+        den += len(durs)
+      if den:
+        speeds.append(round(num / den, 4))
 
     return cls(
       task_types=task_types,
@@ -228,6 +241,29 @@ class WorkloadModel:
     return {
       name: max(len(t["durs"]), 1) for name, t in self.task_types.items()
     }
+
+  def clip_outliers(self, factor: float = 4.0) -> int:
+    """Drop per-type duration samples beyond ``factor`` × the type
+    median. A journal mined from a chaos run carries fault-inflated
+    spans — a SIGSTOPped worker's interrupted task records the whole
+    freeze inside its ``dur`` — and a forecast that injects the same
+    fault through a ChaosSpec would double-count it. Returns the number
+    of samples dropped; ``sum`` is re-derived from the survivors."""
+    dropped = 0
+    for t in self.task_types.values():
+      durs = t.get("durs") or []
+      if len(durs) < 4:
+        continue
+      median = durs[len(durs) // 2]   # durs are mined sorted
+      if median <= 0:
+        continue
+      kept = [d for d in durs if d <= factor * median]
+      if len(kept) == len(durs):
+        continue
+      dropped += len(durs) - len(kept)
+      t["durs"] = kept
+      t["sum"] = round(sum(kept), 6)
+    return dropped
 
   def fail_prob(self, task_type: str) -> float:
     t = self.task_types.get(task_type)

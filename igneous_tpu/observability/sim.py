@@ -88,6 +88,19 @@ class SimConfig:
   max_sim_sec: float = 30 * 24 * 3600.0
   segment_spans: int = 512         # spans per emitted journal segment
   range_lease: int = 0             # 1 = one shared lease per round (ISSUE 15)
+  # campaign survival (ISSUE 17): duplicate-issue the leased members of
+  # slow/stalled holders (first resolution wins, the loser fences) and
+  # let idle workers carve the unstarted tails of long-held rounds
+  speculate: int = 0
+  speculate_interval_sec: float = 10.0
+  steal: int = 0
+  steal_min_held_sec: float = 5.0
+  # replay an OBSERVED fleet trajectory: one worker spawned per entry,
+  # at that sim-second offset (0 = campaign start). Overrides `workers`
+  # for the initial population — replacements an external autoscaler
+  # produced are just later entries, so a forecast can hold the fleet
+  # history fixed and test only the execution/lease/survival model.
+  worker_arrivals: Optional[List[float]] = None
 
   _ENV = {
     "workers": "IGNEOUS_SIM_WORKERS",
@@ -100,9 +113,12 @@ class SimConfig:
     "fail_scale": "IGNEOUS_SIM_FAIL_SCALE",
     "max_sim_sec": "IGNEOUS_SIM_MAX_SEC",
     "range_lease": "IGNEOUS_SIM_RANGE_LEASE",
+    "speculate": "IGNEOUS_SIM_SPECULATE",
+    "steal": "IGNEOUS_SIM_STEAL",
   }
   _INT_FIELDS = ("workers", "seed", "tasks", "batch_size",
-                 "max_deliveries", "segment_spans", "range_lease")
+                 "max_deliveries", "segment_spans", "range_lease",
+                 "speculate", "steal")
 
   @classmethod
   def from_env(cls, **overrides) -> "SimConfig":
@@ -186,6 +202,12 @@ class FleetSimulator:
     self.zombie_fenced = 0
     self.released = 0
     self.range_rounds = 0
+    self.spec_issued = 0       # campaign survival (ISSUE 17)
+    self.spec_won = 0
+    self.spec_fenced = 0
+    self.spec_dup = 0
+    self.steals = 0
+    self.steal_tasks = 0
     self.policy_loop = PolicyLoop(
       self.cfg.policy or AutoscalePolicy()
     ) if self.cfg.autoscale else None
@@ -245,6 +267,10 @@ class FleetSimulator:
         "i": i, "type": name, "state": "pending", "deliveries": 0,
         "enqueue_t": 0.0, "lease_token": 0, "lease_worker": None,
         "done_t": None,
+        # speculation (ISSUE 17): a leased task can carry a second live
+        # lease — the twin. spec: None -> "wait" (twin queued) ->
+        # "open" (twin leased) -> "resolved" (first terminal ack won)
+        "twin_token": 0, "twin_worker": None, "spec": None,
       })
       self.pending.append(i)
 
@@ -330,12 +356,23 @@ class FleetSimulator:
   def _drain_exit(self, w: _SimWorker, released: List[int]) -> None:
     for i in released:
       task = self.tasks[i]
-      if task["state"] == "leased" and task["lease_worker"] == w.wid:
-        task["state"] = "pending"
+      if task["state"] != "leased":
+        continue
+      if task["lease_worker"] == w.wid:
+        task["lease_token"] = 0
         task["lease_worker"] = None
+      elif task["twin_worker"] == w.wid:
+        task["twin_token"] = 0
+        task["twin_worker"] = None
+      else:
+        continue
+      # requeue only when no speculative twin survives us — a live
+      # twin keeps the index; requeueing would fence its completion
+      if not (task["lease_token"] or task["twin_token"]):
+        task["state"] = "pending"
         self.pending.append(i)
-        w.incr("drain.released")
-        self.released += 1
+      w.incr("drain.released")
+      self.released += 1
     rs = w.round_state
     if rs is not None:
       self._span(
@@ -373,11 +410,35 @@ class FleetSimulator:
     if w.draining:
       return self._drain_exit(w, [])
     members: List[int] = []
+    twins: List[int] = []
     cap = 1 if w.straggler_flagged else max(self.cfg.batch_size, 1)
     use_range = bool(self.cfg.range_lease)
     while self.pending and len(members) < cap:
       i = self.pending.popleft()
       task = self.tasks[i]
+      if task["state"] == "leased":
+        # speculative duplicate-issue (ISSUE 17): the original holder
+        # keeps its lease — this worker runs a twin copy with its own
+        # token; first resolution wins, the loser's ack fences
+        if task["spec"] != "wait" or task["lease_worker"] == w.wid:
+          continue   # resolved / recycled / own lease: stale entry
+        task["spec"] = "open"
+        task["deliveries"] += 1
+        task["twin_worker"] = w.wid
+        if not use_range:
+          self._lease_seq += 1
+          task["twin_token"] = self._lease_seq
+          tok = self._lease_seq
+          self._push(
+            self.t + self.cfg.lease_sec,
+            lambda i=i, tok=tok: self._lease_expire(i, tok),
+          )
+        else:
+          twins.append(i)
+        members.append(i)
+        continue
+      if task["state"] != "pending":
+        continue   # reached terminal state while a stale entry sat queued
       task["state"] = "leased"
       task["deliveries"] += 1
       task["lease_worker"] = w.wid
@@ -397,8 +458,12 @@ class FleetSimulator:
       # so the shared expiry recycles only still-leased survivors
       self._lease_seq += 1
       tok = self._lease_seq
+      twin_set = set(twins)
       for i in members:
-        self.tasks[i]["lease_token"] = tok
+        if i in twin_set:
+          self.tasks[i]["twin_token"] = tok
+        else:
+          self.tasks[i]["lease_token"] = tok
       self._push(
         self.t + self.cfg.lease_sec,
         lambda m=tuple(members), tok=tok: self._range_expire(m, tok),
@@ -408,6 +473,11 @@ class FleetSimulator:
     if not members:
       if self.done:
         return self._clean_exit(w)
+      if self.cfg.steal and self._steal(w):
+        # a claim was serviced: the carved tail is back in pending —
+        # re-poll now instead of sleeping through the backoff
+        self._push(self.t, lambda: self._poll(w))
+        return
       self._push(self.t + self.cfg.poll_sec, lambda: self._poll(w))
       return
     w.rounds += 1
@@ -455,10 +525,12 @@ class FleetSimulator:
       return
     i = rs["members"][rs["i"]]
     task = self.tasks[i]
-    if (
-      task["state"] != "leased" or task["lease_worker"] != w.wid
-    ):
-      # lease recycled from under us before we even started the member
+    if task["state"] == "leased" and task["lease_worker"] == w.wid:
+      tok = task["lease_token"]
+    elif task["state"] == "leased" and task["twin_worker"] == w.wid:
+      tok = task["twin_token"]   # we hold the speculative twin side
+    else:
+      # lease recycled or stolen from under us before the member started
       rs["i"] += 1
       self._push(self.t, lambda: self._exec_next(w))
       return
@@ -468,7 +540,6 @@ class FleetSimulator:
       self.model.fail_prob(task["type"]) * self.cfg.fail_scale, 0.95,
     )
     fail = self.rng.random() < fail_p
-    tok = task["lease_token"]
     start_t = self.t
     self._push(
       self.t + dur,
@@ -482,11 +553,23 @@ class FleetSimulator:
     rs = w.round_state
     task = self.tasks[i]
     w.busy_sec += dur
-    if task["lease_token"] != tok or task["state"] != "leased":
-      # lease expired mid-execution and the task was recycled: the
-      # completion is fenced exactly like the real queue's zombie path
+    side = (
+      "twin" if (task["twin_token"] and tok == task["twin_token"])
+      else "orig"
+    )
+    live = task["state"] == "leased" and (
+      tok == task["lease_token"] or
+      (task["twin_token"] and tok == task["twin_token"])
+    )
+    if not live:
+      # lease expired / recycled mid-execution, or the speculative twin
+      # already resolved this index: the completion is fenced exactly
+      # like the real queue's zombie + done-marker paths
       w.incr("zombie.delete")
       self.zombie_fenced += 1
+      if task["spec"] == "resolved":
+        w.incr("speculation.duplicate_ack")
+        self.spec_dup += 1
       self._span(
         w, "task", start_t, dur, task=task["type"],
         attempt=task["deliveries"], fenced=True,
@@ -507,18 +590,33 @@ class FleetSimulator:
           w, "task", start_t, dur, trace=tid, span=task_sid,
           task=task["type"], attempt=attempt, error="SimFault",
         )
-        if (
+        # retire the acking side; a surviving twin/orig keeps running
+        # and owns the remaining retry budget
+        if side == "twin":
+          task["twin_token"] = 0
+          task["twin_worker"] = None
+        else:
+          task["lease_token"] = 0
+          task["lease_worker"] = None
+        if task["lease_token"] or task["twin_token"]:
+          pass   # the other side is still live: no requeue, no dlq
+        elif (
           self.cfg.max_deliveries
           and attempt >= self.cfg.max_deliveries
         ):
           task["state"] = "dlq"
           w.incr("dlq.promoted")
           self.dlq += 1
+          if task["spec"] in ("wait", "open"):
+            # the pair resolved by exhaustion, not by a win: account it
+            # as fenced so won + fenced == issued still reconciles
+            task["spec"] = "resolved"
+            w.incr("speculation.fenced")
+            self.spec_fenced += 1
           self._terminal()
         else:
           w.incr("retries.nack")
           task["state"] = "pending"
-          task["lease_worker"] = None
           self.pending.append(i)
       else:
         self._span(
@@ -527,6 +625,15 @@ class FleetSimulator:
         )
         task["state"] = "done"
         task["done_t"] = self.t
+        if task["spec"] in ("wait", "open"):
+          # first terminal ack wins the pair — the done-marker seam
+          task["spec"] = "resolved"
+          if side == "twin":
+            w.incr("speculation.won")
+            self.spec_won += 1
+          else:
+            w.incr("speculation.fenced")
+            self.spec_fenced += 1
         w.completed += 1
         self.completion_log.append(self.t)
         self._terminal()
@@ -538,12 +645,30 @@ class FleetSimulator:
         rs["executed"] += 1
       self._push(self.t, lambda: self._exec_next(w))
 
-  def _lease_expire(self, i: int, tok: int) -> None:
+  def _expire_side(self, i: int, tok: int) -> bool:
+    """Retire whichever side (original lease or speculative twin) of
+    task ``i`` holds ``tok``. The task recycles back to pending only
+    when no other live side remains — a surviving twin keeps running
+    and owns the index. Returns True when the task was recycled."""
     task = self.tasks[i]
-    if task["state"] == "leased" and task["lease_token"] == tok:
-      task["state"] = "pending"
+    if task["state"] != "leased":
+      return False
+    if task["lease_token"] == tok:
+      task["lease_token"] = 0
       task["lease_worker"] = None
-      self.pending.append(i)
+    elif task["twin_token"] and task["twin_token"] == tok:
+      task["twin_token"] = 0
+      task["twin_worker"] = None
+    else:
+      return False
+    if task["lease_token"] or task["twin_token"]:
+      return False
+    task["state"] = "pending"
+    self.pending.append(i)
+    return True
+
+  def _lease_expire(self, i: int, tok: int) -> None:
+    if self._expire_side(i, tok):
       self.driver.incr("retries.lease_recycle")
       self.lease_recycles += 1
 
@@ -551,17 +676,91 @@ class FleetSimulator:
     """Shared-token expiry for a range-leased round: recycle every member
     still holding the round's token. Members already done / dlq'd / nacked
     back to pending (sub-task accounting) are untouched."""
-    recycled = 0
-    for i in members:
-      task = self.tasks[i]
-      if task["state"] == "leased" and task["lease_token"] == tok:
-        task["state"] = "pending"
-        task["lease_worker"] = None
-        self.pending.append(i)
-        recycled += 1
+    recycled = sum(1 for i in members if self._expire_side(i, tok))
     if recycled:
       self.driver.incr("retries.lease_recycle", recycled)
       self.lease_recycles += recycled
+
+  # -- campaign survival (ISSUE 17) ------------------------------------------
+
+  def _speculate_tick(self) -> None:
+    """The campaign driver's speculation sweep: duplicate-issue every
+    leased task whose holder is stalled, straggler-slow, or dead with
+    an unexpired lease (the live runner's silent-holder trigger). First
+    terminal ack wins the pair; the loser fences — exactly the live
+    ``speculate_flagged`` + done-marker protocol."""
+    if self.done:
+      return
+    issued = 0
+    for task in self.tasks:
+      if task["state"] != "leased" or task["spec"] is not None:
+        continue
+      holder = self.workers.get(task["lease_worker"])
+      if holder is None:
+        continue
+      # a dead holder's unexpired lease is journal-silent: the live
+      # driver's silent-holder trigger twins it instead of waiting out
+      # lease expiry, so the sim must too (exited-with-leases = killed;
+      # drains release on the way out and never reach here)
+      if not holder.exited and not (
+        holder.stalled or holder.mode == "straggler"
+        or holder.straggler_flagged
+      ):
+        continue
+      task["spec"] = "wait"
+      self.pending.append(task["i"])
+      issued += 1
+    if issued:
+      self.spec_issued += issued
+      self.driver.incr("speculation.issued", issued)
+      self._span(self.driver, "sim.speculate", self.t, 0.0, twinned=issued)
+    self._push(self.t + self.cfg.speculate_interval_sec,
+               self._speculate_tick)
+
+  def _steal(self, w: _SimWorker) -> bool:
+    """Idle worker carves the unstarted tail off the longest round held
+    past ``steal_min_held_sec`` — the claim-file handshake collapsed to
+    its effect (the holder's heartbeat releases; here it is immediate
+    and deterministic). Returns True when tasks were released."""
+    best = None
+    for wid in sorted(self.workers):
+      v = self.workers[wid]
+      rs = v.round_state
+      if v is w or rs is None:
+        continue
+      if self.t - rs["t0"] < self.cfg.steal_min_held_sec:
+        continue
+      tail = [
+        i for i in rs["members"][rs["i"] + 1:]
+        if self.tasks[i]["state"] == "leased"
+        and self.tasks[i]["lease_worker"] == v.wid
+        and self.tasks[i]["spec"] is None
+      ]
+      if len(tail) >= 2 and (best is None or len(tail) > len(best[1])):
+        best = (v, tail)
+    if best is None:
+      return False
+    v, tail = best
+    grant = tail[-(len(tail) // 2):]   # holder keeps at least half + current
+    for i in grant:
+      task = self.tasks[i]
+      task["state"] = "pending"
+      task["lease_token"] = 0
+      task["lease_worker"] = None
+      self.pending.append(i)
+    grant_set = set(grant)
+    rs = v.round_state
+    rs["members"] = [i for i in rs["members"] if i not in grant_set]
+    w.incr("steal.claims")
+    v.incr("steal.granted")
+    v.incr("steal.tasks", len(grant))
+    self.steals += 1
+    self.steal_tasks += len(grant)
+    self._span(
+      self.driver, "sim.steal", self.t, 0.0,
+      thief=w.wid, victim=v.wid, tasks=len(grant),
+    )
+    return True
 
   def _terminal(self) -> None:
     self.terminal += 1
@@ -622,15 +821,24 @@ class FleetSimulator:
     self._ran = True
     cfg = self.cfg
     self._build_tasks()
-    initial = cfg.workers
-    if cfg.autoscale:
-      pol = self.policy_loop.policy
-      initial = max(pol.min_workers, min(pol.max_workers, cfg.workers))
-    for _ in range(max(initial, 0)):
-      self._add_worker(0.0)
+    if cfg.worker_arrivals:
+      # observed-trajectory replay: spawn order follows arrival order so
+      # chaos assignment (sorted wids) lands on the campaign's earliest
+      # workers — the ones a real storm actually hit
+      for off in sorted(float(o) for o in cfg.worker_arrivals):
+        self._add_worker(max(off, 0.0))
+    else:
+      initial = cfg.workers
+      if cfg.autoscale:
+        pol = self.policy_loop.policy
+        initial = max(pol.min_workers, min(pol.max_workers, cfg.workers))
+      for _ in range(max(initial, 0)):
+        self._add_worker(0.0)
     self._assign_chaos()
     if cfg.autoscale:
       self._push(cfg.autoscale_interval_sec, self._autoscale_tick)
+    if cfg.speculate:
+      self._push(cfg.speculate_interval_sec, self._speculate_tick)
     while self._heap:
       t, _, fn = heapq.heappop(self._heap)
       if t > cfg.max_sim_sec:
@@ -685,6 +893,13 @@ class FleetSimulator:
       "released": self.released,
       "rounds": sum(w.rounds for w in self.workers.values()),
       "range_rounds": self.range_rounds,
+      "speculation": {
+        "issued": self.spec_issued,
+        "won": self.spec_won,
+        "fenced": self.spec_fenced,
+        "duplicate_acks": self.spec_dup,
+      },
+      "steals": {"claims": self.steals, "tasks": self.steal_tasks},
       "makespan_sec": round(makespan, 3),
       "tasks_per_sec": (
         round(completed / makespan, 4) if makespan > 0 else 0.0

@@ -227,6 +227,9 @@ class LeaseBatcher:
       # ISSUE 12: members whose unstarted page ranges a flagged worker
       # shed back to the queue mid-campaign (healthy hosts re-lease them)
       "paged_splits": 0,
+      # ISSUE 17: steal claims this worker filed while the queue looked
+      # empty (the claimed holder's next heartbeat releases the tail)
+      "steal_claims": 0,
       "dispatches": defaultdict(int),
     }
     # straggler-flag poll cache: (checked_at_monotonic, flagged)
@@ -382,6 +385,12 @@ class LeaseBatcher:
           executed=self.stats["executed"], empty=True
         ):
           return self.stats["executed"]
+        if self._try_steal():
+          # a claim is filed: the holder's next heartbeat releases the
+          # unstarted tail back to the queue — re-poll soon, don't back
+          # off, or the released tasks sit idle for the backoff window
+          time.sleep(1.0 + random.random())
+          continue
         time.sleep(backoff + random.random())
         backoff = min(backoff * 2, max_backoff_window)
         continue
@@ -430,6 +439,35 @@ class LeaseBatcher:
       # round boundary: the round's spans (one lease.round + K member
       # task spans) flush as one journal segment
       journal_mod.maybe_flush_active(event="round")
+
+  def _try_steal(self) -> bool:
+    """Idle-worker pull half of work stealing (ISSUE 17): the queue
+    looks empty, but long-held range leases may still pin unstarted
+    work — claim the biggest one so its holder's next heartbeat renewal
+    releases the unstarted tail back to the pool. Opt-in
+    (IGNEOUS_STEAL); queues without the protocol are skipped."""
+    from ..analysis import knobs
+
+    steal_claim = getattr(self.queue, "steal_claim", None)
+    if steal_claim is None or not knobs.get_bool("IGNEOUS_STEAL"):
+      return False
+    try:
+      seg = steal_claim()
+    except Exception:
+      return False
+    if seg is None:
+      return False
+    self.stats["steal_claims"] += 1
+    return True
+
+  @staticmethod
+  def _mark_started(lease_id):
+    """Fence this member off work stealing: only UNSTARTED members are
+    carved off a claimed range (queues/ranges.py). Classic string
+    tokens have no mark and need none — stealing is range-only."""
+    mark = getattr(lease_id, "mark_started", None)
+    if mark is not None:
+      mark()
 
   def _lease_many(self, n: int):
     """One queue interaction for up to ``n`` leases: the batched wire
@@ -653,6 +691,8 @@ class LeaseBatcher:
         "mesh": self._run_mesh_group,
       }[key[0]]
       self._completed_in_group = set()
+      for _task, lease_id in group:
+        self._mark_started(lease_id)  # group dispatch begins now
       try:
         handler(key, group)
       except Exception:
@@ -677,6 +717,7 @@ class LeaseBatcher:
         return
       if self.verbose:
         print(f"Executing (solo) {task!r}")
+      self._mark_started(lease_id)
       try:
         with trace.task_span(
           task, attempt=self._attempt_of(lease_id), mode="batch-solo"

@@ -187,6 +187,44 @@ def page_partition(n_pages: int, weights=None):
   return int(starts[pid]), int(bounds[pid]), per
 
 
+def throughput_weights(
+  journal_path: str,
+  workers,
+  window_sec: float = 600.0,
+  floor: float = 0.25,
+):
+  """Per-worker weights for :func:`page_partition`, mined from journal
+  task spans (ISSUE 17): each worker's busy-time rate (tasks per second
+  while executing), so a host running at half the fleet's speed gets
+  roughly half the pages up front instead of holding the campaign tail
+  hostage. ``workers`` is the process-ordered worker-id list (process i
+  must pass the same list so every host computes identical bounds).
+
+  Returns a list aligned to ``workers``, or None when the journal has
+  no usable rates — callers fall back to the uniform split. Workers the
+  journal hasn't seen yet get the fleet median; measured rates are
+  clamped to ``floor``× the median so one noisy sample can't starve a
+  host to zero pages.
+  """
+  from ..observability import fleet
+
+  try:
+    rates = fleet.worker_rates(
+      fleet.load_effective(journal_path), window_sec=window_sec
+    )
+  except Exception:
+    return None
+  known = sorted(rates[w] for w in workers if w in rates)
+  if not known:
+    return None
+  median = float(known[len(known) // 2])
+  if median <= 0:
+    return None
+  return [
+    max(float(rates.get(w, median)), floor * median) for w in workers
+  ]
+
+
 def from_process_local(mesh, local_batch: np.ndarray, per: int):
   """Assemble the global sharded batch from each host's local chunks.
 

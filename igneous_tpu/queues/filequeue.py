@@ -32,6 +32,31 @@ O(segments): task counts ride in the file names, completion tallies stay
 through sub-task accounting — see :mod:`.ranges`. Classic per-task files
 and segments coexist freely in one queue directory, so pre-ISSUE-15
 layouts keep reading.
+
+Campaign survival (ISSUE 17): two sidecar protocols keep a hostile
+fleet's tail from holding a campaign hostage, both dormant (zero reads,
+zero writes) until first use — queues that never speculate or steal
+read byte-for-byte unchanged.
+
+* **Straggler speculation** (``spec/`` sidecar): :meth:`speculate_lease`
+  double-issues the unfinished tail of a held range lease as a twin
+  segment. First RESOLUTION wins: completing an index creates a
+  per-index ``O_EXCL`` marker, and only the marker creator tallies the
+  completion — the loser's late ack shrinks its lease *without*
+  tallying, so completions never double-count. Exactly one of
+  ``speculation.won`` (twin resolved first) / ``speculation.fenced``
+  (original resolved first) increments per issued index, making
+  ``won + fenced == issued`` an end-of-campaign invariant.
+* **Work stealing** (``steal/`` sidecar): an idle worker claims a
+  long-held range with :meth:`steal_claim` (``O_EXCL`` claim file =
+  deterministic winner among racing thieves); the holder's next
+  heartbeat renewal services the claim by releasing the unstarted tail
+  of its range back to the pool through the expiry-fenced range-release
+  seam, then removes the claim.
+
+While a speculation pair is live, ``enqueued``/``backlog`` transiently
+count both copies and ``fsck`` counter drift dips negative by the
+twinned index count; both read exact again once the pair resolves.
 """
 
 from __future__ import annotations
@@ -39,6 +64,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import re
 import time
 import uuid
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -56,6 +82,16 @@ SEG_SUFFIX = ".jsonl"
 DEFAULT_QUEUE_SHARDS = 16
 DEFAULT_SEG_TASKS = 1024
 DEFAULT_RECYCLE_SEC = 5.0
+DEFAULT_SPECULATE_MIN_TASKS = 1
+DEFAULT_SPECULATE_MAX_TWINS = 4
+DEFAULT_SPECULATE_MIN_HELD_SEC = 0.0
+DEFAULT_STEAL_MIN_TASKS = 2
+DEFAULT_STEAL_MIN_HELD_SEC = 2.0
+DEFAULT_STEAL_FRACTION = 0.5
+DEFAULT_STEAL_CLAIM_TTL_SEC = 300.0
+
+# mid-range failures / DLQ expansions carve per-index classic files
+_CARVE_RE = re.compile(r"^task_([0-9a-f]+)_(\d+)\.json$")
 
 
 def seg_parse(name: str) -> Optional[Tuple[str, int]]:
@@ -227,6 +263,9 @@ def poll_loop(
       backoff = 1.0
       task, lease_id = leased
       key = hb.track(lease_id)
+      if isinstance(lease_id, RangeSub):
+        # stealing only carves UNSTARTED members; this one is in flight
+        lease_id.mark_started()
       if verbose:
         print(f"Executing {task!r}")
       try:
@@ -277,10 +316,17 @@ def poll_loop(
 
 
 class FileQueue:
-  def __init__(self, path: str, max_deliveries: Optional[int] = None):
+  def __init__(self, path: str, max_deliveries: Optional[int] = None,
+               worker_id: Optional[str] = None):
     """``max_deliveries``: after this many deliveries (leases), a task
     that fails again is quarantined in ``dlq/`` instead of recycling.
-    None (default) keeps the historical infinite-retry behavior."""
+    None (default) keeps the historical infinite-retry behavior.
+
+    ``worker_id`` names this consumer in segment lease metadata (the
+    ``holder`` field speculation/steal planners target). Defaults to the
+    journal's worker id so HealthEngine flags — which name journal
+    workers — map straight onto lease holders; pass the same id given to
+    :class:`~..observability.journal.Journal` when overriding one."""
     if path.startswith("fq://"):
       path = path[len("fq://"):]
     self.path = os.path.abspath(os.path.expanduser(path))
@@ -288,6 +334,11 @@ class FileQueue:
     self.lease_dir = os.path.join(self.path, "leased")
     self.dlq_dir = os.path.join(self.path, "dlq")
     self.meta_dir = os.path.join(self.path, "meta")
+    # survival sidecars (ISSUE 17): created lazily on first use, so a
+    # queue that never speculates/steals keeps its pre-ISSUE-17 layout
+    self.spec_dir = os.path.join(self.path, "spec")
+    self.steal_dir = os.path.join(self.path, "steal")
+    self._worker_id = worker_id
     self.max_deliveries = (
       None if not max_deliveries or int(max_deliveries) <= 0
       else int(max_deliveries)
@@ -300,6 +351,14 @@ class FileQueue:
     # full listdir+sort per acquisition) and the recycle-scan throttle
     self._pending_cache: Optional[List[str]] = None
     self._last_recycle = 0.0
+
+  @property
+  def worker_id(self) -> str:
+    if self._worker_id is None:
+      from ..observability.journal import default_worker_id
+
+      self._worker_id = default_worker_id()
+    return self._worker_id
 
   # -- per-task attempt metadata --------------------------------------------
 
@@ -458,6 +517,16 @@ class FileQueue:
   @property
   def completed(self) -> int:
     return self._count("completions")
+
+  @property
+  def speculation_won(self) -> int:
+    """Crash-safe count of pair indices the TWIN resolved first."""
+    return self._count("speculation_won")
+
+  @property
+  def speculation_fenced(self) -> int:
+    """Crash-safe count of pair indices the ORIGINAL resolved first."""
+    return self._count("speculation_fenced")
 
   @property
   def enqueued(self) -> int:
@@ -664,10 +733,34 @@ class FileQueue:
 
   def _copy_meta(self, src_segid: str, dst_segid: str):
     """Splits inherit the parent segment's attempt record, so per-task
-    DLQ attribution survives any number of lease splits."""
+    DLQ attribution survives any number of lease splits. Speculation
+    pair membership (ISSUE 17) rides along too — a twin tail split off
+    at the batch cap (or a stolen/released remainder) must keep routing
+    its acks through first-resolution marker arbitration, else the two
+    copies of an index would BOTH tally. Holder identity does not copy:
+    the split lands pending, owned by whoever leases it next."""
     meta = self._read_meta(f"{SEG_PREFIX}{src_segid}")
-    if meta.get("deliveries") or meta.get("failures"):
+    meta.pop("holder", None)
+    meta.pop("leased_at", None)
+    if not meta.get("spec") and self._spec_active():
+      spec = self._spec_of(src_segid)   # heals a clobbered orig meta
+      if spec is not None:
+        meta["spec"] = spec
+    if meta.get("deliveries") or meta.get("failures") or meta.get("spec"):
       self._write_meta(f"{SEG_PREFIX}{dst_segid}", meta)
+    spec = meta.get("spec")
+    if isinstance(spec, dict) and spec.get("pair"):
+      # lineage marker: until this descendant drains, the pair's done
+      # markers must survive — a GC that only tracked the two original
+      # segids would collect them and let a lingering copy re-tally
+      try:
+        fd = os.open(
+          self._spec_path(f"side_{spec['pair']}_{dst_segid}"),
+          os.O_CREAT | os.O_WRONLY,
+        )
+        os.close(fd)
+      except OSError:
+        pass
 
   # -- producer -------------------------------------------------------------
 
@@ -792,6 +885,8 @@ class FileQueue:
       n += 1
       if self._pending_cache is not None:
         self._pending_cache.append(orig)
+    if os.path.isdir(self.spec_dir) or os.path.isdir(self.steal_dir):
+      self._survival_gc(now)
     return n
 
   def _expire_segment_to_dlq(self, src: str, segid: str, reason: str):
@@ -808,7 +903,13 @@ class FileQueue:
     except FileNotFoundError:
       return  # another worker expanded it first
     seg_meta = self._read_meta(f"{SEG_PREFIX}{segid}")
+    spec = seg_meta.get("spec") if self._spec_active() else None
     for idx, payload in entries:
+      if spec and self._spec_resolved(spec["pair"], idx):
+        # the pair's other copy already completed (and tallied) this
+        # index — dropping it is the resolution, not a quarantine
+        self._spec_collapse(None, None, 1)
+        continue
       name = f"task_{segid}_{idx}.json"
       meta = self._read_meta(name)
       meta["deliveries"] = max(
@@ -817,6 +918,8 @@ class FileQueue:
       meta["failures"] = (
         seg_meta.get("failures", []) + meta.get("failures", [])
       )[-MAX_RECORDED_FAILURES:]
+      if spec:
+        meta["spec"] = spec
       self._write_meta(name, meta)
       self._record_failure(name, reason)
       self._write_file(self.dlq_dir, name, payload)
@@ -844,7 +947,9 @@ class FileQueue:
     at ``cap`` members — the remainder returns to the pool under a new
     segid (attempt meta copied) BEFORE the lease shrinks, so a crash
     between duplicates deliveries but never loses tasks. Returns a list
-    of (task, token) pairs, or None when the rename race was lost."""
+    of (task, token) pairs, None when the rename race was lost, or []
+    when the file held only speculation-resolved indices (already
+    completed by the pair's other copy) and collapsed to nothing."""
     deadline = time.time() + seconds
     lease_name = f"{deadline:.3f}{LEASE_SEP}{name}"
     src = os.path.join(self.queue_dir, name)
@@ -855,6 +960,14 @@ class FileQueue:
       return None  # lost the race; caller tries another
     parsed = seg_parse(name)
     if parsed is None:
+      spec = self._spec_of_name(name) if self._spec_active() else None
+      if spec is not None:
+        carve = _CARVE_RE.match(name)
+        if carve and self._spec_resolved(spec["pair"], int(carve.group(2))):
+          # the pair's other copy already completed (and tallied) this
+          # index — drop the duplicate instead of delivering it
+          self._spec_collapse(dst, name, 1)
+          return []
       meta = self._read_meta(name)
       meta["deliveries"] = int(meta.get("deliveries", 0)) + 1
       self._write_meta(name, meta)
@@ -862,6 +975,30 @@ class FileQueue:
         return [(deserialize(f.read()), lease_name)]
     segid = parsed[0]
     entries = self._read_segment(dst)
+    if self._spec_active():
+      spec = self._spec_of(segid)
+      if spec is not None:
+        live = [
+          (i, p) for i, p in entries
+          if not self._spec_resolved(spec["pair"], i)
+        ]
+        if len(live) != len(entries):
+          self._spec_collapse(None, None, len(entries) - len(live))
+          if not live:
+            try:
+              os.remove(dst)
+            except FileNotFoundError:
+              pass
+            self._drop_meta(f"{SEG_PREFIX}{segid}")
+            return []
+          new_lease = f"{deadline:.3f}{LEASE_SEP}{seg_name(segid, len(live))}"
+          self._write_file(self.lease_dir, new_lease, _seg_content(live))
+          try:
+            os.remove(dst)
+          except FileNotFoundError:
+            pass
+          lease_name, entries = new_lease, live
+          dst = os.path.join(self.lease_dir, lease_name)
     cap = max(int(cap), 1)
     if len(entries) > cap:
       keep, rest = entries[:cap], entries[cap:]
@@ -881,6 +1018,10 @@ class FileQueue:
       lease_name, entries = lease_name_new, keep
     meta = self._read_meta(f"{SEG_PREFIX}{segid}")
     meta["deliveries"] = int(meta.get("deliveries", 0)) + 1
+    # holder identity: what speculation targets (flagged worker -> its
+    # leases) and stealing filters (a thief never claims its own range)
+    meta["holder"] = self.worker_id
+    meta["leased_at"] = round(time.time(), 3)
     self._write_meta(f"{SEG_PREFIX}{segid}", meta)
     rl = RangeLease(self, lease_name, segid, dict(entries), deadline)
     return [(deserialize(p), RangeSub(rl, i)) for i, p in entries]
@@ -976,12 +1117,24 @@ class FileQueue:
     if deadline is not None and deadline < time.time():
       telemetry.incr("zombie.delete")
       return False
+    orig = str(lease_id).split(LEASE_SEP, 1)[-1]
     try:
       os.remove(os.path.join(self.lease_dir, lease_id))
     except FileNotFoundError:
       telemetry.incr("zombie.delete")
       return False
-    self._drop_meta(str(lease_id).split(LEASE_SEP, 1)[-1])
+    spec = self._spec_of_name(orig) if self._spec_active() else None
+    self._drop_meta(orig)
+    if spec is not None:
+      # a speculated index carved out as a classic task: the O_EXCL
+      # marker arbitrates the tally exactly as in _range_ack_many
+      carve = _CARVE_RE.match(orig)
+      idx = int(carve.group(2)) if carve else None
+      if idx is not None:
+        if not self._spec_mark_first(spec["pair"], idx):
+          self._spec_wasted(spec, 1)
+          return False  # pair's other copy completed (and tallied) it
+        self._spec_account_first(spec, 1)
     self._tally("completions")
     return True
 
@@ -1123,7 +1276,22 @@ class FileQueue:
       if not self._range_rewrite(rl, remaining):
         telemetry.incr("zombie.delete", len(hit))
         return {i: False for i in todo}
-      self._tally("completions", len(hit))
+      # first-RESOLUTION-wins (ISSUE 17): with a live speculation pair,
+      # the per-index O_EXCL marker — attempted only AFTER the rewrite
+      # proved this worker still owns its copy — arbitrates the tally.
+      # Exactly one side creates each marker (and tallies); the loser's
+      # ack shrank its lease above but tallies nothing.
+      spec = self._spec_of(rl.segid) if self._spec_active() else None
+      if spec is None:
+        first = hit
+      else:
+        first = [i for i in hit if self._spec_mark_first(spec["pair"], i)]
+        if first:
+          self._spec_account_first(spec, len(first))
+        if len(first) != len(hit):
+          self._spec_wasted(spec, len(hit) - len(first))
+      if first:
+        self._tally("completions", len(first))
       if not remaining:
         self._drop_meta(f"{SEG_PREFIX}{rl.segid}")
       hitset = set(hit)
@@ -1156,6 +1324,10 @@ class FileQueue:
       meta["failures"] = (
         seg_meta.get("failures", []) + meta.get("failures", [])
       )[-MAX_RECORDED_FAILURES:]
+      if seg_meta.get("spec"):
+        # pair membership rides along: the carve's eventual ack must
+        # still go through first-resolution marker arbitration
+        meta["spec"] = seg_meta["spec"]
       self._write_meta(carve, meta)
       carve_lease = f"{rl.deadline:.3f}{LEASE_SEP}{carve}"
       self._write_file(self.lease_dir, carve_lease, rl.entries[index])
@@ -1220,6 +1392,10 @@ class FileQueue:
         raise StaleLeaseError(
           f"range lease {rl.segid!r} already expired; due for re-issue"
         )
+      # work stealing (ISSUE 17): the heartbeat IS the holder's claim
+      # inbox — service a pending claim before the freshness guard can
+      # short-circuit, so a thief never waits past one renewal interval
+      self._steal_service(rl)
       if rl.deadline >= now + float(seconds) * 0.9:
         return rl.token
       new_deadline = now + float(seconds)
@@ -1240,8 +1416,413 @@ class FileQueue:
       rl.deadline = new_deadline
       return rl.token
 
+  # -- campaign survival: straggler speculation + work stealing (ISSUE 17) ---
+
+  def _spec_active(self) -> bool:
+    """One stat call gates every speculation hook: the ``spec/`` sidecar
+    only exists once something speculated, so queues that never do read
+    byte-for-byte as before ISSUE 17."""
+    return os.path.isdir(self.spec_dir)
+
+  def _spec_path(self, name: str) -> str:
+    return os.path.join(self.spec_dir, name)
+
+  def _spec_of(self, segid: str) -> Optional[dict]:
+    """Pair membership of a segment: ``{"pair": …, "side": "orig"|"twin"}``
+    from its attempt meta. The ORIG side gets a pair-file fallback:
+    ``speculate_lease`` (driver process) stamping ``meta["spec"]`` can
+    race the holder's own meta read-modify-write (a lease split's
+    delivery bump, a failure record) and lose — but the pair file is
+    NAMED after the orig segid, so its existence alone proves
+    membership no matter which write landed last."""
+    spec = self._read_meta(f"{SEG_PREFIX}{segid}").get("spec")
+    if isinstance(spec, dict) and "pair" in spec:
+      return spec
+    if os.path.exists(self._spec_path(f"pair_{segid}.json")):
+      return {"pair": segid, "side": "orig"}
+    return None
+
+  def _spec_of_name(self, name: str) -> Optional[dict]:
+    """Pair membership of a classic queue/lease/dlq file name (carves
+    inherit it into their own meta; plain per-task files never have
+    any)."""
+    spec = self._read_meta(self._meta_key(name)).get("spec")
+    return spec if isinstance(spec, dict) and "pair" in spec else None
+
+  def _spec_resolved(self, pairid: str, index: int) -> bool:
+    return os.path.exists(self._spec_path(f"done_{pairid}_{int(index)}"))
+
+  def _spec_mark_first(self, pairid: str, index: int) -> bool:
+    """Atomically claim first resolution of (pair, index). The O_EXCL
+    create is the ONE commitment point for the completion tally: the
+    creator tallies, everyone else is fenced."""
+    try:
+      fd = os.open(
+        self._spec_path(f"done_{pairid}_{int(index)}"),
+        os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+      )
+    except FileExistsError:
+      return False
+    os.close(fd)
+    return True
+
+  def _spec_account_first(self, spec: dict, n: int):
+    """Exactly one of won/fenced per issued index, settled at first
+    resolution — the twin resolving first means speculation paid off,
+    the original resolving first means the twin's copy is now waste.
+    Besides the in-process telemetry counter (journal-flushed, LOSSY
+    when the acking worker is SIGKILLed before its next flush) the
+    resolution appends to a crash-safe queue tally, committed in the
+    same breath as the done marker — the campaign driver reconciles
+    the journal ledger from these after the pool is down."""
+    from .. import telemetry
+
+    if spec.get("side") == "twin":
+      telemetry.incr("speculation.won", n)
+      self._tally("speculation_won", n)
+    else:
+      telemetry.incr("speculation.fenced", n)
+      self._tally("speculation_fenced", n)
+
+  def _spec_wasted(self, spec: dict, n: int):
+    """A duplicate ack: the loser executed work the winner already
+    tallied. ``speculation.wasted_ms`` accumulates the pair-open window
+    per duplicate — the wall-clock bound on the duplicated effort."""
+    from .. import telemetry
+
+    telemetry.incr("speculation.duplicate_ack", n)
+    pair = self._read_pair(spec["pair"])
+    if pair and pair.get("ts"):
+      window_ms = int(max(0.0, time.time() - float(pair["ts"])) * 1000)
+      telemetry.incr("speculation.wasted_ms", window_ms * n)
+
+  def _spec_collapse(self, lease_path: Optional[str],
+                     meta_name: Optional[str], n: int):
+    """Drop an already-resolved duplicate copy at lease/expiry time. No
+    tally — the winner tallied at resolution; this is how a fenced
+    twin's leftover copies drain out of rotation."""
+    from .. import telemetry
+
+    if lease_path is not None:
+      try:
+        os.remove(lease_path)
+      except FileNotFoundError:
+        pass
+    if meta_name is not None:
+      self._drop_meta(meta_name)
+    telemetry.incr("speculation.deduped", n)
+
+  def _read_pair(self, pairid: str) -> Optional[dict]:
+    try:
+      with open(self._spec_path(f"pair_{pairid}.json")) as f:
+        return json.load(f)
+    except (FileNotFoundError, ValueError):
+      return None
+
+  def range_leases(self) -> List[dict]:
+    """Live range leases with holder identity — the planner's view for
+    speculation targeting and steal candidate selection."""
+    now = time.time()
+    spec_on = self._spec_active()
+    out = []
+    for name in os.listdir(self.lease_dir):
+      try:
+        deadline = float(name.split(LEASE_SEP, 1)[0])
+      except ValueError:
+        continue
+      parsed = seg_parse(name.split(LEASE_SEP, 1)[-1])
+      if parsed is None:
+        continue
+      segid, count = parsed
+      meta = self._read_meta(f"{SEG_PREFIX}{segid}")
+      paired = bool(meta.get("spec")) or (
+        # pair-file fallback: a clobbered orig meta must not make this
+        # lease look stealable/re-speculatable (see _spec_of)
+        spec_on
+        and os.path.exists(self._spec_path(f"pair_{segid}.json"))
+      )
+      out.append({
+        "lease": name, "segid": segid, "count": count,
+        "deadline": deadline, "expired": deadline < now,
+        "holder": meta.get("holder"),
+        "leased_at": meta.get("leased_at"),
+        "spec": paired,
+      })
+    return out
+
+  def speculate_lease(self, lease_name: str) -> int:
+    """Double-issue the unfinished tail of one held range lease as a
+    speculative TWIN segment: fresh segid, fresh delivery budget, the
+    SAME global task indices. The twin enters normal rotation; whichever
+    copy resolves an index first tallies it (see ``_range_ack_many``)
+    and the loser's copy is fenced. One live pair per segment —
+    re-speculation waits until the pair resolves and GCs. Returns the
+    number of indices twinned (0 when the target is not a range lease,
+    is already paired, is below ``IGNEOUS_SPECULATE_MIN_TASKS``, or
+    rotated away since it was listed)."""
+    from .. import telemetry
+    from ..analysis import knobs
+
+    orig = str(lease_name).split(LEASE_SEP, 1)[-1]
+    parsed = seg_parse(orig)
+    if parsed is None:
+      return 0
+    segid = parsed[0]
+    key = f"{SEG_PREFIX}{segid}"
+    meta = self._read_meta(key)
+    if meta.get("spec"):
+      return 0
+    v = knobs.get_int("IGNEOUS_SPECULATE_MIN_TASKS")
+    min_tasks = DEFAULT_SPECULATE_MIN_TASKS if v is None else int(v)
+    try:
+      entries = self._read_segment(os.path.join(self.lease_dir, lease_name))
+    except FileNotFoundError:
+      return 0  # rotated or completed since the listing; next sweep
+    if len(entries) < max(min_tasks, 1):
+      return 0
+    os.makedirs(self.spec_dir, exist_ok=True)
+    # the pair file is the mutex: an O_EXCL loss means a racing driver
+    # just speculated this segment
+    try:
+      fd = os.open(
+        self._spec_path(f"pair_{segid}.json"),
+        os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+      )
+    except FileExistsError:
+      return 0
+    twin = uuid.uuid4().hex
+    with os.fdopen(fd, "w") as f:
+      json.dump({
+        "pair": segid, "orig": segid, "twin": twin,
+        "indices": [int(i) for i, _ in entries],
+        "ts": round(time.time(), 3), "holder": meta.get("holder"),
+      }, f)
+    self._write_meta(
+      f"{SEG_PREFIX}{twin}",
+      {"deliveries": 0, "failures": [],
+       "spec": {"pair": segid, "side": "twin"}},
+    )
+    meta["spec"] = {"pair": segid, "side": "orig"}
+    self._write_meta(key, meta)
+    # the twin entering rotation is the commit point
+    twin_name = seg_name(twin, len(entries))
+    self._write_file(self.queue_dir, twin_name, _seg_content(entries))
+    if self._pending_cache is not None:
+      self._pending_cache.append(twin_name)
+    telemetry.incr("speculation.issued", len(entries))
+    return len(entries)
+
+  def speculate_flagged(self, workers, max_twins: Optional[int] = None) -> int:
+    """Driver entry point: twin the tails of every unexpired, unpaired
+    range lease held by a flagged worker — biggest ranges first, capped
+    at ``max_twins`` new pairs per sweep (IGNEOUS_SPECULATE_MAX_TWINS).
+    Returns the total number of indices twinned."""
+    from ..analysis import knobs
+
+    workers = {str(w) for w in workers}
+    if not workers:
+      return 0
+    if max_twins is None:
+      v = knobs.get_int("IGNEOUS_SPECULATE_MAX_TWINS")
+      max_twins = DEFAULT_SPECULATE_MAX_TWINS if v is None else int(v)
+    held = knobs.get_float("IGNEOUS_SPECULATE_MIN_HELD_SEC")
+    min_held = DEFAULT_SPECULATE_MIN_HELD_SEC if held is None else float(held)
+    now = time.time()
+    cands = [
+      r for r in self.range_leases()
+      if not r["expired"] and not r["spec"] and r["holder"] in workers
+      and now - float(r["leased_at"] or now) >= min_held
+    ]
+    cands.sort(key=lambda r: (-r["count"], r["lease"]))
+    issued = twins = 0
+    for r in cands:
+      if twins >= max_twins:
+        break
+      n = self.speculate_lease(r["lease"])
+      if n:
+        issued += n
+        twins += 1
+    return issued
+
+  def steal_claim(self, thief: Optional[str] = None) -> Optional[str]:
+    """Thief entry point: claim the biggest long-held foreign range so
+    its holder's next heartbeat renewal releases the unstarted tail back
+    to the pool, where the thief (or any idle worker) leases it. One
+    claim file per segment; O_EXCL creation makes racing thieves
+    converge on distinct targets deterministically. Returns the claimed
+    segid, or None when nothing qualifies."""
+    from .. import telemetry
+    from ..analysis import knobs
+
+    thief = thief or self.worker_id
+    v = knobs.get_int("IGNEOUS_STEAL_MIN_TASKS")
+    min_tasks = DEFAULT_STEAL_MIN_TASKS if v is None else int(v)
+    held = knobs.get_float("IGNEOUS_STEAL_MIN_HELD_SEC")
+    min_held = DEFAULT_STEAL_MIN_HELD_SEC if held is None else float(held)
+    now = time.time()
+    cands = [
+      r for r in self.range_leases()
+      if not r["expired"] and r["count"] >= max(min_tasks, 1)
+      and r["holder"] not in (None, thief)
+      and now - float(r["leased_at"] or now) >= min_held
+    ]
+    cands.sort(key=lambda r: (-r["count"], r["lease"]))
+    for r in cands:
+      os.makedirs(self.steal_dir, exist_ok=True)
+      try:
+        fd = os.open(
+          os.path.join(self.steal_dir, f"{r['segid']}.claim"),
+          os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+        )
+      except FileExistsError:
+        continue  # another thief got this range; try the next
+      with os.fdopen(fd, "w") as f:
+        json.dump({"thief": thief, "ts": round(now, 3)}, f)
+      telemetry.incr("steal.claims")
+      return r["segid"]
+    return None
+
+  def _steal_service(self, rl: RangeLease) -> int:
+    """Holder side, under ``rl.lock`` from ``_range_renew``: a pending
+    claim releases ``IGNEOUS_STEAL_FRACTION`` of the UNSTARTED tail
+    through the expiry-fenced range-release seam, always keeping at
+    least one member so the holder's in-flight work keeps its lease.
+    Too-small grants deny the claim (file removed) rather than starve
+    the thief silently."""
+    if not os.path.isdir(self.steal_dir):
+      return 0
+    claim = os.path.join(self.steal_dir, f"{rl.segid}.claim")
+    if not os.path.exists(claim):
+      return 0
+    from .. import telemetry
+    from ..analysis import knobs
+
+    frac = knobs.get_float("IGNEOUS_STEAL_FRACTION")
+    frac = DEFAULT_STEAL_FRACTION if frac is None else float(frac)
+    v = knobs.get_int("IGNEOUS_STEAL_MIN_TASKS")
+    min_tasks = DEFAULT_STEAL_MIN_TASKS if v is None else int(v)
+    unstarted = sorted(set(rl.entries) - rl.started)
+    grant_n = min(
+      int(len(unstarted) * max(min(frac, 1.0), 0.0)),
+      len(rl.entries) - 1,
+    )
+    granted = 0
+    if grant_n >= 1 and len(unstarted) >= max(min_tasks, 1):
+      granted = self._range_release(rl, unstarted[-grant_n:])
+    try:
+      os.remove(claim)
+    except FileNotFoundError:
+      pass
+    if granted:
+      telemetry.incr("steal.granted")
+      telemetry.incr("steal.tasks", granted)
+    else:
+      telemetry.incr("steal.denied")
+    return granted
+
+  def _survival_gc(self, now: float):
+    """Recycle-pass housekeeping for the survival sidecars: TTL-expired
+    steal claims recycle (so a re-leased range can be claimed again),
+    DLQ carves whose index the pair's other copy completed are pruned
+    as stale duplicates, and fully-resolved pairs drop their markers +
+    pair file — but only once NOTHING on disk references either segid,
+    because any lingering copy must keep deduping against the markers."""
+    from .. import telemetry
+    from ..analysis import knobs
+
+    if os.path.isdir(self.steal_dir):
+      ttl = knobs.get_float("IGNEOUS_STEAL_CLAIM_TTL_SEC")
+      ttl = DEFAULT_STEAL_CLAIM_TTL_SEC if ttl is None else float(ttl)
+      for name in os.listdir(self.steal_dir):
+        if not name.endswith(".claim"):
+          continue
+        path = os.path.join(self.steal_dir, name)
+        try:
+          with open(path) as f:
+            ts = float(json.load(f).get("ts") or 0)
+        except (FileNotFoundError, ValueError, TypeError):
+          ts = 0.0
+        if now - ts > max(ttl, 0.0):
+          try:
+            os.remove(path)
+            telemetry.incr("steal.expired_claims")
+          except FileNotFoundError:
+            pass
+    if not self._spec_active():
+      return
+    names = os.listdir(self.spec_dir)
+    pairs = [n for n in names if n.startswith("pair_")]
+    if not pairs:
+      return
+    markers = {n for n in names if n.startswith("done_")}
+    qlive = os.listdir(self.queue_dir) + os.listdir(self.lease_dir)
+
+    for pname in pairs:
+      try:
+        with open(self._spec_path(pname)) as f:
+          pair = json.load(f)
+      except (FileNotFoundError, ValueError):
+        continue
+      pid = pair.get("pair")
+      # descendants (lease splits, stolen/released tails) carry the
+      # pair under fresh segids; their side_ lineage markers make them
+      # visible here so the pair outlives every circulating copy
+      side_pref = f"side_{pid}_"
+      lineage = [n[len(side_pref):] for n in names if n.startswith(side_pref)]
+      sides = tuple(
+        [pair.get("orig", ""), pair.get("twin", "")] + lineage
+      )
+      # stale DLQ duplicates: the other copy completed this index AFTER
+      # it was quarantined — zero-DLQ-leakage means pruning them
+      for n in os.listdir(self.dlq_dir):
+        m = _CARVE_RE.match(n)
+        if not m or m.group(1) not in sides:
+          continue
+        if f"done_{pid}_{int(m.group(2))}" in markers:
+          try:
+            os.remove(os.path.join(self.dlq_dir, n))
+          except FileNotFoundError:
+            continue
+          self._drop_meta(n)
+          telemetry.incr("speculation.dlq_pruned")
+      idxs = pair.get("indices", [])
+      done = [f"done_{pid}_{i}" for i in idxs]
+      if not all(d in markers for d in done):
+        continue
+
+      def referenced(segid: str, listing) -> bool:
+        seg_pref = f"{SEG_PREFIX}{segid}_"
+        carve_pref = f"task_{segid}_"
+        return any(seg_pref in n or carve_pref in n for n in listing)
+
+      dlq_live = os.listdir(self.dlq_dir)
+      if any(
+        referenced(s, qlive) or referenced(s, dlq_live) for s in sides
+      ):
+        continue
+      for d in done:
+        try:
+          os.remove(self._spec_path(d))
+        except FileNotFoundError:
+          pass
+      for n in names:
+        if n.startswith(side_pref):
+          try:
+            os.remove(self._spec_path(n))
+          except FileNotFoundError:
+            pass
+      try:
+        os.remove(self._spec_path(pname))
+      except FileNotFoundError:
+        pass
+      for s in sides:
+        self._drop_meta(f"{SEG_PREFIX}{s}")
+
   def purge(self):
-    for d in (self.queue_dir, self.lease_dir, self.dlq_dir, self.meta_dir):
+    for d in (self.queue_dir, self.lease_dir, self.dlq_dir, self.meta_dir,
+              self.spec_dir, self.steal_dir):
+      if not os.path.isdir(d):
+        continue
       for name in list(os.listdir(d)):
         try:
           os.remove(os.path.join(d, name))

@@ -46,6 +46,11 @@ class RangeSub:
     self.parent = parent
     self.index = int(index)
 
+  def mark_started(self):
+    """Record that work on this member has begun (see
+    :meth:`RangeLease.mark_started`)."""
+    self.parent.mark_started(self.index)
+
   def __repr__(self):
     return f"RangeSub({self.parent.segid[:8]}:{self.index})"
 
@@ -63,6 +68,7 @@ class RangeLease:
     self.segid = segid          # stable across rewrites; keys attempt meta
     self.entries = dict(entries)  # index -> serialized payload, pending only
     self.deadline = float(deadline)
+    self.started = set()        # members whose execution has begun
     self.lock = threading.RLock()
 
   # -- shape ----------------------------------------------------------------
@@ -85,6 +91,20 @@ class RangeLease:
   def subs(self) -> List[RangeSub]:
     with self.lock:
       return [RangeSub(self, i) for i in sorted(self.entries)]
+
+  def mark_started(self, index: int):
+    """Record that work on a member has begun. Work stealing (ISSUE 17)
+    only carves UNSTARTED members off a claimed range — marking is what
+    protects in-flight work from being handed to a thief mid-execution.
+    Workers that never mark still converge (an in-flight member granted
+    away just zombie-fences its late ack), only less efficiently."""
+    with self.lock:
+      self.started.add(int(index))
+
+  def unstarted(self) -> List[int]:
+    """Surviving members no one has begun — the stealable tail."""
+    with self.lock:
+      return sorted(set(self.entries) - self.started)
 
   def __repr__(self):
     with self.lock:

@@ -1,0 +1,403 @@
+"""Campaign survival (ISSUE 17): speculation + stealing race regressions.
+
+The acceptance invariant behind every test here: **completions tally ==
+task count EXACTLY**, no matter how holder acks, speculative-twin acks,
+steal grants, lease expiry recycles, and GC interleave. The per-index
+``O_EXCL`` done marker is the arbitration seam; these tests drive each
+documented race through it:
+
+* holder and twin ack the same indices — sequentially in both orders and
+  from concurrent threads — exactly one side tallies each index;
+* a twin SPLIT mid-pair (lease cap) keeps pair membership through
+  ``_copy_meta``, and the ``side_`` lineage marker keeps the pair's
+  markers alive until every descendant copy resolved;
+* the driver's pair stamp clobbered out of the segment meta by the
+  holder's delivery-bump RMW (the cross-process race) heals through the
+  pair-file fallback — fencing still engages;
+* a steal claim serviced by the holder's heartbeat releases only the
+  unstarted tail; a claim racing lease expiry is TTL-collected so the
+  re-issued range can be claimed again;
+* the queue's crash-safe ``speculation_won/fenced`` tallies reconcile a
+  journal that lost worker counters to SIGKILL
+  (``CampaignRunner._reconcile_ledger``).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from igneous_tpu import telemetry
+from igneous_tpu.observability import (
+  fleet,
+  journal as journal_mod,
+  metrics,
+  replay,
+  sim,
+  trace,
+)
+from igneous_tpu.queues import FileQueue, PrintTask
+from igneous_tpu.queues.filequeue import SEG_PREFIX
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability(monkeypatch):
+  telemetry.reset_all()
+  metrics.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+  # race tests need fresh leases and eager survival paths, not throttles
+  monkeypatch.setenv("IGNEOUS_QUEUE_RECYCLE_SEC", "0")
+  monkeypatch.setenv("IGNEOUS_SPECULATE_MIN_HELD_SEC", "0")
+  monkeypatch.setenv("IGNEOUS_STEAL_MIN_HELD_SEC", "0")
+  yield
+  telemetry.reset_all()
+  metrics.reset_all()
+  trace.reset()
+  journal_mod.set_active(None)
+
+
+def make_queue(tmp_path, n=8, worker_id="holder", name="q"):
+  q = FileQueue(f"fq://{tmp_path}/{name}", worker_id=worker_id)
+  if n:
+    q.insert_batch([PrintTask(f"t{i}") for i in range(n)])
+  return q
+
+
+def view(q, worker_id):
+  """Another consumer of the same queue directory (its own process in
+  production; a second handle is the same filesystem protocol)."""
+  return FileQueue(f"fq://{q.path}", worker_id=worker_id)
+
+
+def speculate(q, holders):
+  driver = view(q, "driver")
+  return driver.speculate_flagged(set(holders))
+
+
+def counters():
+  return telemetry.counters_snapshot()
+
+
+# -- holder vs twin -----------------------------------------------------------
+
+
+class TestSpeculationRaces:
+  def test_holder_first_then_twin_acks_are_fenced(self, tmp_path):
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+    assert len(twin) == 8
+
+    assert all(q.ack_batch([t for _x, t in held]))
+    assert q.completed == 8
+    # the twin's acks shrink its own lease but tally NOTHING
+    twin_q.ack_batch([t for _x, t in twin])
+    assert q.completed == 8
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+    # orig side resolved first on every index: the twin was fenced
+    assert q.speculation_fenced == 8 and q.speculation_won == 0
+    assert counters().get("speculation.issued") == 8
+    assert counters().get("speculation.duplicate_ack") == 8
+
+  def test_twin_first_wins_and_holder_is_fenced(self, tmp_path):
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+
+    twin_q.ack_batch([t for _x, t in twin])
+    assert q.completed == 8
+    q.ack_batch([t for _x, t in held])
+    assert q.completed == 8                  # never double-counted
+    assert q.speculation_won == 8 and q.speculation_fenced == 0
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+
+  def test_interleaved_acks_split_the_ledger(self, tmp_path):
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+
+    q.ack_batch([t for _x, t in held[:4]])       # holder wins 0..3
+    twin_q.ack_batch([t for _x, t in twin])      # twin wins 4..7
+    q.ack_batch([t for _x, t in held[4:]])       # fenced
+    assert q.completed == 8
+    assert q.speculation_won == 4 and q.speculation_fenced == 4
+    assert q.speculation_won + q.speculation_fenced == 8
+
+  def test_concurrent_holder_and_twin_acks_stay_exact(self, tmp_path):
+    """The literal race: both sides ack all 8 indices from concurrent
+    threads. Whatever the interleaving, the O_EXCL marker hands each
+    index to exactly one side."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+
+    barrier = threading.Barrier(2)
+
+    def ack_all(queue, got):
+      barrier.wait()
+      for _t, tok in got:
+        queue.delete(tok)
+
+    threads = [
+      threading.Thread(target=ack_all, args=(q, held)),
+      threading.Thread(target=ack_all, args=(twin_q, twin)),
+    ]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    assert q.completed == 8
+    assert q.speculation_won + q.speculation_fenced == 8
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+
+  def test_twin_split_keeps_pair_membership(self, tmp_path):
+    """A twin leased below its size SPLITS: the remainder re-enters the
+    pool under a NEW segid. ``_copy_meta`` must carry the pair stamp (a
+    remainder that forgot its pair would double-tally), and the
+    ``side_`` lineage marker must keep GC off the pair until the
+    remainder resolves."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    part = twin_q.lease_batch(60, max_tasks=3)   # splits the twin: 3 + 5
+    assert len(part) == 3
+    lineage = [
+      n for n in os.listdir(q.spec_dir) if n.startswith("side_")
+    ]
+    assert lineage, "split remainder left no lineage marker"
+
+    assert all(q.ack_batch([t for _x, t in held]))   # holder wins all 8
+    assert q.completed == 8
+    # the pair must survive GC: the split remainder still circulates
+    q._survival_gc(time.time())
+    assert any(
+      n.startswith("pair_") for n in os.listdir(q.spec_dir)
+    ), "GC collected the pair while a descendant copy was live"
+
+    twin_q.ack_batch([t for _x, t in part])          # fenced, no tally
+    rest_q = view(q, "rest")
+    # the remainder's 5 members all resolved on the orig side: the lease
+    # attempt COLLAPSES them as resolved duplicates instead of
+    # delivering dead work
+    assert rest_q.lease_batch(60, max_tasks=8) == []
+    assert counters().get("speculation.deduped") == 5
+    assert q.completed == 8
+    assert q.speculation_fenced == 8
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+    # nothing references the pair now: GC may collect everything
+    q._survival_gc(time.time())
+    assert os.listdir(q.spec_dir) == []
+
+  def test_meta_clobber_heals_through_pair_file(self, tmp_path):
+    """Cross-process RMW race: the holder's delivery bump can rewrite
+    segment meta WITHOUT the driver's fresh ``spec`` stamp. Fencing must
+    still engage via the pair file named after the orig segid."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    segid = held[0][1].parent.segid
+    key = f"{SEG_PREFIX}{segid}"
+    meta = q._read_meta(key)
+    assert meta.get("spec")
+    meta.pop("spec")                      # the clobbered write
+    q._write_meta(key, meta)
+
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+    twin_q.ack_batch([t for _x, t in twin])
+    assert q.completed == 8
+    q.ack_batch([t for _x, t in held])    # must fence, not double-tally
+    assert q.completed == 8
+    assert q.speculation_won == 8
+
+
+# -- work stealing ------------------------------------------------------------
+
+
+class TestStealRaces:
+  def test_claim_vs_holder_partial_ack(self, tmp_path):
+    """The holder acks a few started members and heartbeats; the renewal
+    services the claim by releasing HALF the unstarted tail. Thief and
+    holder then drain their shares to an exact tally."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    toks = [tok for _t, tok in held]
+    for tok in toks[:2]:
+      tok.mark_started()
+
+    thief_q = view(q, "thief")
+    segid = thief_q.steal_claim()
+    assert segid == toks[0].parent.segid
+    assert counters().get("steal.claims") == 1
+
+    assert all(q.ack_batch(toks[:2]))     # partial ack races the claim
+    q.renew(toks[2], 60)                  # heartbeat services the claim
+    assert counters().get("steal.granted") == 1
+    granted = counters().get("steal.tasks")
+    assert granted == 3                   # half of the 6 unstarted
+    assert not os.listdir(q.steal_dir)    # claim consumed
+
+    stolen = thief_q.lease_batch(60, max_tasks=8)
+    assert len(stolen) == granted
+    thief_q.ack_batch([t for _x, t in stolen])
+    assert all(q.ack_batch(toks[2:2 + (8 - 2 - granted)]))
+    assert q.completed == 8
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+
+  def test_claim_vs_expiry_recycle(self, tmp_path, monkeypatch):
+    """The claimed holder dies instead of heartbeating: the lease
+    expires and recycles the WHOLE range. The stale claim must not
+    survive its TTL (a re-issued range stays stealable), and the
+    recycled campaign still drains to an exact tally."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(seconds=0.05, max_tasks=8)
+    thief_q = view(q, "thief")
+    assert thief_q.steal_claim() is not None
+    time.sleep(0.12)                      # lease expires, claim pending
+
+    monkeypatch.setenv("IGNEOUS_STEAL_CLAIM_TTL_SEC", "0")
+    fresh_q = view(q, "second")
+    fresh = fresh_q.lease_batch(60, max_tasks=8)   # recycle re-issues
+    assert len(fresh) == 8
+    assert not os.listdir(q.steal_dir), "stale claim outlived its TTL"
+    assert counters().get("steal.expired_claims", 0) >= 1
+
+    # the dead holder's zombie acks fence instead of double-counting
+    assert q.ack_batch([t for _x, t in held]) == [False] * 8
+    fresh_q.ack_batch([t for _x, t in fresh])
+    assert q.completed == 8
+    assert q.is_empty() and os.listdir(q.lease_dir) == []
+
+
+# -- crash-safe ledger ---------------------------------------------------------
+
+
+class TestLedgerReconciliation:
+  def test_tallies_survive_without_worker_journals(self, tmp_path):
+    """won/fenced land as 1-byte queue tallies in the same breath as the
+    done marker — SIGKILLing every worker cannot lose them."""
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+    twin_q.ack_batch([t for _x, t in twin[:5]])
+    q.ack_batch([t for _x, t in held])
+    twin_q.ack_batch([t for _x, t in twin[5:]])
+    assert q.speculation_won == 5
+    assert q.speculation_fenced == 3
+    assert q.speculation_won + q.speculation_fenced == 8
+
+  def test_campaign_runner_tops_up_lost_counters(self, tmp_path):
+    """A journal with NO speculation counters (every worker SIGKILLed
+    before flushing) reconciles from the queue tallies: the driver
+    journals the missing delta so won + fenced == issued holds from
+    ``fleet status`` alone."""
+    from igneous_tpu.observability import campaign
+
+    q = make_queue(tmp_path, n=8)
+    held = q.lease_batch(60, max_tasks=8)
+    assert speculate(q, ["holder"]) == 8
+    twin_q = view(q, "twin")
+    twin = twin_q.lease_batch(60, max_tasks=8)
+    twin_q.ack_batch([t for _x, t in twin])      # won=8 on the tally
+    q.ack_batch([t for _x, t in held])
+    assert q.speculation_won == 8
+
+    # the workers' in-process counters die with them (SIGKILL): the
+    # driver process starts from zero and has only queue + journal
+    telemetry.reset_all()
+    metrics.reset_all()
+    jpath = os.path.join(tmp_path, "journal")
+    runner = campaign.CampaignRunner(
+      jpath, q, actuator=object(), tick_sec=1.0, speculate=False,
+    )
+    topped = runner._reconcile_ledger()
+    assert topped == {"speculation.won": 8}
+    got = fleet.status(fleet.load_effective(jpath))["counters"]
+    assert got.get("speculation.won") == 8
+
+
+# -- simulator fidelity --------------------------------------------------------
+
+
+def _mixed_records():
+  """Two task types with disjoint per-worker assignments: the case that
+  used to mine a type-mix artifact as an 84x worker-speed outlier."""
+  recs = []
+  for i in range(12):
+    recs.append({
+      "kind": "span", "worker": "downsampler", "trace": f"d{i}",
+      "span": f"sd{i}", "parent": None, "name": "task",
+      "ts": 100.0 + i, "dur": 0.01, "task": "DownsampleTask", "attempt": 1,
+    })
+    recs.append({
+      "kind": "span", "worker": "sleeper", "trace": f"s{i}",
+      "span": f"ss{i}", "parent": None, "name": "task",
+      "ts": 100.0 + i, "dur": 0.6, "task": "SleepTask", "attempt": 1,
+    })
+  return recs
+
+
+class TestSimSurvivalModel:
+  def test_worker_speeds_are_type_normalized(self):
+    m = replay.WorkloadModel.mine(_mixed_records())
+    assert len(m.worker_speeds) == 2
+    # both workers ran at exactly their type's fleet median: neither is
+    # a "fast machine", no matter how lopsided the type assignment
+    assert all(s == pytest.approx(1.0) for s in m.worker_speeds)
+
+  def test_clip_outliers_drops_fault_inflated_durs(self):
+    recs = _mixed_records()
+    recs.append({
+      "kind": "span", "worker": "sleeper", "trace": "frozen",
+      "span": "sf", "parent": None, "name": "task",
+      "ts": 120.0, "dur": 9.7, "task": "SleepTask", "attempt": 1,
+    })
+    m = replay.WorkloadModel.mine(recs)
+    assert max(m.task_types["SleepTask"]["durs"]) == pytest.approx(9.7)
+    assert m.clip_outliers() == 1
+    assert max(m.task_types["SleepTask"]["durs"]) < 1.0
+    assert m.clip_outliers() == 0          # idempotent
+
+  def test_worker_arrivals_replay_observed_trajectory(self):
+    m = replay.WorkloadModel.mine(_mixed_records())
+    cfg = sim.SimConfig(
+      workers=3, seed=5, tasks=12, batch_size=4, lease_sec=30.0,
+      range_lease=1, worker_arrivals=[0.0, 6.0, 6.0],
+    )
+    out = sim.FleetSimulator(m, cfg).run()
+    assert out["completed_all"]
+    assert out["peak_workers"] <= 3
+    # one worker carries the first 6 sim-seconds; the fleet cannot beat
+    # the serial floor of that window
+    assert out["makespan_sec"] > 1.0
+
+  def test_same_seed_bit_identical_with_survival_on(self, tmp_path):
+    m = replay.WorkloadModel.mine(_mixed_records())
+    cfg = dict(
+      workers=3, seed=11, tasks=24, batch_size=4, lease_sec=10.0,
+      range_lease=1, speculate=1, steal=1, steal_min_held_sec=1.0,
+      speculate_interval_sec=2.0, worker_arrivals=[0.0, 1.5, 4.0],
+      chaos=sim.ChaosSpec(stall=1, kill=1, kill_at=2.0),
+    )
+    a = sim.FleetSimulator(m, sim.SimConfig(**cfg)).run()
+    b = sim.FleetSimulator(m, sim.SimConfig(**cfg)).run()
+    assert a == b
+    assert a["speculation"]["issued"] >= 1
+    assert (
+      a["speculation"]["won"] + a["speculation"]["fenced"]
+      == a["speculation"]["issued"]
+    )

@@ -627,6 +627,298 @@ def run_stall_health_scenario(scratch, seed, health_out=None):
   }
 
 
+def run_hostile_scenario(scratch, seed):
+  """ISSUE 17 acceptance: a continuous seeded kill/stall/preempt +
+  speculation storm over a full range-lease campaign driven by the
+  closed-loop campaign runner. The output must be byte-identical to a
+  clean control, completions == tasks EXACTLY (first-ack-wins fencing,
+  never double-counted), zero DLQ leakage, and the speculation ledger
+  must reconcile from the journal alone: won + fenced == issued. The
+  report also carries a `fleet simulate` forecast mined from the
+  hostile journal itself — it must land within ±20% of the live run."""
+  import random
+  import signal
+
+  from igneous_tpu.observability import (
+    autoscale,
+    campaign,
+    fleet,
+    health,
+    journal as journal_mod,
+    replay,
+    sim as sim_mod,
+  )
+
+  rng_img = np.random.default_rng(seed)
+  img = rng_img.integers(0, 255, (160, 160, 64)).astype(np.uint8)
+
+  def hostile_tasks(path):
+    return list(tc.create_downsampling_tasks(
+      path, mip=0, num_mips=1, memory_target=int(6e5), compress="gzip",
+    ))
+
+  with pipeline_disabled():
+    n_clean, clean = run_pipeline(
+      os.path.join(scratch, "hostile-clean"), img, tag="hostile-clean",
+      task_fn=hostile_tasks,
+    )
+
+  workdir = os.path.join(scratch, "hostile")
+  layer = f"file://{workdir}/layer"
+  Volume.from_numpy(img, layer, chunk_size=(32, 32, 32), compress="gzip")
+  # the downsample grid carries the byte-identity claim; interleaved
+  # SleepTasks (they write nothing) stretch the campaign across enough
+  # driver ticks for the storm to land mid-range — without them the 18
+  # real tasks drain in ~2s and every fault misses
+  from igneous_tpu.tasks import SleepTask
+  tasks = hostile_tasks(layer)
+  tasks += [SleepTask(seconds=0.6) for _ in range(30)]
+  spec = f"fq://{workdir}/q"
+
+  # few, FAT segments: range leases must hold real unfinished tails for
+  # speculation to twin and thieves to carve. Classic insert() writes
+  # one file per task (no ranges at all) — the batched wire protocol
+  # with a known total spreads the grid across IGNEOUS_QUEUE_SHARDS
+  # segment files, and --batch workers lease them as ranges
+  prev_shards = knobs.raw("IGNEOUS_QUEUE_SHARDS")
+  os.environ["IGNEOUS_QUEUE_SHARDS"] = "3"
+  try:
+    q = FileQueue(spec, max_deliveries=25)
+    n_tasks = q.insert_batch(tasks, total=len(tasks))
+  finally:
+    if prev_shards is None:
+      os.environ.pop("IGNEOUS_QUEUE_SHARDS", None)
+    else:
+      os.environ["IGNEOUS_QUEUE_SHARDS"] = prev_shards
+  assert n_tasks >= 8, f"hostile storm needs a task grid, got {n_tasks}"
+  jpath = journal_mod.journal_path_for(q, spec)
+
+  env = {
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": (
+      REPO_ROOT + os.pathsep + os.environ["PYTHONPATH"]
+      if os.environ.get("PYTHONPATH") else REPO_ROOT
+    ),
+    # workers journal aggressively (stall detection reads flush age),
+    # steal claims when idle, and speculation twins fresh leases too
+    "IGNEOUS_JOURNAL_FLUSH_SEC": "0.2",
+    "IGNEOUS_STEAL": "1",
+    "IGNEOUS_STEAL_MIN_HELD_SEC": "1.0",
+    "IGNEOUS_SPECULATE_MIN_HELD_SEC": "0",
+  }
+  os.environ["IGNEOUS_SPECULATE_MIN_HELD_SEC"] = "0"
+  actuator = autoscale.LocalPoolActuator(
+    spec,
+    # --batch 4 engages the LeaseBatcher => fq segments arrive as RANGE
+    # leases; --lease-sec 20 outlives the stall window so speculation
+    # (not expiry recycling) is what rescues the frozen worker's tail
+    worker_args=["--lease-sec", "20", "--batch", "4"],
+    env=env, grace_sec=60.0,
+  )
+  policy = autoscale.AutoscalePolicy(
+    min_workers=2, max_workers=3, horizon_sec=5.0,
+    hysteresis=0.2, cooldown_sec=1.0, step_max=2,
+  )
+  runner = campaign.CampaignRunner(
+    jpath, q, actuator,
+    policy=policy,
+    health_config=health.HealthConfig(stall_sec=3.0),
+    tick_sec=1.0, speculate=True, max_wall_sec=240.0,
+  )
+
+  # the continuous storm, keyed to driver ticks: freeze one worker
+  # mid-range (SIGSTOP: the flagged-straggler + speculation path), hard
+  # kill another (leases recycle at expiry, autoscale respawns), SIGTERM
+  # a third (graceful drain), then wake the frozen one so its late acks
+  # hit the fence. Seeded jitter makes each seed a different storm.
+  rng = random.Random(seed)
+  state = {"tick": 0, "stopped": None, "resume_at": 0,
+           "stalled": 0, "killed": 0, "preempted": 0, "resumed": 0}
+  stall_tick = 1 + rng.randrange(2)
+
+  def range_holder_pids():
+    # worker ids are <host>-<pid>: map live range-lease holders back to
+    # the local pool's processes so the freeze always lands mid-range
+    pids = set()
+    for r in q.range_leases():
+      holder = r.get("holder") or ""
+      if not r.get("expired") and "-" in holder:
+        try:
+          pids.add(int(holder.rsplit("-", 1)[1]))
+        except ValueError:
+          pass
+    return pids
+
+  def chaos_sleep(dt):
+    state["tick"] += 1
+    t = state["tick"]
+    actuator.reap()
+    procs = [p for p in actuator.procs if p.poll() is None]
+    if procs and not state["stalled"] and t >= stall_tick:
+      # wait for a worker that actually HOLDS a live range: freezing a
+      # leaseless worker stalls nothing (it never leases again), and the
+      # whole speculation path would go unexercised
+      holders = range_holder_pids()
+      victims = [p for p in procs if p.pid in holders]
+      if victims:
+        victim = victims[rng.randrange(len(victims))]
+        victim.send_signal(signal.SIGSTOP)
+        state.update(stalled=1, stopped=victim, stall_t=time.time(),
+                     resume_at=t + 8 + rng.randrange(3))
+    elif procs and not state["killed"] and t >= stall_tick + 3:
+      live = [p for p in procs if p is not state["stopped"]]
+      if live:
+        live[-1].send_signal(signal.SIGKILL)
+        state.update(killed=1, kill_t=time.time())
+    elif procs and not state["preempted"] and t >= stall_tick + 6:
+      live = [p for p in procs if p is not state["stopped"]]
+      if live:
+        live[0].send_signal(signal.SIGTERM)
+        state.update(preempted=1, preempt_t=time.time())
+    if state["stopped"] is not None and t >= state["resume_at"]:
+      # the zombie wakes mid-campaign: everything it still thinks it
+      # holds was speculated away or recycled — its acks must fence
+      state["stopped"].send_signal(signal.SIGCONT)
+      state["stopped"] = None
+      state["resumed"] = 1
+    time.sleep(dt)
+
+  summary = runner.run(sleep_fn=chaos_sleep)
+  if state["stopped"] is not None:   # never left frozen on a fast drain
+    state["stopped"].send_signal(signal.SIGCONT)
+
+  assert state["stalled"] and state["killed"], (
+    f"storm never landed its faults (ticks={state['tick']}): {state}"
+  )
+  assert not summary["timed_out"], f"campaign timed out: {summary}"
+  assert q.is_empty() and q.enqueued == 0, "hostile queue not drained"
+  assert q.dlq_count == 0, f"DLQ leakage: {q.dlq_ls()}"
+  # completions EXACT: double-issued twins, steals, recycles, and the
+  # waking zombie's late acks must never double-count a task
+  assert q.completed == n_tasks, (
+    f"completions drifted: tally={q.completed} tasks={n_tasks}"
+  )
+
+  hostile = layer_bytes(os.path.join(workdir, "layer"))
+  missing = sorted(set(clean) - set(hostile))
+  extra = sorted(set(hostile) - set(clean))
+  assert not missing and not extra, (
+    f"key sets differ: missing={missing[:5]} extra={extra[:5]}"
+  )
+  diff = [k for k in clean if clean[k] != hostile[k]]
+  assert not diff, f"{len(diff)} objects differ byte-wise: {diff[:5]}"
+
+  # the speculation ledger must reconcile FROM THE JOURNAL ALONE —
+  # issued counts on the driver, won/fenced on whichever worker's ack
+  # created the done marker; fleet.status merges them
+  records = fleet.load_effective(jpath)
+  counters = fleet.status(records)["counters"]
+  spec_issued = counters.get("speculation.issued", 0)
+  spec_won = counters.get("speculation.won", 0)
+  spec_fenced = counters.get("speculation.fenced", 0)
+  assert spec_issued > 0, (
+    f"storm never speculated — the stall was not flagged in time "
+    f"(counters={counters}, history={runner.history[-5:]})"
+  )
+  assert spec_won + spec_fenced == spec_issued, (
+    f"speculation ledger broken: issued={spec_issued} won={spec_won} "
+    f"fenced={spec_fenced}"
+  )
+
+  # forecast fidelity (ISSUE 17 satellite): mine THIS hostile journal —
+  # the task-duration model, the OBSERVED fleet trajectory (each
+  # worker's arrival offset, replacements included), and the storm's
+  # fault wall-times — then replay the campaign in the simulator with
+  # speculation + stealing and demand the forecast lands within ±20% of
+  # the live hostile makespan. Holding the fleet history and fault
+  # schedule fixed makes this a test of the sim's execution + lease +
+  # survival model, not of how well it can re-guess autoscaler latency.
+  task_spans = [
+    r for r in records
+    if r.get("kind") == "span" and r.get("name") == "task"
+  ]
+  first_task_ts = min(r["ts"] for r in task_spans)
+  # last FIRST-resolution, not last span end: the waking zombie's
+  # interrupted spans carry the whole freeze in their dur and its acks
+  # are fenced — only winners append to the completions tally, so the
+  # tally file's mtime is the instant the campaign actually finished
+  last_completion = os.path.getmtime(os.path.join(q.path, "completions"))
+  live_makespan = last_completion - first_task_ts
+  # the observed fleet trajectory: each distinct worker id's first task
+  # span, offset from campaign start — replacements the live autoscaler
+  # spawned mid-storm appear as later arrivals, so the sim replays the
+  # real capacity trough instead of re-deriving controller latency
+  first_seen = {}
+  for r in task_spans:
+    w = r.get("worker")
+    if w and (w not in first_seen or r["ts"] < first_seen[w]):
+      first_seen[w] = r["ts"]
+  arrivals = sorted(
+    max(ts - first_task_ts, 0.0) for ts in first_seen.values()
+  )
+  model = replay.WorkloadModel.mine(records)
+  # the frozen worker's interrupted span carries the whole SIGSTOP
+  # freeze in its dur; the ChaosSpec injects that fault explicitly, so
+  # fault-inflated samples would double-count the storm
+  clipped = model.clip_outliers()
+  cfg = sim_mod.SimConfig(
+    workers=len(arrivals), seed=seed, tasks=n_tasks,
+    batch_size=4, lease_sec=20.0, range_lease=1, speculate=1, steal=1,
+    steal_min_held_sec=1.0, worker_arrivals=arrivals,
+    # the live driver sweeps every tick with stall_sec=3 detection
+    # latency — the sim's sweep interval is the analogous lag
+    speculate_interval_sec=3.0,
+    # fault times replayed from the storm's own wall clock, landing on
+    # the earliest arrivals — the workers the live storm actually hit
+    chaos=sim_mod.ChaosSpec(
+      stall=1, kill=1, preempt=state["preempted"],
+      kill_at=max(state.get("kill_t", 0) - first_task_ts, 0.1),
+      preempt_at=max(state.get("preempt_t", 0) - first_task_ts, 0.1),
+    ),
+  )
+  forecast = sim_mod.FleetSimulator(model, cfg).run()
+  ratio = forecast["makespan_sec"] / max(live_makespan, 1e-9)
+  assert 0.8 <= ratio <= 1.2, (
+    f"sim forecast diverged from the live hostile run: "
+    f"forecast={forecast['makespan_sec']}s live={round(live_makespan, 3)}s "
+    f"(ratio {ratio:.2f}; arrivals={[round(a, 2) for a in arrivals]} "
+    f"clipped={clipped} chaos={cfg.chaos})"
+  )
+
+  return {
+    "tasks": n_tasks,
+    "clean_executed": n_clean,
+    "completions_tally": q.completed,
+    "dlq": q.dlq_count,
+    "objects_compared": len(clean),
+    "byte_identical": True,
+    "campaign": {k: summary[k] for k in
+                 ("ticks", "actions", "speculated", "wall_sec")},
+    "storm": {k: state[k] for k in
+              ("stalled", "killed", "preempted", "resumed")},
+    "speculation": {
+      "issued": spec_issued, "won": spec_won, "fenced": spec_fenced,
+      "duplicate_acks": counters.get("speculation.duplicate_ack", 0),
+      "wasted_ms": counters.get("speculation.wasted_ms", 0),
+    },
+    "steal": {
+      "claims": counters.get("steal.claims", 0),
+      "granted": counters.get("steal.granted", 0),
+      "tasks": counters.get("steal.tasks", 0),
+    },
+    "zombie_fenced": counters.get("zombie.delete", 0),
+    "forecast": {
+      "live_makespan_sec": round(live_makespan, 3),
+      "sim_makespan_sec": forecast["makespan_sec"],
+      "ratio": round(ratio, 3),
+      "worker_arrivals": [round(a, 2) for a in arrivals],
+      "outlier_durs_clipped": clipped,
+      "sim_speculation": forecast["speculation"],
+      "sim_steals": forecast["steals"],
+    },
+  }
+
+
 def main():
   ap = argparse.ArgumentParser(description=__doc__)
   ap.add_argument("--seed", type=int, default=0,
@@ -637,7 +929,7 @@ def main():
                   help="keep the scratch dir for inspection")
   ap.add_argument("--scenario",
                   choices=("faults", "preemption", "stall", "corruption",
-                           "all"),
+                           "hostile", "all"),
                   default="faults",
                   help="faults: ISSUE 1 storage/queue fault storm; "
                        "preemption: ISSUE 2 worker kill storm + zombie; "
@@ -645,7 +937,14 @@ def main():
                        "`fleet check` must flag it; "
                        "corruption: ISSUE 16 silent at-rest damage -> "
                        "audit names every fault, heal converges "
-                       "byte-identically")
+                       "byte-identically; "
+                       "hostile: ISSUE 17 closed-loop campaign runner "
+                       "under a kill/stall/preempt + speculation storm "
+                       "-> byte-identical, completions exact, ledger "
+                       "reconciles, sim forecast within ±20%")
+  ap.add_argument("--report-out", default=None,
+                  help="write the full soak report JSON here (CI uploads "
+                       "it as an artifact)")
   ap.add_argument("--trace-out", default=None,
                   help="write a Perfetto/Chrome trace JSON of the "
                        "preemption storm's merged journal here (CI "
@@ -693,8 +992,13 @@ def main():
       )
     if args.scenario in ("corruption", "all"):
       report["corruption"] = run_corruption_scenario(scratch, img, args.seed)
+    if args.scenario in ("hostile", "all"):
+      report["hostile"] = run_hostile_scenario(scratch, args.seed)
     report["counters"] = telemetry.counters_snapshot()
     report["wall_s"] = round(time.monotonic() - t0, 2)
+    if args.report_out:
+      with open(args.report_out, "w") as f:
+        json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
   finally:
     if args.keep:
