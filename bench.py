@@ -695,6 +695,117 @@ def bench_serve(seg):
   }
 
 
+def bench_serve_fleet(seg):
+  """Federated serving tier (ISSUE 18): three in-process replicas over
+  one seeded mem:// layer joined by a static consistent-hash ring. A
+  seeded zipfian herd (the stationary request mix of a million-user
+  viewer population) runs round-robin across the replicas; reports
+  fleet req/s, the peer-hit ratio (cold fills answered by the chunk's
+  ring owner instead of origin), and the shed rate once one replica's
+  admission gate is squeezed to a token-bucket far below offered load."""
+  import http.client
+  import threading
+
+  from igneous_tpu.observability import metrics
+  from igneous_tpu.serve import (
+    Federation, QosGate, ServeApp, ServeConfig, ServeServer,
+  )
+  from igneous_tpu.volume import Volume
+
+  sub = np.ascontiguousarray(seg[:128, :128, :64])
+  Volume.from_numpy(
+    sub, "mem://bench/serve_fleet_layer", chunk_size=(32, 32, 32),
+    layer_type="segmentation",
+  )
+  replicas = []
+  try:
+    for _ in range(3):
+      app = ServeApp(
+        {"layer": "mem://bench/serve_fleet_layer"}, default_layer="layer",
+        config=ServeConfig(ram_mb=64.0, synth_mips=False),
+      )
+      replicas.append(ServeServer(app, host="127.0.0.1", port=0))
+    urls = [
+      f"http://127.0.0.1:{srv.server_address[1]}" for srv in replicas
+    ]
+    for srv, url in zip(replicas, urls):
+      fed = Federation(peers=urls, timeout_ms=5000.0, retry_sec=30.0)
+      fed.activate(url)
+      srv.app.federation = fed
+    ports = [srv.server_address[1] for srv in replicas]
+
+    rng = np.random.default_rng(7)
+    keys = [
+      f"1_1_1/{x}-{x+32}_{y}-{y+32}_{z}-{z+32}"
+      for x in range(0, 128, 32)
+      for y in range(0, 128, 32)
+      for z in range(0, 64, 32)
+    ]
+    ranks = np.arange(1, len(keys) + 1, dtype=np.float64)
+    pop = 1.0 / ranks ** 1.1
+    pop /= pop.sum()
+    n_requests = 150 if QUICK else 600
+    clients = 8
+    draws = rng.choice(len(keys), size=n_requests, p=pop)
+    requests = [keys[d] for d in draws]
+    per_client = [requests[i::clients] for i in range(clients)]
+    barrier = threading.Barrier(clients)
+
+    def viewer(ci):
+      conns = {}
+      barrier.wait()
+      for j, key in enumerate(per_client[ci]):
+        port = ports[(ci + j) % len(ports)]
+        conn = conns.get(port)
+        if conn is None:
+          conn = conns[port] = http.client.HTTPConnection(
+            "127.0.0.1", port
+          )
+        conn.request("GET", f"/{key}", headers={"Accept-Encoding": "gzip"})
+        conn.getresponse().read()
+      for conn in conns.values():
+        conn.close()
+
+    before = metrics.counters_snapshot()
+    threads = [
+      threading.Thread(target=viewer, args=(ci,)) for ci in range(clients)
+    ]
+    t_all = time.perf_counter()
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    wall = time.perf_counter() - t_all
+    after = metrics.counters_snapshot()
+    peer_hits = after.get("serve.peer.hits", 0) - before.get(
+      "serve.peer.hits", 0
+    )
+    fetches = after.get("serve.fetch", 0) - before.get("serve.fetch", 0)
+    peer_hit_ratio = peer_hits / max(peer_hits + fetches, 1)
+
+    # overload one replica: token bucket at ~2 rps vs a 200-request blast
+    shed_app = replicas[0].app
+    shed_app._qos = QosGate(rps=2.0, burst_sec=1.0, layer_names=["layer"])
+    sheds = 0
+    blast = 200
+    conn = http.client.HTTPConnection("127.0.0.1", ports[0])
+    for _ in range(blast):
+      conn.request("GET", f"/{keys[0]}")
+      resp = conn.getresponse()
+      resp.read()
+      if resp.status == 503:
+        sheds += 1
+    conn.close()
+  finally:
+    for srv in replicas:
+      srv.shutdown()
+  return {
+    "serve_fleet_req_per_sec": round(n_requests / wall, 1),
+    "serve_fleet_peer_hit_ratio": round(peer_hit_ratio, 3),
+    "serve_fleet_shed_rate": round(sheds / blast, 3),
+  }
+
+
 def measure_transfer_MBps():
   import jax
 
@@ -1392,6 +1503,7 @@ def run_bench(platform: str):
    campaign_spec_issued) = bench_campaign_survival()
   xfer_passthrough, xfer_decode = bench_transfer_passthrough(seg)
   serve_stats = bench_serve(seg)
+  serve_fleet_stats = bench_serve_fleet(seg)
 
   # Headline = the framework's production kernel path on this platform:
   # device pyramid on TPU; on the CPU fallback, the native threaded host
@@ -1530,6 +1642,7 @@ def run_bench(platform: str):
       # ISSUE 9: interactive serving tier — hot-path latency, sustained
       # keep-alive throughput, and herd-coalescing effectiveness
       **serve_stats,
+      **serve_fleet_stats,
       # ISSUE 7: the device telemetry plane's own view of this bench run
       # — per-kernel compile/execute seconds + vox/s, per-device busy
       # seconds, recompile count, transfer bytes, utilization ratio

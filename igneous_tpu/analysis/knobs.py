@@ -279,6 +279,44 @@ _knob("IGNEOUS_SERVE_IO_THREADS", "int", 16,
       "backend fetch pool width", "serve")
 _knob("IGNEOUS_SERVE_DRAIN_SEC", "float", 30.0,
       "SIGTERM drain deadline for in-flight responses", "serve")
+_knob("IGNEOUS_SERVE_FLEET_PEERS", "str", None,
+      "comma-separated replica base URLs: static federation ring "
+      "membership (unset + no membership dir = federation off)",
+      "serve")
+_knob("IGNEOUS_SERVE_FLEET_MEMBERSHIP", "str", None,
+      "shared membership directory cloudpath: replicas heartbeat + "
+      "discover the ring here (dynamic join/leave)", "serve")
+_knob("IGNEOUS_SERVE_FLEET_SELF", "str", None,
+      "this replica's advertised base URL (default derived from the "
+      "bound host/port)", "serve")
+_knob("IGNEOUS_SERVE_FLEET_TTL_SEC", "float", 15.0,
+      "membership heartbeat TTL; silent replicas leave the ring",
+      "serve")
+_knob("IGNEOUS_SERVE_FLEET_TIMEOUT_MS", "float", 2000.0,
+      "peer-fill HTTP timeout before falling back to origin", "serve")
+_knob("IGNEOUS_SERVE_FLEET_RETRY_SEC", "float", 10.0,
+      "dead-peer quarantine before peer fills retry that replica",
+      "serve")
+_knob("IGNEOUS_SERVE_PREWARM", "bool", False,
+      "telemetry-driven prefetch of predicted-hot chunks mined from "
+      "journal request traces", "serve")
+_knob("IGNEOUS_SERVE_PREWARM_INTERVAL_SEC", "float", 30.0,
+      "prewarm cycle cadence (cycles are skipped while requests are "
+      "in flight)", "serve")
+_knob("IGNEOUS_SERVE_PREWARM_TOP", "int", 16,
+      "hottest mined chunks whose neighbors/children are predicted per "
+      "cycle", "serve")
+_knob("IGNEOUS_SERVE_PREWARM_BUDGET", "int", 64,
+      "max prefetch fetches per prewarm cycle", "serve")
+_knob("IGNEOUS_SERVE_QOS_RPS", "float", 0.0,
+      "global admission rate (requests/s) split across layers by QoS "
+      "weight; 0 disables load shedding", "serve")
+_knob("IGNEOUS_SERVE_QOS_WEIGHTS", "str", None,
+      "per-layer QoS weights as 'layer=weight,...'; unlisted layers "
+      "weigh 1", "serve")
+_knob("IGNEOUS_SERVE_QOS_BURST_SEC", "float", 2.0,
+      "token-bucket depth in seconds of each layer's admission rate",
+      "serve")
 
 # --- journal --------------------------------------------------------------
 _knob("IGNEOUS_JOURNAL", "str", None,
@@ -354,6 +392,15 @@ _knob("IGNEOUS_SLO_P95_MS", "float", None,
       "optional p95 task-latency SLO", "health / SLO")
 _knob("IGNEOUS_SERVE_SLO_P99_MS", "float", None,
       "optional p99 serve-latency SLO", "health / SLO")
+_knob("IGNEOUS_SERVE_PEER_FAIL_RATIO", "float", 0.5,
+      "peer-fill failure-storm ceiling (fallbacks / peer attempts)",
+      "health / SLO")
+_knob("IGNEOUS_SERVE_PEER_MIN", "int", 8,
+      "min peer-fill attempts before the failure-storm detector fires",
+      "health / SLO")
+_knob("IGNEOUS_SERVE_SHED_RATIO", "float", 0.2,
+      "shed-rate SLO ceiling (sheds / offered requests)",
+      "health / SLO")
 _knob("IGNEOUS_SERVE_MISS_RATIO", "float", 0.9,
       "cold-miss-storm: backend-fetch fraction ceiling",
       "health / SLO")

@@ -3156,9 +3156,25 @@ def view_cmd(path, port, browser, ng, pos, name, indirect):
                    "(env IGNEOUS_JOURNAL).")
 @click.option("--metrics-port", default=None, type=int,
               help="Prometheus /metrics port (also served inline at "
-                   "/metrics on the main port).")
+                   "/metrics on the main port; 0 auto-assigns).")
+@click.option("--peers", default=None,
+              help="Comma-separated replica base URLs: static federation "
+                   "ring membership (env IGNEOUS_SERVE_FLEET_PEERS).")
+@click.option("--peers-file", default=None,
+              help="Shared membership directory cloudpath: replicas "
+                   "heartbeat + discover the ring here "
+                   "(env IGNEOUS_SERVE_FLEET_MEMBERSHIP).")
+@click.option("--self-url", default=None,
+              help="This replica's advertised base URL (env "
+                   "IGNEOUS_SERVE_FLEET_SELF; default derived from the "
+                   "bound host/port).")
+@click.option("--prewarm/--no-prewarm", default=None,
+              help="Telemetry-driven prefetch of predicted-hot chunks "
+                   "mined from journal traces (env IGNEOUS_SERVE_PREWARM; "
+                   "default off).")
 def serve_cmd(paths, port, host, ram_mb, ssd_dir, ssd_mb, synth, writeback,
-              cache_control, journal, metrics_port):
+              cache_control, journal, metrics_port, peers, peers_file,
+              self_url, prewarm):
   """Serve one or more Precomputed layers over HTTP (ISSUE 9).
 
   PATHS are cloudpaths, optionally named: ``name=gs://bucket/layer``.
@@ -3177,7 +3193,7 @@ def serve_cmd(paths, port, host, ram_mb, ssd_dir, ssd_mb, synth, writeback,
 
   from .observability import journal as journal_mod
   from .observability import prom
-  from .serve import ServeApp, ServeConfig, ServeServer
+  from .serve import Federation, ServeApp, ServeConfig, ServeServer
 
   layers = {}
   for spec in paths:
@@ -3203,18 +3219,36 @@ def serve_cmd(paths, port, host, ram_mb, ssd_dir, ssd_mb, synth, writeback,
     ram_mb=ram_mb, ssd_dir=ssd_dir, ssd_mb=ssd_mb, synth_mips=synth,
     writeback=writeback, cache_control=cache_control,
   )
-  app = ServeApp(layers, config=config, default_layer=default_layer)
+  federation = Federation.from_env(peers=peers, membership_dir=peers_file)
+  app = ServeApp(layers, config=config, default_layer=default_layer,
+                 federation=federation, prewarm=prewarm)
   server = ServeServer(app, host=host, port=port,
                        drain_timeout=config.drain_sec)
+  bound_metrics = None
   if metrics_port is not None:
-    bound = prom.start_http_server(metrics_port)
-    if bound is not None:
-      click.echo(f"metrics: http://0.0.0.0:{bound}/metrics")
+    bound_metrics = prom.start_http_server(metrics_port)
+    if bound_metrics is not None:
+      click.echo(f"metrics: http://0.0.0.0:{bound_metrics}/metrics")
+  # the advertised URL needs the BOUND port (--port 0 auto-assigns),
+  # so federation activates only after the listening socket exists
+  if federation.configured:
+    from .analysis import knobs as knobs_mod
+
+    adv = self_url or knobs_mod.get_str("IGNEOUS_SERVE_FLEET_SELF")
+    if not adv:
+      adv_host = host
+      if adv_host in ("0.0.0.0", "::", ""):
+        adv_host = socket_mod.gethostname().split(".")[0]
+      adv = f"http://{adv_host}:{server.server_address[1]}"
+    federation.activate(adv)
   # machine-parsable readiness line (the CI smoke and orchestration
-  # scripts wait on this rather than polling the port)
+  # scripts wait on this rather than polling ports — it carries every
+  # BOUND port so N auto-assigned replicas can boot on one host)
   click.echo(json_mod.dumps({
     "event": "serve.listening", "port": server.server_address[1],
     "host": host, "layers": sorted(layers),
+    "metrics_port": bound_metrics,
+    "self_url": federation.self_url if federation.configured else None,
   }), nl=True)
 
   def _on_signal(_signum, _frame):

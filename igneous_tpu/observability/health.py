@@ -96,6 +96,13 @@ class HealthConfig:
   serve_p99_ms: Optional[float] = None
   serve_miss_ratio_max: float = 0.9
   serve_min_requests: int = 50
+  # serve federation (ISSUE 18): peer-fill failure storm = origin
+  # fallbacks above this fraction of peer attempts (the ring is
+  # half-dead and every miss pays a failed peer round before origin);
+  # shed-rate SLO = 503s above this fraction of offered requests
+  serve_peer_fail_max: float = 0.5
+  serve_peer_min_attempts: int = 8
+  serve_shed_ratio_max: float = 0.2
   # data integrity (ISSUE 16): corrupt reads + failed write-verifies +
   # quarantined objects above this count is an anomaly — the default 0
   # means ANY detected corruption alerts (it should: every one names a
@@ -130,6 +137,9 @@ class HealthConfig:
     "serve_p99_ms": "IGNEOUS_SERVE_SLO_P99_MS",
     "serve_miss_ratio_max": "IGNEOUS_SERVE_MISS_RATIO",
     "serve_min_requests": "IGNEOUS_SERVE_MIN_REQUESTS",
+    "serve_peer_fail_max": "IGNEOUS_SERVE_PEER_FAIL_RATIO",
+    "serve_peer_min_attempts": "IGNEOUS_SERVE_PEER_MIN",
+    "serve_shed_ratio_max": "IGNEOUS_SERVE_SHED_RATIO",
     "integrity_corrupt_max": "IGNEOUS_HEALTH_INTEGRITY_MAX",
     "speculate_waste_max": "IGNEOUS_SPECULATE_WASTE_MAX",
     "speculate_min_issued": "IGNEOUS_SPECULATE_MIN_ISSUED",
@@ -156,6 +166,7 @@ class HealthConfig:
     cfg.min_workers = int(cfg.min_workers)
     cfg.max_workers = int(cfg.max_workers)
     cfg.serve_min_requests = int(cfg.serve_min_requests)
+    cfg.serve_peer_min_attempts = int(cfg.serve_peer_min_attempts)
     cfg.speculate_min_issued = int(cfg.speculate_min_issued)
     return cfg
 
@@ -520,6 +531,43 @@ class HealthEngine:
         "target_ms": cfg.serve_p99_ms, "requests": serve_req,
       })
 
+    # serve federation detectors (ISSUE 18), from the fleet-aggregated
+    # counters: a peer-fill failure storm means misses pay a dead peer
+    # round before origin on every fill; shed rate over the SLO ceiling
+    # means the fleet is turning real viewers away faster than budgeted
+    peer_hits = counters.get("serve.peer.hits", 0)
+    peer_fallbacks = counters.get("serve.peer.fallback", 0)
+    peer_attempts = (
+      peer_hits + peer_fallbacks + counters.get("serve.peer.notfound", 0)
+    )
+    peer_fail_ratio = (
+      peer_fallbacks / peer_attempts if peer_attempts else None
+    )
+    if (
+      peer_attempts >= cfg.serve_peer_min_attempts
+      and peer_fail_ratio is not None
+      and peer_fail_ratio > cfg.serve_peer_fail_max
+    ):
+      anomalies.append({
+        "kind": "peer_fill_storm", "attempts": peer_attempts,
+        "fallbacks": peer_fallbacks,
+        "fail_ratio": round(peer_fail_ratio, 3),
+        "max": cfg.serve_peer_fail_max,
+      })
+    serve_sheds = counters.get("serve.shed.requests", 0)
+    serve_offered = serve_sheds + counters.get("serve.requests", 0)
+    shed_ratio = (serve_sheds / serve_offered) if serve_offered else None
+    if (
+      serve_offered >= cfg.serve_min_requests
+      and shed_ratio is not None
+      and shed_ratio > cfg.serve_shed_ratio_max
+    ):
+      anomalies.append({
+        "kind": "shed_rate_slo", "offered": serve_offered,
+        "sheds": serve_sheds, "shed_ratio": round(shed_ratio, 3),
+        "max": cfg.serve_shed_ratio_max,
+      })
+
     # SLO burn: error-budget consumption rate (1.0 = burning exactly at
     # budget; >1 = on track to violate the SLO)
     success_rate = (tasks_ok / tasks_total) if tasks_total else None
@@ -603,7 +651,7 @@ class HealthEngine:
       },
       "workers": workers_report,
     }
-    if serve_req > 0:
+    if serve_req > 0 or peer_attempts or serve_sheds:
       report["serve"] = {
         "requests": serve_req,
         "backend_fetches": serve_fetches,
@@ -613,6 +661,15 @@ class HealthEngine:
           round(serve_miss_ratio, 3) if serve_miss_ratio is not None else None
         ),
         "p99_target_ms": cfg.serve_p99_ms,
+        "peer_hits": peer_hits,
+        "peer_attempts": peer_attempts,
+        "peer_fail_ratio": (
+          round(peer_fail_ratio, 3) if peer_fail_ratio is not None else None
+        ),
+        "sheds": serve_sheds,
+        "shed_ratio": (
+          round(shed_ratio, 3) if shed_ratio is not None else None
+        ),
       }
     if spec_issued or counters.get("steal.claims", 0):
       report["speculation"] = {
@@ -668,6 +725,11 @@ def publish_gauges(report: dict) -> None:
     metrics.gauge_set("fleet.serve_p99_ms", srv["p99_ms"])
     if srv.get("miss_ratio") is not None:
       metrics.gauge_set("fleet.serve_miss_ratio", srv["miss_ratio"])
+    if srv.get("peer_fail_ratio") is not None:
+      metrics.gauge_set("fleet.serve_peer_fail_ratio",
+                        srv["peer_fail_ratio"])
+    if srv.get("shed_ratio") is not None:
+      metrics.gauge_set("fleet.serve_shed_ratio", srv["shed_ratio"])
   spec = report.get("speculation")
   if spec:
     # rendered by observability.prom as igneous_speculation_* — the
@@ -779,6 +841,10 @@ def check_lines(report: dict) -> List[str]:
       f"p99 {srv['p99_ms']}ms  miss {srv['miss_ratio']}"
       + (f" (p99 target {srv['p99_target_ms']}ms)"
          if srv.get("p99_target_ms") else "")
+      + (f"  peer-fill {srv['peer_hits']}/{srv['peer_attempts']}"
+         if srv.get("peer_attempts") else "")
+      + (f"  shed {srv['sheds']} ({srv['shed_ratio']})"
+         if srv.get("sheds") else "")
     ))
   for s in report["stragglers"]:
     if s["kind"] == "stalled":

@@ -4,7 +4,10 @@ An async HTTP server fronting one or many layers from any storage
 backend, with a multi-tier stored-bytes cache (RAM LRU → local-SSD spill
 → CDN via strong ETags), request coalescing (N clients, one backend
 fetch), and on-the-fly synthesis of missing mips through the device
-pool's downsample kernels.
+pool's downsample kernels. With peers configured (ISSUE 18) the fleet
+behaves as ONE cache: rendezvous-hash chunk ownership with peer-fill
+before origin, fleet-wide invalidation broadcast, per-layer QoS load
+shedding, and telemetry-driven prewarming (see :mod:`.federation`).
 
 Quick start::
 
@@ -18,6 +21,9 @@ or from the CLI: ``igneous serve gs://bucket/layer --port 8080``.
 
 from .app import LayerHandle, ServeApp, ServeConfig
 from .cache import Entry, TieredStoredCache, strong_etag
+from .federation import (
+  PEER_FILL_HEADER, Federation, HashRing, Prewarmer, QosGate,
+)
 from .server import HttpServer, Request, Response, ServeServer
 
 
@@ -34,7 +40,8 @@ def start_server(layers, host: str = "0.0.0.0", port: int = 0,
 
 
 __all__ = [
-  "Entry", "HttpServer", "LayerHandle", "Request", "Response",
+  "Entry", "Federation", "HashRing", "HttpServer", "LayerHandle",
+  "PEER_FILL_HEADER", "Prewarmer", "QosGate", "Request", "Response",
   "ServeApp", "ServeConfig", "ServeServer", "TieredStoredCache",
   "start_server", "strong_etag",
 ]

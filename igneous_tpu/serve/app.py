@@ -29,13 +29,19 @@ and cold-miss-storm anomalies from the journaled spans.
 
 Env knobs (all prefixed ``IGNEOUS_SERVE_``): RAM_MB, SSD_DIR, SSD_MB,
 CACHE_CONTROL, SYNTH_MIPS, WRITEBACK, MAX_OBJECT_MB, IO_THREADS,
-DRAIN_SEC.
+DRAIN_SEC — plus the federation surface (``IGNEOUS_SERVE_FLEET_*``,
+``IGNEOUS_SERVE_QOS_*``, ``IGNEOUS_SERVE_PREWARM*``; see
+:mod:`.federation`): when peers are configured, a local miss asks the
+chunk's ring owner before origin, uploads broadcast invalidations
+fleet-wide, admission control sheds with 503 + Retry-After, and idle
+cycles prefetch predicted-hot chunks mined from journal traces.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import math
 import os
 import posixpath
 import time
@@ -52,6 +58,7 @@ from ..observability import journal as journal_mod
 from ..observability import metrics, trace
 from ..storage import CloudFiles, compress_bytes, decompress_bytes, normalize_path
 from .cache import Entry, TieredStoredCache, strong_etag
+from .federation import PEER_FILL_HEADER, Federation, Prewarmer, QosGate
 from .server import Request, Response
 
 from ..analysis import knobs
@@ -135,7 +142,10 @@ class ServeApp:
 
   def __init__(self, layers: Union[str, Dict[str, str]],
                config: Optional[ServeConfig] = None,
-               default_layer: Optional[str] = None):
+               default_layer: Optional[str] = None,
+               federation: Optional[Federation] = None,
+               qos: Optional[QosGate] = None,
+               prewarm: Optional[bool] = None):
     if isinstance(layers, str):
       name = layers.rstrip("/").split("/")[-1] or "layer"
       layers = {name: layers}
@@ -157,6 +167,13 @@ class ServeApp:
     self._loop: Optional[asyncio.AbstractEventLoop] = None
     self._inflight: Dict[tuple, asyncio.Future] = {}
     self._closed = False
+    # fleet surface: inert objects unless peers/QoS/prewarm configured,
+    # so the single-replica path pays nothing
+    self.federation = federation if federation is not None else Federation.from_env()
+    self._qos = qos if qos is not None else QosGate(layer_names=list(self._layers))
+    if prewarm is None:
+      prewarm = knobs.get_bool("IGNEOUS_SERVE_PREWARM")
+    self._prewarmer = Prewarmer(self) if prewarm else None
     # overwrite/delete anywhere in this process (Volume.upload/delete,
     # pipeline write joins, serve's own write-back) invalidates the
     # serving tiers through the ONE shared entry point
@@ -168,12 +185,17 @@ class ServeApp:
     self._loop = loop
 
   async def housekeeping(self) -> None:
-    """Periodic gauges + journal flush, on the serve loop."""
+    """Periodic gauges + journal flush + federation tick, on the serve
+    loop (the blocking membership/prefetch IO runs on the executor)."""
     try:
       while True:
         await asyncio.sleep(1.0)
         self.update_gauges()
         await self._run(journal_mod.maybe_flush_active)
+        if self.federation.active:
+          await self._run(self.federation.tick)
+        if self._prewarmer is not None:
+          await self._run(self._prewarmer.maybe_cycle)
     except asyncio.CancelledError:
       pass
 
@@ -182,6 +204,7 @@ class ServeApp:
       return
     self._closed = True
     chunk_cache.unregister_invalidation_hook(self._on_invalidate)
+    self.federation.close()
     self.update_gauges()
     journal_mod.flush_active("drain")
     self._pool.shutdown(wait=False)
@@ -200,15 +223,26 @@ class ServeApp:
     for layer in self._layers.values():
       if layer.norm != norm:
         continue
-      prefix = None
-      if mip is not None:
-        meta = layer.try_meta()
-        if meta is not None:
-          try:
-            prefix = f"{meta.key(mip)}/"
-          except IndexError:
-            prefix = None
-      self._cache.invalidate(layer.name, prefix)
+      self._cache.invalidate(layer.name, self._mip_prefix(layer, mip))
+      # fleet-wide coherence: a write on THIS replica (writeback synth,
+      # Volume.upload in-process) must not leave stale bytes on peers.
+      # Best-effort fire-and-forget — receivers drop tiers directly
+      # (no hook) so the broadcast cannot loop.
+      if self.federation.active and not self._closed:
+        self._pool.submit(
+          self.federation.broadcast_invalidate, layer.name, mip
+        )
+
+  def _mip_prefix(self, layer: LayerHandle, mip: Optional[int]) -> Optional[str]:
+    if mip is None:
+      return None
+    meta = layer.try_meta()
+    if meta is None:
+      return None
+    try:
+      return f"{meta.key(mip)}/"
+    except IndexError:
+      return None
 
   # -- request handling ------------------------------------------------------
 
@@ -225,8 +259,6 @@ class ServeApp:
   async def handle(self, req: Request) -> Response:
     if req.method == "OPTIONS":
       return Response(204, headers=self._base_headers())
-    if req.method not in ("GET", "HEAD"):
-      return Response(405, b"method not allowed", self._base_headers())
     path = urllib.parse.unquote(req.target.split("?", 1)[0])
     key = posixpath.normpath(path.lstrip("/"))
     # never allow escaping the served layers (the CORS wildcard makes
@@ -237,12 +269,19 @@ class ServeApp:
       return Response(403, b"forbidden", self._base_headers())
     if key == ".":
       key = ""
+    if key.startswith("-/fed/"):
+      return await self._handle_fed(req, key[len("-/fed/"):])
+    if req.method not in ("GET", "HEAD"):
+      return Response(405, b"method not allowed", self._base_headers())
     if key == "healthz":
-      body = json.dumps({
+      body = {
         "ok": True, "layers": self.layer_names, "cache": self._cache.stats(),
-      }).encode("utf8")
+      }
+      if self.federation.configured:
+        body["federation"] = self.federation.stats()
       return Response(
-        200, body, self._base_headers() + [("Content-Type", "application/json")]
+        200, json.dumps(body).encode("utf8"),
+        self._base_headers() + [("Content-Type", "application/json")],
       )
     if key == "metrics":
       from ..observability import prom
@@ -263,7 +302,23 @@ class ServeApp:
       metrics.incr("serve.notfound")
       return Response(404, b"not found", self._base_headers())
     layer, subkey = routed
-    return await self._serve_key(layer, subkey, req)
+    # a peer fill was already admitted by the edge replica the client
+    # hit; re-gating it here would double-charge the same request
+    peer_fill = bool(req.header(PEER_FILL_HEADER))
+    if peer_fill:
+      metrics.incr("serve.peer.served")
+    else:
+      retry_after = self._qos.admit(layer.name)
+      if retry_after is not None:
+        metrics.incr("serve.shed.requests")
+        metrics.incr(f"serve.shed.layer.{layer.name}")
+        return Response(
+          503, b"overloaded",
+          self._base_headers() + [
+            ("Retry-After", str(int(max(1, math.ceil(retry_after))))),
+          ],
+        )
+    return await self._serve_key(layer, subkey, req, peer_fill=peer_fill)
 
   def _route(self, key: str) -> Optional[Tuple[LayerHandle, str]]:
     head, _, rest = key.partition("/")
@@ -273,8 +328,40 @@ class ServeApp:
       return self._layers[self.default_layer], key
     return None
 
-  async def _serve_key(self, layer: LayerHandle, key: str,
-                       req: Request) -> Response:
+  async def _handle_fed(self, req: Request, sub: str) -> Response:
+    """Internal fleet endpoints under ``/-/fed/`` (never routed as layer
+    keys: layer names cannot contain ``-/``). Peer-authenticated by the
+    same header the fill protocol uses."""
+    if sub == "status" and req.method in ("GET", "HEAD"):
+      body = json.dumps(self.federation.stats()).encode("utf8")
+      return Response(
+        200, body, self._base_headers() + [("Content-Type", "application/json")]
+      )
+    if not req.header(PEER_FILL_HEADER):
+      metrics.incr("serve.forbidden")
+      return Response(403, b"forbidden", self._base_headers())
+    if sub == "invalidate" and req.method == "POST":
+      qs = urllib.parse.parse_qs(urllib.parse.urlsplit(req.target).query)
+      layer_name = (qs.get("layer") or [""])[0]
+      layer = self._layers.get(layer_name)
+      if layer is None:
+        return Response(404, b"not found", self._base_headers())
+      prefix = None
+      if qs.get("mip"):
+        try:
+          mip = int(qs["mip"][0])
+        except ValueError:
+          return Response(400, b"bad mip", self._base_headers())
+        prefix = self._mip_prefix(layer, mip)
+      # drop tiers DIRECTLY (not via chunk_cache.invalidate): the hook
+      # path would re-broadcast and loop the fleet
+      self._cache.invalidate(layer_name, prefix)
+      metrics.incr("serve.peer.invalidate.received")
+      return Response(204, headers=self._base_headers())
+    return Response(404, b"not found", self._base_headers())
+
+  async def _serve_key(self, layer: LayerHandle, key: str, req: Request,
+                       peer_fill: bool = False) -> Response:
     ts = time.time()
     t0 = time.perf_counter()
     tinfo = trace.mint()
@@ -316,7 +403,9 @@ class ServeApp:
 
     entry, tier = await self._run(self._cache.get, layer.name, key)
     if entry is None:
-      entry, tier = await self._coalesced_fetch(layer, key, tid, root_id, sampled)
+      entry, tier = await self._coalesced_fetch(
+        layer, key, tid, root_id, sampled, allow_peer=not peer_fill
+      )
     if entry is None:
       metrics.incr("serve.notfound")
       return finish(Response(404, b"not found", self._base_headers()), 404, "miss")
@@ -401,7 +490,8 @@ class ServeApp:
     return None, None
 
   async def _coalesced_fetch(self, layer: LayerHandle, key: str, tid, root_id,
-                             sampled) -> Tuple[Optional[Entry], str]:
+                             sampled,
+                             allow_peer: bool = True) -> Tuple[Optional[Entry], str]:
     fkey = (layer.name, key)
     fut = self._inflight.get(fkey)
     if fut is not None:
@@ -422,9 +512,8 @@ class ServeApp:
         metrics.incr("serve.coalesce.waiters")
       else:
         metrics.incr("serve.coalesce.leaders")
-        tier = "origin"
-        entry = await self._run(
-          self._fetch_blocking, layer, key, tid, root_id, sampled
+        entry, tier = await self._run(
+          self._fill_blocking, layer, key, allow_peer, tid, root_id, sampled
         )
     except Exception as e:
       self._inflight.pop(fkey, None)
@@ -437,6 +526,65 @@ class ServeApp:
     if not fut.done():
       fut.set_result(entry)
     return entry, tier
+
+  def _fill_blocking(self, layer: LayerHandle, key: str, allow_peer: bool,
+                     tid, root_id, sampled) -> Tuple[Optional[Entry], str]:
+    """Executor thread: peer-fill from the chunk's ring owner when one
+    exists, origin otherwise. The single-flight leader runs this, so a
+    local herd costs one peer round and the owner's own single-flight
+    makes the fleet-wide cost one origin fetch."""
+    fed = self.federation
+    if allow_peer and fed.active:
+      owner = fed.owner(layer.name, key)
+      if owner is not None:
+        entry, authoritative = self._peer_fill(
+          layer, key, owner, tid, root_id, sampled
+        )
+        if authoritative:
+          # a peer 404 is final: the owner already consulted origin and
+          # tried synthesis, so retrying origin here would restore the
+          # N-replicas-hit-origin behavior federation exists to remove
+          return entry, "peer"
+        metrics.incr("serve.peer.fallback")
+    return self._fetch_blocking(layer, key, tid, root_id, sampled), "origin"
+
+  def _peer_fill(self, layer: LayerHandle, key: str, owner: str, tid, root_id,
+                 sampled) -> Tuple[Optional[Entry], bool]:
+    """One peer round. Returns ``(entry, authoritative)``: authoritative
+    False means transport/integrity failure — quarantine the peer and
+    fall back to origin."""
+    ts = time.time()
+    t0 = time.perf_counter()
+    status, data, method, etag = self.federation.peer_fetch(
+      owner, layer.name, key
+    )
+    if sampled:
+      trace.record_at(
+        "serve.peer", ts, time.perf_counter() - t0, tid, parent=root_id,
+        layer=layer.name, key=key, peer=owner, status=status,
+      )
+    if status == "hit":
+      actual = strong_etag(data)
+      if etag is not None and etag != actual:
+        # the peer transcoded (or corrupted) the stored bytes: the fill
+        # would poison this replica's tiers with a different ETag than
+        # the owner serves, breaking CDN dedup — treat as a peer failure
+        metrics.incr("serve.peer.etag_mismatch")
+        self.federation.mark_dead(owner)
+        return None, False
+      metrics.incr("serve.peer.hits")
+      metrics.incr("serve.peer.bytes", len(data))
+      self.federation.mark_alive(owner)
+      if len(data) <= int(self.config.max_object_mb * 1e6):
+        return self._cache.put(layer.name, key, data, method), True
+      return Entry(bytes(data), method, actual), True
+    if status == "miss":
+      metrics.incr("serve.peer.notfound")
+      self.federation.mark_alive(owner)
+      return None, True
+    metrics.incr("serve.peer.errors")
+    self.federation.mark_dead(owner)
+    return None, False
 
   def _fetch_blocking(self, layer: LayerHandle, key: str, tid, root_id,
                       sampled) -> Optional[Entry]:
@@ -620,6 +768,16 @@ class ServeApp:
     waiters = c.get("serve.coalesce.waiters", 0)
     if leaders:
       metrics.gauge_set("serve.coalesce_fan_in", (leaders + waiters) / leaders)
+    # fleet economics: of all cache FILLS, how many came from a peer
+    # instead of origin; of all admissions, how many were shed
+    peer_hits = c.get("serve.peer.hits", 0)
+    fills = peer_hits + c.get("serve.fetch", 0)
+    if fills:
+      metrics.gauge_set("serve.fleet.peer_hit_ratio", peer_hits / fills)
+    sheds = c.get("serve.shed.requests", 0)
+    offered = sheds + c.get("serve.requests", 0)
+    if offered:
+      metrics.gauge_set("serve.fleet.shed_ratio", sheds / offered)
     for q, name in ((0.5, "serve.p50_ms"), (0.99, "serve.p99_ms")):
       val = metrics.histogram_quantile("serve.request", q)
       if val is not None:

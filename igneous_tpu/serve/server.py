@@ -33,7 +33,8 @@ REASONS = {
   304: "Not Modified", 400: "Bad Request", 403: "Forbidden",
   404: "Not Found", 405: "Method Not Allowed",
   413: "Payload Too Large", 416: "Range Not Satisfiable",
-  500: "Internal Server Error", 502: "Bad Gateway",
+  429: "Too Many Requests", 500: "Internal Server Error",
+  502: "Bad Gateway", 503: "Service Unavailable",
 }
 
 

@@ -1,0 +1,568 @@
+"""ISSUE 18: serve federation — rendezvous ring determinism and
+rebalance bounds, peer-fill byte identity + ETag agreement, fleet-wide
+single-flight (cold herd = 1 origin fetch), owner-down fallback, loop
+prevention, QoS load shedding (503 + Retry-After, weighted shares),
+invalidation broadcast, file-backed membership join/leave, prewarm
+prediction from journaled access patterns, and the HealthEngine's
+peer-fill-storm / shed-rate detectors."""
+
+import json
+import http.client
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from igneous_tpu import chunk_cache
+from igneous_tpu.observability import health, journal as journal_mod
+from igneous_tpu.observability import metrics, trace
+from igneous_tpu.serve import (
+  Federation, HashRing, Prewarmer, QosGate, ServeApp, ServeConfig,
+  ServeServer, strong_etag,
+)
+from igneous_tpu.serve.federation import FileMembership, member_slug
+from igneous_tpu.storage import CloudFiles, clear_memory_storage, set_backend_wrapper
+from igneous_tpu.volume import Volume
+
+CHUNK = "1_1_1/0-64_0-64_0-64"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  clear_memory_storage()
+  chunk_cache.clear()
+  yield
+  set_backend_wrapper(None)
+  journal_mod.set_active(None)
+  clear_memory_storage()
+
+
+def _get(port, path, headers=None, method="GET"):
+  conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+  try:
+    conn.request(method, path, headers=headers or {})
+    resp = conn.getresponse()
+    return resp.status, dict(resp.getheaders()), resp.read()
+  finally:
+    conn.close()
+
+
+def _seed(path, rng, chunk=64, size=64):
+  data = rng.integers(0, 200, (size, size, size)).astype(np.uint8)
+  Volume.from_numpy(
+    data, path, chunk_size=(chunk, chunk, chunk), layer_type="image",
+    encoding="raw", compress="gzip",
+  )
+  return data
+
+
+def _fleet(layers, n=2, extra_peers=(), qos=None, **cfg_kw):
+  """n in-process replicas over the same layers, federated with a
+  static ring (ports are only known after boot, so the Federation is
+  attached post-boot exactly like the CLI does)."""
+  servers = []
+  for _ in range(n):
+    config = ServeConfig(**{"ram_mb": 64.0, "synth_mips": False, **cfg_kw})
+    default = next(iter(layers)) if len(layers) == 1 else None
+    app = ServeApp(dict(layers), config=config, default_layer=default,
+                   qos=qos)
+    servers.append(ServeServer(app, host="127.0.0.1", port=0))
+  urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+  ring_urls = urls + list(extra_peers)
+  for srv, url in zip(servers, urls):
+    fed = Federation(peers=ring_urls, timeout_ms=5000.0, retry_sec=30.0)
+    fed.activate(url)
+    srv.app.federation = fed
+  return servers, urls
+
+
+def _shutdown(servers):
+  for srv in servers:
+    srv.shutdown()
+
+
+def _owned_chunks(path, urls):
+  """chunk key -> owner url under the fleet's ring, for every stored
+  mip-0 chunk of the layer."""
+  ring = HashRing(urls)
+  cf = CloudFiles(path)
+  out = {}
+  layer_name = path.rstrip("/").split("/")[-1]
+  for key in cf.list():
+    if key.startswith("1_1_1/"):
+      out[key] = ring.owner(layer_name, key)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# ring determinism + rebalance bounds
+
+
+def test_ring_deterministic_and_balanced():
+  peers = [f"http://replica-{i}:8080" for i in range(3)]
+  keys = [f"1_1_1/k{i}" for i in range(600)]
+  a = HashRing(peers)
+  b = HashRing(list(reversed(peers)))  # order must not matter
+  owners = {k: a.owner("layer", k) for k in keys}
+  assert owners == {k: b.owner("layer", k) for k in keys}
+  by_peer = {p: sum(1 for o in owners.values() if o == p) for p in peers}
+  for p, count in by_peer.items():
+    assert count >= len(keys) * 0.1, f"{p} owns only {count}/{len(keys)}"
+  # ranked order is a permutation of the peer set, owner first
+  ranked = a.ranked("layer", keys[0])
+  assert sorted(ranked) == sorted(peers)
+  assert ranked[0] == owners[keys[0]]
+
+
+def test_ring_rebalance_bounds_on_leave_and_join():
+  peers = [f"http://replica-{i}:8080" for i in range(4)]
+  keys = [f"1_1_1/k{i}" for i in range(1000)]
+  before = {k: HashRing(peers).owner("L", k) for k in keys}
+
+  # leave: ONLY the departed peer's keys move (rendezvous optimality)
+  survivors = peers[:-1]
+  after_leave = {k: HashRing(survivors).owner("L", k) for k in keys}
+  for k in keys:
+    if before[k] != peers[-1]:
+      assert after_leave[k] == before[k], f"{k} moved on unrelated leave"
+
+  # join: a new peer takes ~1/N and nothing else shuffles
+  joined = peers + ["http://replica-new:8080"]
+  after_join = {k: HashRing(joined).owner("L", k) for k in keys}
+  moved = [k for k in keys if after_join[k] != before[k]]
+  assert all(after_join[k] == "http://replica-new:8080" for k in moved)
+  assert 0 < len(moved) < len(keys) * 0.4  # ~1/5 expected
+
+
+# ---------------------------------------------------------------------------
+# peer fill: byte identity, ETag agreement, tier labels
+
+
+def test_peer_fill_byte_identity_and_etag(rng):
+  path = "mem://serve/fed"
+  _seed(path, rng)
+  stored, method = CloudFiles(path).get_stored(CHUNK)
+  servers, urls = _fleet({"fed": path})
+  try:
+    owner = HashRing(urls).owner("fed", CHUNK)
+    edge = next(s for s, u in zip(servers, urls) if u != owner)
+    c0 = metrics.counters_snapshot()
+    status, headers, body = _get(
+      edge.server_address[1], f"/fed/{CHUNK}", {"Accept-Encoding": "gzip"}
+    )
+    assert status == 200
+    assert headers["X-Igneous-Cache"] == "peer"
+    assert body == stored and headers.get("Content-Encoding") == method
+    assert headers["ETag"] == strong_etag(stored)
+    c1 = metrics.counters_snapshot()
+    assert c1.get("serve.peer.hits", 0) - c0.get("serve.peer.hits", 0) == 1
+    assert c1.get("serve.peer.served", 0) - c0.get("serve.peer.served", 0) == 1
+    # the fill landed in the edge's tiers: the re-read never leaves RAM
+    status, headers, body2 = _get(
+      edge.server_address[1], f"/fed/{CHUNK}", {"Accept-Encoding": "gzip"}
+    )
+    assert headers["X-Igneous-Cache"] == "ram" and body2 == stored
+    # both replicas serve identical bytes + identical ETags
+    for srv in servers:
+      _, h, b = _get(srv.server_address[1], f"/fed/{CHUNK}",
+                     {"Accept-Encoding": "gzip"})
+      assert b == stored and h["ETag"] == strong_etag(stored)
+  finally:
+    _shutdown(servers)
+
+
+class _CountingBackend:
+  def __init__(self, inner, counts, delay):
+    self._inner = inner
+    self._counts = counts
+    self._delay = delay
+
+  def get(self, key):
+    with self._counts["lock"]:
+      self._counts[key] = self._counts.get(key, 0) + 1
+    time.sleep(self._delay)
+    return self._inner.get(key)
+
+  def __getattr__(self, name):
+    return getattr(self._inner, name)
+
+
+def test_fleet_wide_cold_herd_costs_one_origin_fetch(rng):
+  path = "mem://serve/fedherd"
+  _seed(path, rng)
+  counts = {"lock": threading.Lock()}
+  set_backend_wrapper(lambda b, pth: _CountingBackend(b, counts, 0.2))
+  servers, urls = _fleet({"fedherd": path})
+  try:
+    ports = [s.server_address[1] for s in servers]
+    n = 8
+    barrier = threading.Barrier(n)
+    bodies = [None] * n
+
+    def client(i):
+      barrier.wait()
+      _, _, bodies[i] = _get(ports[i % len(ports)], f"/fedherd/{CHUNK}",
+                             {"Accept-Encoding": "gzip"})
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    # the headline economics: a herd across BOTH replicas = 1 origin trip
+    assert counts.get(CHUNK, 0) == 1, (
+      f"expected exactly 1 origin fetch fleet-wide, saw {counts.get(CHUNK)}"
+    )
+    expect, _ = CloudFiles(path).get_stored(CHUNK)
+    assert all(b == expect for b in bodies)
+  finally:
+    _shutdown(servers)
+
+
+def test_owner_down_falls_back_to_origin(rng):
+  path = "mem://serve/feddown"
+  _seed(path, rng, chunk=32)
+  dead = "http://127.0.0.1:1"  # nothing listens on port 1
+  servers, urls = _fleet({"feddown": path}, n=1, extra_peers=[dead])
+  try:
+    port = servers[0].server_address[1]
+    owned = _owned_chunks(path, urls + [dead])
+    dead_keys = [k for k, o in owned.items() if o == dead]
+    assert dead_keys, "no chunk hashed to the dead peer (8 chunks)"
+    stored, _ = CloudFiles(path).get_stored(dead_keys[0])
+    c0 = metrics.counters_snapshot()
+    status, headers, body = _get(port, f"/feddown/{dead_keys[0]}",
+                                 {"Accept-Encoding": "gzip"})
+    assert status == 200 and body == stored
+    assert headers["X-Igneous-Cache"] == "origin"
+    c1 = metrics.counters_snapshot()
+    assert c1.get("serve.peer.errors", 0) - c0.get("serve.peer.errors", 0) == 1
+    assert c1.get("serve.peer.fallback", 0) - c0.get("serve.peer.fallback", 0) == 1
+    # the dead peer is quarantined: the next cold miss it owns goes
+    # STRAIGHT to origin, no doomed peer round first
+    if len(dead_keys) > 1:
+      c1 = metrics.counters_snapshot()
+      status, _, _ = _get(port, f"/feddown/{dead_keys[1]}")
+      assert status == 200
+      c2 = metrics.counters_snapshot()
+      assert c2.get("serve.peer.errors", 0) == c1.get("serve.peer.errors", 0)
+  finally:
+    _shutdown(servers)
+
+
+def test_peer_fill_requests_are_never_reforwarded(rng):
+  path = "mem://serve/fedloop"
+  _seed(path, rng)
+  counts = {"lock": threading.Lock()}
+  set_backend_wrapper(lambda b, pth: _CountingBackend(b, counts, 0.0))
+  servers, urls = _fleet({"fedloop": path})
+  try:
+    owner = HashRing(urls).owner("fedloop", CHUNK)
+    edge = next(s for s, u in zip(servers, urls) if u != owner)
+    # a request already marked as a peer fill must be served from
+    # origin by the NON-owner instead of hopping the ring again
+    c0 = metrics.counters_snapshot()
+    status, headers, _ = _get(
+      edge.server_address[1], f"/fedloop/{CHUNK}",
+      {"X-Igneous-Peer-Fill": "http://tester"},
+    )
+    assert status == 200
+    assert headers["X-Igneous-Cache"] == "origin"
+    c1 = metrics.counters_snapshot()
+    assert c1.get("serve.peer.hits", 0) == c0.get("serve.peer.hits", 0)
+  finally:
+    _shutdown(servers)
+
+
+# ---------------------------------------------------------------------------
+# QoS: weighted token buckets, 503 + Retry-After
+
+
+def test_qos_weighted_shares_unit():
+  clock = [0.0]
+  gate = QosGate(rps=10.0, weights={"hot": 4.0, "cold": 1.0},
+                 burst_sec=1.0, layer_names=["hot", "cold"],
+                 now_fn=lambda: clock[0])
+  assert gate.rate_for("hot") == pytest.approx(8.0)
+  assert gate.rate_for("cold") == pytest.approx(2.0)
+  hot_admits = sum(1 for _ in range(20) if gate.admit("hot") is None)
+  cold_admits = sum(1 for _ in range(20) if gate.admit("cold") is None)
+  assert hot_admits == 8 and cold_admits == 2  # full buckets, no refill
+  retry = gate.admit("cold")
+  assert retry is not None and retry > 0
+  clock[0] += retry  # honoring Retry-After readmits
+  assert gate.admit("cold") is None
+
+
+def test_shed_returns_503_with_retry_after(rng):
+  path = "mem://serve/qos"
+  _seed(path, rng)
+  gate = QosGate(rps=0.5, weights={}, burst_sec=1.0, layer_names=["qos"])
+  config = ServeConfig(ram_mb=64.0, synth_mips=False)
+  app = ServeApp({"qos": path}, config=config, default_layer="qos",
+                 qos=gate)
+  srv = ServeServer(app, host="127.0.0.1", port=0)
+  try:
+    port = srv.server_address[1]
+    c0 = metrics.counters_snapshot()
+    status, _, _ = _get(port, f"/{CHUNK}")
+    assert status == 200  # the one-token burst admits the first request
+    status, headers, body = _get(port, f"/{CHUNK}")
+    assert status == 503
+    assert int(headers["Retry-After"]) >= 1
+    c1 = metrics.counters_snapshot()
+    assert c1.get("serve.shed.requests", 0) - c0.get("serve.shed.requests", 0) == 1
+    assert c1.get("serve.shed.layer.qos", 0) - c0.get("serve.shed.layer.qos", 0) == 1
+    # healthz/metrics stay reachable while the layer sheds
+    status, _, _ = _get(port, "/healthz")
+    assert status == 200
+  finally:
+    srv.shutdown()
+
+
+def test_peer_fills_bypass_admission(rng):
+  """The owner must answer peer fills even when its QoS gate is
+  exhausted — the edge replica already admitted the client."""
+  path = "mem://serve/qospeer"
+  _seed(path, rng)
+  gate = QosGate(rps=0.001, weights={}, burst_sec=1.0,
+                 layer_names=["qospeer"])
+  app = ServeApp({"qospeer": path},
+                 config=ServeConfig(ram_mb=64.0, synth_mips=False),
+                 default_layer="qospeer", qos=gate)
+  srv = ServeServer(app, host="127.0.0.1", port=0)
+  try:
+    port = srv.server_address[1]
+    _get(port, f"/{CHUNK}")  # burn the burst token
+    status, _, _ = _get(port, f"/{CHUNK}")
+    assert status == 503
+    status, _, _ = _get(port, f"/{CHUNK}",
+                        {"X-Igneous-Peer-Fill": "http://edge"})
+    assert status == 200
+  finally:
+    srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide invalidation broadcast
+
+
+def test_invalidation_broadcast_reaches_peers(rng):
+  path = "mem://serve/fedinv"
+  data = _seed(path, rng)
+  servers, urls = _fleet({"fedinv": path})
+  try:
+    ports = [s.server_address[1] for s in servers]
+    etags = []
+    for port in ports:
+      status, h, _ = _get(port, f"/{CHUNK}", {"Accept-Encoding": "gzip"})
+      assert status == 200
+      etags.append(h["ETag"])
+    assert etags[0] == etags[1]
+    # replica B's LOCAL hook unhooked: only the HTTP broadcast from A
+    # (whose hook fires on the in-process upload below) can reach it
+    appB = servers[1].app
+    chunk_cache.unregister_invalidation_hook(appB._on_invalidate)
+    vol = Volume(path)
+    new = ((data.astype(np.uint16) + 55) % 200).astype(np.uint8)
+    vol.upload(vol.meta.bounds(0), new, mip=0)
+    deadline = time.monotonic() + 10.0
+    fresh = None
+    while time.monotonic() < deadline:
+      status, h, body = _get(ports[1], f"/{CHUNK}",
+                             {"Accept-Encoding": "gzip"})
+      if h["ETag"] != etags[1]:
+        fresh = (h["ETag"], body)
+        break
+      time.sleep(0.05)
+    assert fresh is not None, "broadcast invalidation never reached peer"
+    stored, _ = CloudFiles(path).get_stored(CHUNK)
+    assert fresh[1] == stored and fresh[0] == strong_etag(stored)
+  finally:
+    _shutdown(servers)
+
+
+# ---------------------------------------------------------------------------
+# membership: join/leave rebuilds the ring
+
+
+def test_file_membership_join_and_graceful_leave(tmp_path):
+  mdir = f"file://{tmp_path}/members"
+  a = Federation(membership_dir=mdir, ttl_sec=30.0)
+  b = Federation(membership_dir=mdir, ttl_sec=30.0)
+  a.activate("http://127.0.0.1:7001")
+  b.activate("http://127.0.0.1:7002")
+  a.tick(force=True)  # a's first tick ran before b joined
+  assert a.stats()["ring"] == ["http://127.0.0.1:7001", "http://127.0.0.1:7002"]
+  assert b.stats()["ring"] == ["http://127.0.0.1:7001", "http://127.0.0.1:7002"]
+  # some keys are owned by the peer; after its graceful leave, none are
+  owned_by_b = [
+    k for k in (f"1_1_1/k{i}" for i in range(64))
+    if a.owner("L", k) == "http://127.0.0.1:7002"
+  ]
+  assert owned_by_b
+  b.close()  # deletes b's membership record
+  a.tick(force=True)
+  assert a.stats()["ring"] == ["http://127.0.0.1:7001"]
+  assert all(a.owner("L", k) is None for k in owned_by_b)
+
+
+def test_stale_heartbeats_age_out(tmp_path):
+  mdir = f"file://{tmp_path}/members"
+  m = FileMembership(mdir, ttl_sec=0.2)
+  m.heartbeat("http://127.0.0.1:7001")
+  assert m.poll("http://self") == ("http://127.0.0.1:7001", "http://self")
+  time.sleep(0.3)
+  assert m.poll("http://self") == ("http://self",)
+  assert member_slug("http://a:1") != member_slug("http://a:2")
+
+
+# ---------------------------------------------------------------------------
+# prewarm: journal-mined access pattern -> neighbor prefetch
+
+
+def test_prewarm_predicts_and_fills_neighbors(rng, tmp_path):
+  path = "mem://serve/prewarm"
+  _seed(path, rng, chunk=32, size=64)  # 8 chunks of 32^3
+  jpath = f"file://{tmp_path}/journal"
+  journal_mod.set_active(journal_mod.Journal(jpath, worker_id="serve-t"))
+  config = ServeConfig(ram_mb=64.0, synth_mips=False)
+  app = ServeApp({"prewarm": path}, config=config, default_layer="prewarm")
+  srv = ServeServer(app, host="127.0.0.1", port=0)
+  counts = {"lock": threading.Lock()}
+  try:
+    port = srv.server_address[1]
+    hot = "1_1_1/0-32_0-32_0-32"
+    for _ in range(3):
+      status, _, _ = _get(port, f"/{hot}")
+      assert status == 200
+    journal_mod.flush_active("test")
+
+    pw = Prewarmer(app, interval_sec=0.0, top=4, budget=16)
+    mined = pw.mine(journal_mod.read_records(jpath))
+    assert mined.get(("prewarm", hot), 0) >= 3
+    predicted = pw.predict(mined)
+    neighbors = {
+      "1_1_1/32-64_0-32_0-32", "1_1_1/0-32_32-64_0-32",
+      "1_1_1/0-32_0-32_32-64",
+    }
+    assert neighbors <= {k for _, k in predicted}
+    assert ("prewarm", hot) not in predicted  # already hot, not re-fetched
+
+    stats = pw.cycle()
+    assert stats["fetched"] >= 3
+    # the predicted neighbors now serve straight from RAM: no origin trip
+    set_backend_wrapper(lambda b, pth: _CountingBackend(b, counts, 0.0))
+    for key in neighbors:
+      status, headers, _ = _get(port, f"/{key}")
+      assert status == 200
+      assert headers["X-Igneous-Cache"] == "ram"
+    assert not counts.get(next(iter(neighbors)))
+  finally:
+    srv.shutdown()
+
+
+def test_prewarm_zoom_children(rng):
+  """A hot mip-1 chunk predicts its mip-0 children (zoom-in)."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import LocalTaskQueue
+
+  path = "mem://serve/pwzoom"
+  data = rng.integers(0, 200, (64, 64, 64)).astype(np.uint8)
+  Volume.from_numpy(data, path, chunk_size=(32, 32, 32))
+  tasks = tc.create_downsampling_tasks(
+    path, num_mips=1, memory_target=16 * 1024 * 1024
+  )
+  LocalTaskQueue(parallel=1, progress=False).insert(tasks)
+  app = ServeApp({"pwzoom": path},
+                 config=ServeConfig(ram_mb=64.0, synth_mips=False),
+                 default_layer="pwzoom")
+  try:
+    meta = app.layer("pwzoom").try_meta()
+    mip1_key = f"{meta.key(1)}/0-32_0-32_0-32"
+    pw = Prewarmer(app, interval_sec=0.0, top=4, budget=16)
+    predicted = pw.predict({("pwzoom", mip1_key): 5})
+    children = sorted(
+      k for _, k in predicted if k.startswith(f"{meta.key(0)}/")
+    )
+    # planar (2,2,1) downsampling: the mip-1 chunk upscales to x/y
+    # 0-64, z 0-32 — exactly 2x2x1 mip-0 chunks
+    assert children == [
+      f"{meta.key(0)}/0-32_0-32_0-32", f"{meta.key(0)}/0-32_32-64_0-32",
+      f"{meta.key(0)}/32-64_0-32_0-32", f"{meta.key(0)}/32-64_32-64_0-32",
+    ]
+  finally:
+    app.close()
+
+
+# ---------------------------------------------------------------------------
+# health detectors
+
+
+def test_health_peer_fill_storm_and_shed_rate():
+  now = time.time()
+  records = [{
+    "kind": "counters", "worker": "serve-0", "ts": now - 10,
+    "event": "interval", "counters": {
+      "serve.requests": 100, "serve.peer.hits": 2,
+      "serve.peer.fallback": 10, "serve.peer.notfound": 0,
+      "serve.shed.requests": 60,
+    },
+  }]
+  cfg = health.HealthConfig(window_sec=600.0)
+  rep = health.HealthEngine(cfg).evaluate(records, now=now)
+  kinds = {a["kind"] for a in rep["anomalies"]}
+  assert "peer_fill_storm" in kinds
+  assert "shed_rate_slo" in kinds
+  assert rep["serve"]["peer_attempts"] == 12
+  assert rep["serve"]["sheds"] == 60
+  assert rep["serve"]["shed_ratio"] == pytest.approx(60 / 160, abs=1e-3)
+  lines = "\n".join(health.check_lines(rep))
+  assert "peer_fill_storm" in lines and "shed_rate_slo" in lines
+
+
+def test_health_quiet_fleet_has_no_federation_anomalies():
+  now = time.time()
+  records = [{
+    "kind": "counters", "worker": "serve-0", "ts": now - 10,
+    "event": "interval", "counters": {
+      "serve.requests": 100, "serve.peer.hits": 50,
+      "serve.peer.fallback": 1, "serve.shed.requests": 2,
+    },
+  }]
+  rep = health.HealthEngine(health.HealthConfig()).evaluate(
+    records, now=now
+  )
+  kinds = {a["kind"] for a in rep["anomalies"]}
+  assert "peer_fill_storm" not in kinds and "shed_rate_slo" not in kinds
+
+
+# ---------------------------------------------------------------------------
+# fed endpoints
+
+
+def test_fed_status_and_invalidate_endpoint_auth(rng):
+  path = "mem://serve/fedep"
+  _seed(path, rng)
+  servers, urls = _fleet({"fedep": path}, n=1)
+  try:
+    port = servers[0].server_address[1]
+    status, _, body = _get(port, "/-/fed/status")
+    stats = json.loads(body)
+    assert status == 200 and stats["self"] == urls[0]
+    # invalidate requires the peer header and POST
+    status, _, _ = _get(port, "/-/fed/invalidate?layer=fedep", method="POST")
+    assert status == 403
+    status, _, _ = _get(
+      port, "/-/fed/invalidate?layer=fedep",
+      {"X-Igneous-Peer-Fill": "http://peer"}, method="POST",
+    )
+    assert status == 204
+    status, _, _ = _get(
+      port, "/-/fed/invalidate?layer=nope",
+      {"X-Igneous-Peer-Fill": "http://peer"}, method="POST",
+    )
+    assert status == 404
+  finally:
+    _shutdown(servers)
