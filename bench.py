@@ -1139,6 +1139,86 @@ def bench_ragged():
     os.environ.pop("IGNEOUS_POOL_HOST", None)
 
 
+_CACHE_BENCH_CHILD = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from igneous_tpu.parallel import paged
+from igneous_tpu.observability import device as dev
+rng = np.random.default_rng(19)
+imgs = [rng.integers(0, 255, s).astype(np.uint8)
+        for s in [(48, 41, 25), (24, 24, 24), (43, 16, 9)]]
+paged.paged_pyramid(imgs, (2, 2, 1), num_mips=2)
+led = dev.LEDGER
+print(json.dumps({
+  "compile_s": sum(k["compile_s"] for k in led.kernels.values()),
+  "cc": dict(led.compile_cache),
+}))
+"""
+
+
+def bench_compile_cache():
+  """Persistent compile cache (ISSUE 19): the same paged workload in two
+  FRESH interpreters sharing one file:// cache. The cold child pays the
+  XLA compiles and publishes executables; the warm child fetches. cold_s
+  is the cold child's measured compile seconds, warm_s what the warm
+  child paid instead (fetch + any residual compiles) — their ratio is
+  the per-worker startup tax the cache removes fleet-wide. Returns
+  (cold_s, warm_s) or None when the children fail (e.g. no
+  serialize_executable support on this backend)."""
+  import tempfile
+
+  tmp = tempfile.mkdtemp(prefix="igneous-bench-cc-")
+
+  def child():
+    env = dict(os.environ)
+    env.update({
+      "JAX_PLATFORMS": "cpu",
+      "PALLAS_AXON_POOL_IPS": "",
+      "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+      "IGNEOUS_COMPILE_CACHE": f"file://{tmp}/cache",
+    })
+    env.pop("AXON_POOL_SVC_OVERRIDE", None)
+    env.pop("AXON_LOOPBACK_RELAY", None)
+    proc = subprocess.run(
+      [sys.executable, "-c", _CACHE_BENCH_CHILD, _REPO_DIR],
+      env=env, cwd=_REPO_DIR, capture_output=True, text=True,
+      timeout=600,
+    )
+    if proc.returncode != 0:
+      return None
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+  cold = child()
+  warm = child()
+  if not cold or not warm:
+    return None
+  if not cold["cc"].get("puts") or not warm["cc"].get("hits"):
+    return None  # the cache never engaged; a ratio would be fiction
+  cold_s = cold["compile_s"]
+  warm_s = warm["compile_s"] + warm["cc"].get("fetch_s", 0.0)
+  return cold_s, warm_s
+
+
+def bench_tune():
+  """Autotuner (ISSUE 19): a budget-bounded `igneous tune` sweep on the
+  live backend — best-vs-default ratio across the tunable knobs (1.0 =
+  registry defaults already optimal; every candidate byte-identity
+  checked inside the sweep). None when the sweep fails."""
+  import tempfile
+
+  from igneous_tpu import tune as tune_mod
+
+  try:
+    config = tune_mod.run(
+      out=f"file://{tempfile.mkdtemp(prefix='igneous-bench-tune-')}",
+      budget_sec=60.0 if QUICK else 180.0, repeats=2, size=32,
+    )
+  except Exception:
+    return None
+  return config.get("tune_best_vs_default_ratio")
+
+
 def bench_host_kernels(img, seg):
   """The production path on an accelerator-less host: the native C++
   pooling kernels threaded across every core — exactly what
@@ -1494,6 +1574,8 @@ def run_bench(platform: str):
   mesh_extract_rate = bench_mesh_extract_kernel()
   pyramid_fused_rate = bench_pyramid_fused(img)
   ragged_batched_rate, ragged_solo_rate, pad_waste_pct = bench_ragged()
+  cache_pair = bench_compile_cache()
+  tune_ratio = bench_tune()
   mesh_forge_rate, skel_forge_rate = bench_forge_pipelines()
   codec_tbl = bench_codecs(img, seg)
   cseg_speedup = bench_cseg_speedup()
@@ -1637,6 +1719,30 @@ def run_bench(platform: str):
       "pad_waste_pct": (
         pad_waste_pct if pad_waste_pct is not None
         else _skip("no pad-waste bytes recorded during the paged run")
+      ),
+      # ISSUE 19: the per-worker startup tax the persistent compile
+      # cache removes — the same paged workload in two fresh
+      # interpreters sharing a file:// cache, compile seconds paid cold
+      # vs fetch seconds paid warm
+      "compile_cache_cold_s": (
+        round(cache_pair[0], 4) if cache_pair
+        else _skip("compile cache children failed or cache never engaged")
+      ),
+      "compile_cache_warm_s": (
+        round(cache_pair[1], 4) if cache_pair
+        else _skip("compile cache children failed or cache never engaged")
+      ),
+      "compile_cache_speedup": (
+        round(cache_pair[0] / cache_pair[1], 2)
+        if cache_pair and cache_pair[1] > 0
+        else _skip("warm child paid ~zero; ratio undefined")
+      ),
+      # ISSUE 19: budget-bounded autotune sweep on this backend — <1.0
+      # means a candidate beat the registry defaults (byte-identity
+      # asserted per candidate inside the sweep)
+      "tune_best_vs_default_ratio": (
+        tune_ratio if tune_ratio is not None
+        else _skip("tune sweep failed or measured nothing")
       ),
       "pool_ab": pool_ab,
       # ISSUE 9: interactive serving tier — hot-path latency, sustained

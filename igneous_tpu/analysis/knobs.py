@@ -60,6 +60,7 @@ _REGISTRY: Dict[str, Knob] = {}
 # section display order for the generated README table
 SECTIONS = (
   "pipeline", "chunk cache", "device kernels", "paged batching",
+  "compile cache / autotune",
   "multihost", "worker lifecycle", "retry", "queue", "campaign survival",
   "storage", "integrity", "serve",
   "journal", "trace / metrics / profile", "health / SLO", "autoscale",
@@ -122,6 +123,9 @@ _knob("IGNEOUS_CCL_TILE", "str", "",
 _knob("IGNEOUS_EDT_BACKEND", "str", "",
       "euclidean-distance-transform backend: `native|numpy|device` "
       "(auto when unset)", "device kernels")
+_knob("IGNEOUS_EDT_LINE_BLOCK", "int", 256,
+      "lines per EDT envelope block in the device kernel (cache-resident "
+      "scan carries; any value is bitwise-identical)", "device kernels")
 _knob("IGNEOUS_MESH_EMIT", "str", "",
       "marching-cubes triangle emission: `host|device` (auto when "
       "unset)", "device kernels")
@@ -133,6 +137,25 @@ _knob("IGNEOUS_PAGE_SHAPE", "shape", "32,32,32",
 _knob("IGNEOUS_PAGE_BATCH", "int", 32,
       "pages per dispatch round (rounded up to a pow2 multiple of the "
       "device count)", "paged batching")
+
+# --- compile cache / autotune (ISSUE 19) ----------------------------------
+_knob("IGNEOUS_COMPILE_CACHE", "str", None,
+      "persistent AOT-executable cache root (`gs://…`|`file://…`); "
+      "workers fetch serialized executables instead of compiling; unset "
+      "disables", "compile cache / autotune")
+_knob("IGNEOUS_EXECUTOR_CACHE_CAP", "int", 64,
+      "max compiled signatures held per in-process executor cache "
+      "(least-recently-used eviction)", "compile cache / autotune")
+_knob("IGNEOUS_TUNE_CONFIG", "str", None,
+      "tuned-config root override; unset reads `tuned/<device_kind>.json` "
+      "under IGNEOUS_COMPILE_CACHE (knob resolution: explicit env > "
+      "tuned config > registry default)", "compile cache / autotune")
+_knob("IGNEOUS_TUNE_BUDGET_SEC", "float", None,
+      "`igneous tune` wall-clock budget in seconds; unset sweeps every "
+      "candidate", "compile cache / autotune")
+_knob("IGNEOUS_TUNE_REPEATS", "int", 2,
+      "timed repeats per tune candidate (best-of)",
+      "compile cache / autotune")
 
 # --- multihost ------------------------------------------------------------
 _knob("IGNEOUS_COORDINATOR", "str", None,
@@ -551,6 +574,13 @@ def set_env(name: str, value: str) -> None:
 def setdefault_env(name: str, value: str) -> None:
   _lookup(name)
   os.environ.setdefault(name, str(value))
+
+
+def del_env(name: str) -> None:
+  """Registered unset — the autotuner's sweep must be able to restore a
+  knob to its genuinely-unset state between candidates."""
+  _lookup(name)
+  os.environ.pop(name, None)
 
 
 BEGIN_MARK = "<!-- knob-table:begin (igneous lint --knobs-md) -->"

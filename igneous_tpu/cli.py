@@ -3295,6 +3295,72 @@ def lint_cmd(root, knobs_md, write, baseline, update_baseline, select,
     raise SystemExit(rc)
 
 
+@main.command("tune")
+@click.option("--out", default=None,
+              help="Config root to write tuned/<device_kind>.json under "
+                   "(default: IGNEOUS_TUNE_CONFIG or IGNEOUS_COMPILE_CACHE).")
+@click.option("--budget", "budget_sec", type=float, default=None,
+              help="Wall-clock budget for the whole sweep in seconds "
+                   "(default: IGNEOUS_TUNE_BUDGET_SEC; unset = unbounded).")
+@click.option("--repeats", type=int, default=None,
+              help="Timed runs per candidate, best-of "
+                   "(default: IGNEOUS_TUNE_REPEATS).")
+@click.option("--size", type=int, default=48, show_default=True,
+              help="Edge length of the seeded sweep workloads.")
+@click.option("--knob", "only", multiple=True,
+              help="Sweep only these knobs (repeatable; default: all).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Print the full tuned config as JSON.")
+def tune_cmd(out, budget_sec, repeats, size, only, as_json):
+  """Autotune kernel knobs for this device kind (see README
+  'Compile cache & autotuner').
+
+  Sweeps Pallas CCL tile shapes, EDT line-block geometry, and page
+  shape/batch on seeded workloads; every candidate must be
+  byte-identical to the registry default. Winners are persisted as
+  tuned/<device_kind>.json and picked up automatically (resolution:
+  explicit env > tuned config > registry default).
+  """
+  import json
+
+  from igneous_tpu import tune as tune_mod
+  from igneous_tpu.analysis import knobs as knobs_mod
+  for name in only:
+    if name not in tune_mod.TUNABLE:
+      raise click.BadParameter(
+        f"unknown tunable {name!r}; choose from "
+        f"{', '.join(tune_mod.TUNABLE)}"
+      )
+  pinned = [n for n in (only or tune_mod.TUNABLE) if knobs_mod.raw(n)]
+  if pinned:
+    raise click.ClickException(
+      f"refusing to tune while {', '.join(pinned)} is pinned in the "
+      "environment — explicit env always outranks tuned configs, so the "
+      "sweep could never take effect; unset it first"
+    )
+  config = tune_mod.run(
+    out=out, budget_sec=budget_sec, repeats=repeats, size=size,
+    only=list(only) or None, log=click.echo,
+  )
+  if as_json:
+    click.echo(json.dumps(config, indent=2, sort_keys=True))
+    return
+  if config["knobs"]:
+    click.echo(f"tuned {len(config['knobs'])} knob(s): "
+               + ", ".join(f"{k}={v}" for k, v in config["knobs"].items()))
+  else:
+    click.echo("registry defaults already optimal; nothing tuned")
+  ratio = config.get("tune_best_vs_default_ratio")
+  if ratio is not None:
+    click.echo(f"tune_best_vs_default_ratio: {ratio}")
+  if config.get("written_to"):
+    click.echo(f"wrote {config['written_to']}")
+  else:
+    click.echo("no config root resolvable (pass --out or set "
+               "IGNEOUS_TUNE_CONFIG / IGNEOUS_COMPILE_CACHE); "
+               "config not persisted")
+
+
 @main.command("license")
 def license_cmd():
   click.echo("igneous-tpu is licensed under the BSD 3-Clause license.")

@@ -100,6 +100,12 @@ class DeviceLedger:
       self.recompiles = 0  # guarded-by: self.lock
       self.dispatches = 0  # guarded-by: self.lock
       self.fastpath = {"batched": 0, "host": 0}  # guarded-by: self.lock
+      # persistent compile-cache accounting (ISSUE 19): saved_s sums the
+      # producer-measured compile seconds each hit avoided — the number
+      # `igneous fleet devices` rolls up into compile-seconds-saved
+      self.compile_cache = dict(  # guarded-by: self.lock
+        hits=0, misses=0, puts=0, corrupt=0, saved_s=0.0, fetch_s=0.0,
+      )
       # padding-byte accounting across every batched dispatch (pow2
       # batch rounding, page-pool filler slots, infer group fill)
       self.pad_bytes = 0  # guarded-by: self.lock
@@ -116,24 +122,54 @@ class DeviceLedger:
       k = self.kernels[name] = {
         "compiles": 0, "compile_s": 0.0,
         "executes": 0, "execute_s": 0.0,
-        "elements": 0, "bytes": 0,
+        "elements": 0, "bytes": 0, "cache_hits": 0,
       }
     return k
 
   # -- write side -----------------------------------------------------------
 
-  def note_signature(self, kernel: str, signature) -> bool:
+  def note_signature(self, kernel: str, signature,
+                     cached: bool = False) -> bool:
     """True exactly once per (kernel, signature): the recompile tick.
     Counter contract (ISSUE 7 acceptance): ``device.recompiles``
-    increments ONLY when a shape/dtype signature is first compiled."""
+    increments ONLY when a shape/dtype signature is first compiled.
+
+    ``cached=True`` marks a persistent compile-cache hit (ISSUE 19): the
+    signature still enters the seen-set, but ``device.recompiles`` does
+    NOT tick — a warm-started fleet fetched the executable instead of
+    compiling, and must not trip the recompile-storm anomaly or skew
+    ``igneous_device_fastpath_ratio`` baselines."""
     key = (kernel, repr(signature))
     with self.lock:
       if key in self._signatures:
         return False
       self._signatures.add(key)
-      self.recompiles += 1
-    metrics.incr("device.recompiles")
+      if not cached:
+        self.recompiles += 1
+    if not cached:
+      metrics.incr("device.recompiles")
     return True
+
+  _CACHE_COUNTER = {"hits": "hit", "misses": "miss",
+                    "puts": "put", "corrupt": "corrupt"}
+
+  def record_cache_event(self, event: str, kernel: str = "",
+                         saved_s: float = 0.0,
+                         fetch_s: float = 0.0) -> None:
+    """Persistent compile-cache accounting (ISSUE 19): ``event`` is one
+    of hits|misses|puts|corrupt. ``saved_s`` is the producer-measured
+    compile time a hit avoided; ``fetch_s`` the deserialize+download
+    cost actually paid instead."""
+    with self.lock:
+      cc = self.compile_cache
+      cc[event] += 1
+      cc["saved_s"] += float(saved_s)
+      cc["fetch_s"] += float(fetch_s)
+      if kernel and event == "hits":
+        k = self._kernel_locked(kernel)
+        k["cache_hits"] = k.get("cache_hits", 0) + 1
+      self._dirty = True
+    metrics.incr(f"device.compile_cache.{self._CACHE_COUNTER[event]}")
 
   def record_compile(self, kernel: str, seconds: float) -> None:
     with self.lock:
@@ -302,6 +338,10 @@ class DeviceLedger:
           dev: round(s, 4) for dev, s in sorted(self.device_busy.items())
         },
         "fastpath": dict(self.fastpath),
+        "compile_cache": {
+          k: (round(v, 4) if isinstance(v, float) else v)
+          for k, v in self.compile_cache.items()
+        },
         "pad_bytes": self.pad_bytes,
         "real_bytes": self.real_bytes,
         "pad_waste_ratio": (
@@ -776,7 +816,29 @@ def render_devices(ledgers: Dict[str, dict]) -> List[str]:
       f"pad waste: {_fmt_bytes(pad)} padding over {_fmt_bytes(real)} real "
       f"bytes ({pad / real:.1%})"
     )
+  cc = _cache_rollup(ledgers)
+  if cc["hits"] or cc["misses"] or cc["puts"] or cc["corrupt"]:
+    lines.append(
+      f"compile cache: {cc['hits']} hits / {cc['misses']} misses, "
+      f"{cc['puts']} puts, {cc['corrupt']} corrupt — "
+      f"{cc['saved_s']:.1f}s compile time saved fleet-wide "
+      f"({cc['fetch_s']:.1f}s spent fetching)"
+    )
   return lines
+
+
+def _cache_rollup(ledgers: Dict[str, dict]) -> dict:
+  """Summed persistent compile-cache stats across every worker's latest
+  ledger record — the fleet-wide compile-seconds-saved number."""
+  cc = {"hits": 0, "misses": 0, "puts": 0, "corrupt": 0,
+        "saved_s": 0.0, "fetch_s": 0.0}
+  for rec in ledgers.values():
+    src = rec.get("compile_cache") or {}
+    for key in cc:
+      cc[key] += type(cc[key])(src.get(key, 0) or 0)
+  cc["saved_s"] = round(cc["saved_s"], 4)
+  cc["fetch_s"] = round(cc["fetch_s"], 4)
+  return cc
 
 
 def fleet_summary(ledgers: Dict[str, dict]) -> Optional[dict]:
@@ -812,6 +874,7 @@ def fleet_summary(ledgers: Dict[str, dict]) -> Optional[dict]:
     "recompiles": sum(r.get("recompiles", 0) for r in ledgers.values()),
     "hbm_peak_frac": round(hbm_frac, 4) if hbm_frac is not None else None,
     "fastpath": fp,
+    "compile_cache": _cache_rollup(ledgers),
   }
 
 

@@ -208,9 +208,10 @@ def _tile_shape() -> Tuple[int, int, int]:
   fill the (8, 128) sublane/lane register shape instead: (8,16,128) is
   64KB per int32 working array, ~5 arrays ≈ 320KB of the ~16MB VMEM, so
   a tile's whole round loop runs on-chip with room to double-buffer."""
-  import os
+  from .. import tune
 
-  spec = knobs.get_str("IGNEOUS_CCL_TILE")
+  # explicit env > tuned/<device_kind>.json > backend default (ISSUE 19)
+  spec = tune.resolve("IGNEOUS_CCL_TILE")
   if not spec:
     return (
       _DEFAULT_TILE_TPU if jax.default_backend() == "tpu"
@@ -607,6 +608,7 @@ def _batch_executor(connectivity: int, mesh=None):
       ),
       mesh=mesh,
       name=f"ccl.tiled[{algo}]",
+      cache_variant=("ccl_tiled", connectivity, algo, tile, engine),
     )
   return _BATCH_EXECUTORS[key]
 

@@ -201,11 +201,32 @@ def _envelope_pass(val: jnp.ndarray, lab: jnp.ndarray, w: float) -> jnp.ndarray:
 # block's stacks cache-resident — the same total copy volume moves at
 # L2/L3 speed instead. Per-line independence makes any blocking bitwise
 # identical (the numpy twin blocks the same way via _NP_LINE_BATCH).
-_LINE_BLOCK = 256
+# The block size is a tunable (ISSUE 19): IGNEOUS_EDT_LINE_BLOCK >
+# tuned/<device_kind>.json > this default.
+_DEFAULT_LINE_BLOCK = 256
+
+
+def _line_block() -> int:
+  """Lines per envelope block, via the tuned-knob resolution order."""
+  from .. import tune
+
+  spec = tune.resolve("IGNEOUS_EDT_LINE_BLOCK")
+  if not spec:
+    return _DEFAULT_LINE_BLOCK
+  try:
+    lb = int(spec)
+  except ValueError:
+    lb = 0
+  if lb < 1:
+    raise ValueError(
+      f"IGNEOUS_EDT_LINE_BLOCK must be a positive int: {spec!r}"
+    )
+  return lb
 
 
 def _axis_pass(
-  val: jnp.ndarray, lab: jnp.ndarray, w: float, first: bool
+  val: jnp.ndarray, lab: jnp.ndarray, w: float, first: bool,
+  line_block: int = _DEFAULT_LINE_BLOCK,
 ) -> jnp.ndarray:
   """One pass along the LAST axis. val, lab: (..., n)."""
   n = val.shape[-1]
@@ -217,7 +238,7 @@ def _axis_pass(
   if not first:
     # the first pass starts from val=INF everywhere, so the same-run
     # envelope could only produce INF — the edge term alone is the answer
-    lb = min(_LINE_BLOCK, B)
+    lb = min(int(line_block), B)
     pad = (-B) % lb
     if pad:
       # padded lines are all-background (label 0, val INF): the envelope
@@ -232,9 +253,10 @@ def _axis_pass(
   return out.reshape(*lead, n)
 
 
-@partial(jax.jit, static_argnames=("anisotropy",))
+@partial(jax.jit, static_argnames=("anisotropy", "line_block"))
 def _edt_sq_kernel(
-  labels: jnp.ndarray, anisotropy: Tuple[float, float, float]
+  labels: jnp.ndarray, anisotropy: Tuple[float, float, float],
+  line_block: int = _DEFAULT_LINE_BLOCK,
 ):
   """labels (z, y, x) int32 → squared EDT float32; three passes.
 
@@ -243,7 +265,8 @@ def _edt_sq_kernel(
   pairs per pass collapsed: x in (z,y,x), y in (z,x,y), z in (y,x,z) —
   two label transposes and three value transposes total instead of six).
   Values are identical under any layout walk; the envelope itself runs
-  blocked over _LINE_BLOCK-line chunks (see above)."""
+  blocked over ``line_block``-line chunks (see above — static arg so the
+  autotuner can sweep the geometry; any value is bitwise identical)."""
   wx, wy, wz = anisotropy
 
   # pass along x, native (z, y, x) layout
@@ -252,10 +275,12 @@ def _edt_sq_kernel(
   )
   # (z, y, x) -> (z, x, y): pass along y
   lab_y = jnp.swapaxes(labels, 1, 2)
-  val = _axis_pass(jnp.swapaxes(val, 1, 2), lab_y, wy, first=False)
+  val = _axis_pass(jnp.swapaxes(val, 1, 2), lab_y, wy, first=False,
+                   line_block=line_block)
   # (z, x, y) -> (y, x, z): pass along z
   lab_z = jnp.transpose(lab_y, (2, 1, 0))
-  val = _axis_pass(jnp.transpose(val, (2, 1, 0)), lab_z, wz, first=False)
+  val = _axis_pass(jnp.transpose(val, (2, 1, 0)), lab_z, wz, first=False,
+                   line_block=line_block)
   # (y, x, z) -> (z, y, x)
   val = jnp.transpose(val, (2, 0, 1))
 
@@ -471,15 +496,18 @@ def batch_edt_executor(anisotropy, mesh=None):
     None if mesh is None
     else (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
   )
-  key = (wx, wy, wz, mesh_key)
+  lb = _line_block()
+  key = (wx, wy, wz, lb, mesh_key)
   if key not in _BATCH_EXECUTORS:
     from functools import partial as _partial
 
     from ..parallel.executor import BatchKernelExecutor
 
     _BATCH_EXECUTORS[key] = BatchKernelExecutor(
-      _partial(_edt_sq_kernel, anisotropy=(wx, wy, wz)), mesh=mesh,
+      _partial(_edt_sq_kernel, anisotropy=(wx, wy, wz), line_block=lb),
+      mesh=mesh,
       name="edt.sq_blocked",
+      cache_variant=("edt", wx, wy, wz, lb),
     )
   return _BATCH_EXECUTORS[key]
 
@@ -566,7 +594,9 @@ def edt(
 
     lab32 = _dense_relabel(work)
     dev = jnp.asarray(np.ascontiguousarray(lab32.transpose(2, 1, 0)))
-    sq = np.asarray(_edt_sq_kernel(dev, (wx, wy, wz))).transpose(2, 1, 0)
+    sq = np.asarray(
+      _edt_sq_kernel(dev, (wx, wy, wz), line_block=_line_block())
+    ).transpose(2, 1, 0)
   if black_border:
     sq = sq[1:-1, 1:-1, 1:-1]
   out = np.sqrt(sq, dtype=np.float32)

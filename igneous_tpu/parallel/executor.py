@@ -9,6 +9,7 @@ scalar statistics (voxel counts, histograms) without host round-trips.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -17,6 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import compile_cache
+from ..analysis import knobs
 from ..observability import device as device_telemetry
 from ..ops.pooling import _pyramid_impl
 
@@ -34,6 +37,48 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "chunks") -> Mesh:
   if n_devices is not None:
     devices = devices[:n_devices]
   return Mesh(np.asarray(devices), (axis,))
+
+
+class LRUCache:
+  """Bounded mapping for per-process compiled-executable caches
+  (ISSUE 19 satellite): a long-lived worker that drifts through many
+  signatures must not hold every executable it ever compiled. Cap from
+  ``IGNEOUS_EXECUTOR_CACHE_CAP``; least-recently-USED eviction (both
+  lookup and insert refresh recency). Eviction is safe — a re-needed
+  signature recompiles (or refetches from the persistent cache) without
+  a fresh ``device.recompiles`` tick, since the ledger seen-set is
+  independent of this cache."""
+
+  def __init__(self, cap: Optional[int] = None):
+    if cap is None:
+      cap = knobs.get_int("IGNEOUS_EXECUTOR_CACHE_CAP")
+    self.cap = max(int(cap or 64), 1)
+    self._d: OrderedDict = OrderedDict()
+
+  def __contains__(self, key) -> bool:
+    return key in self._d
+
+  def __len__(self) -> int:
+    return len(self._d)
+
+  def __getitem__(self, key):
+    val = self._d[key]
+    self._d.move_to_end(key)
+    return val
+
+  def get(self, key, default=None):
+    if key not in self._d:
+      return default
+    return self[key]
+
+  def __setitem__(self, key, val) -> None:
+    self._d[key] = val
+    self._d.move_to_end(key)
+    while len(self._d) > self.cap:
+      self._d.popitem(last=False)
+
+  def keys(self):
+    return self._d.keys()
 
 
 _CHUNK_EXECUTOR_CACHE = {}
@@ -86,13 +131,20 @@ class BatchKernelExecutor:
   """
 
   def __init__(self, kernel, mesh: Optional[Mesh] = None,
-               name: Optional[str] = None):
+               name: Optional[str] = None, cache_variant=None):
+    """``cache_variant`` (ISSUE 19): a stable tuple of the kernel's
+    closure configuration (factors, tile, anisotropy, model spec…) that
+    the name+signature alone cannot capture. Declaring it opts this
+    executor into the persistent compile cache; None keeps the site
+    compile-only — two differently-configured kernels sharing a name
+    must never exchange executables."""
     self.kernel = kernel
     self.name = name or getattr(kernel, "__name__", "kernel").lstrip("_")
     self.mesh = mesh if mesh is not None else make_mesh()
     self.axis = self.mesh.axis_names[0]
-    self._cache = {}
-    self._consts_cache = {}
+    self.cache_variant = cache_variant
+    self._cache = LRUCache()
+    self._consts_cache = LRUCache()
 
   @property
   def n_devices(self) -> int:
@@ -198,14 +250,14 @@ class BatchKernelExecutor:
       # device.compile vs device.execute split (ISSUE 7): AOT
       # lower+compile so the compile span measures XLA work alone —
       # jit's lazy first-call compile would fold it into the first
-      # execute and poison the utilization ledger
-      device_telemetry.LEDGER.note_signature(self.name, sig)
-      with device_telemetry.compile_span(
-        self.name, device_telemetry._devices_of(self.mesh)
-      ):
-        self._cache[sig] = (
-          self._build(batch, consts).lower(*argv).compile()
-        )
+      # execute and poison the utilization ledger. load_or_compile
+      # (ISSUE 19) consults the persistent cache first when one is
+      # configured and this executor declared its cache_variant.
+      self._cache[sig] = compile_cache.load_or_compile(
+        self.name, sig, self.mesh,
+        lambda: self._build(batch, consts).lower(*argv).compile(),
+        variant=self.cache_variant,
+      )
     with device_telemetry.execute_span(
       self.name, elements=device_telemetry.elements_of(batch),
       nbytes=device_telemetry.nbytes_of(batch), mesh=self.mesh,
@@ -253,7 +305,14 @@ class ChunkExecutor:
     # ({"mip_from": m, "mip_to": m + len(factors)}) here before each run
     self.span_attrs: dict = {}
     self._fn = self._build()
-    self._compiled = {}  # input signature -> AOT executable (ISSUE 7)
+    # input signature -> AOT executable (ISSUE 7); LRU-bounded (ISSUE 19)
+    self._compiled = LRUCache()
+    # persistent-cache key component: the pyramid configuration this
+    # closure bakes in (name+signature alone cannot distinguish two
+    # factor chains of equal shapes)
+    self.cache_variant = (
+      "pyramid", self.factors, method, bool(sparse), self.planes
+    )
 
   def _build(self):
     factors, method, sparse = self.factors, self.method, self.sparse
@@ -309,10 +368,35 @@ class ChunkExecutor:
     )
     if len(arrs) != self.planes:
       raise ValueError(f"expected {self.planes} plane(s), got {len(arrs)}")
-    # multihost path keeps the plain jit (AOT executables and global
-    # arrays interact badly across versions); first-call-per-signature
+    sig = ("global",) + tuple((a.shape, str(a.dtype)) for a in arrs)
+    # the persistent cache (ISSUE 19) prefers the AOT route so a warm
+    # worker skips the compile entirely; any failure (AOT executables
+    # and global arrays interact badly across some versions) falls
+    # through to the plain-jit path below, which stays the default when
+    # no cache is configured
+    if compile_cache.get_active() is not None:
+      compiled = self._compiled.get(sig)
+      try:
+        if compiled is None:
+          compiled = compile_cache.load_or_compile(
+            self.name, sig, self.mesh,
+            lambda: self._fn.lower(tuple(arrs)).compile(),
+            variant=self.cache_variant + ("global",),
+          )
+          self._compiled[sig] = compiled
+        with device_telemetry.execute_span(
+          self.name, elements=device_telemetry.elements_of(arrs),
+          mesh=self.mesh, **self.span_attrs,
+        ):
+          out = compiled(tuple(arrs))
+          jax.block_until_ready(out)
+        return out
+      except Exception:
+        from ..observability import metrics
+
+        metrics.incr("device.compile_cache.error")
+    # multihost default keeps the plain jit; first-call-per-signature
     # still ticks the recompile ledger and labels as compile
-    sig = tuple((a.shape, str(a.dtype)) for a in arrs)
     fresh = device_telemetry.LEDGER.note_signature(self.name, sig)
     span = (
       device_telemetry.compile_span(
@@ -353,11 +437,11 @@ class ChunkExecutor:
       xs = tuple(jax.device_put(p, sharding) for p in padded)
     sig = tuple((a.shape, str(a.dtype)) for a in xs)
     if sig not in self._compiled:
-      device_telemetry.LEDGER.note_signature(self.name, sig)
-      with device_telemetry.compile_span(
-        self.name, device_telemetry._devices_of(self.mesh)
-      ):
-        self._compiled[sig] = self._fn.lower(xs).compile()
+      self._compiled[sig] = compile_cache.load_or_compile(
+        self.name, sig, self.mesh,
+        lambda: self._fn.lower(xs).compile(),
+        variant=self.cache_variant,
+      )
     with device_telemetry.execute_span(
       self.name, elements=sum(int(p.size) for p in padded),
       nbytes=sum(int(p.nbytes) for p in padded), mesh=self.mesh,

@@ -66,8 +66,9 @@ from ..ops.pooling import (
   _split_u64_planes,
   _to_device_layout,
 )
-from .executor import BatchKernelExecutor, _shard_map, make_mesh
+from .executor import BatchKernelExecutor, LRUCache, _shard_map, make_mesh
 
+from .. import tune
 from ..analysis import knobs
 
 _DEFAULT_PAGE = (32, 32, 32)
@@ -86,7 +87,8 @@ def page_shape() -> Tuple[int, int, int]:
   The default 32^3 divides evenly by every standard mip factor chain up
   to 5 halvings and by both CCL tile defaults, so all three paged kernels
   share one page geometry."""
-  raw = knobs.raw("IGNEOUS_PAGE_SHAPE") or ""
+  # explicit env > tuned/<device_kind>.json > registry default (ISSUE 19)
+  raw = tune.resolve("IGNEOUS_PAGE_SHAPE") or ""
   if not raw:
     return _DEFAULT_PAGE
   parts = tuple(int(v) for v in raw.replace(" ", "").split(","))
@@ -102,7 +104,7 @@ def page_round_cap(n_devices: int) -> int:
   (zero filler pages, extent 0), so the compiled signature is
   round-count-independent. Pow2 multiple of the device count so the
   executor's own canonical-K rounding is a no-op."""
-  want = int(knobs.raw("IGNEOUS_PAGE_BATCH")
+  want = int(tune.resolve("IGNEOUS_PAGE_BATCH")
              or knobs.KNOBS["IGNEOUS_PAGE_BATCH"].default)
   if want <= 0:
     raise ValueError("IGNEOUS_PAGE_BATCH must be positive")
@@ -213,6 +215,9 @@ def paged_pyramid_executor(
       _make_page_kernel(factors, method, sparse, planes),
       mesh=mesh,
       name=f"pooling.paged_pyramid[{method}]",
+      cache_variant=(
+        "paged_pyramid", factors, method, bool(sparse), int(planes)
+      ),
     )
   return _PAGED_EXECUTORS[key]
 
@@ -469,6 +474,7 @@ def _paged_ccl_executor(connectivity: int, mesh=None):
       ),
       mesh=mesh,
       name=f"ccl.paged[{algo}]",
+      cache_variant=("ccl_paged", connectivity, algo, tile, engine),
     )
   return _PAGED_CCL_EXECUTORS[key]
 
@@ -579,15 +585,17 @@ _PAGED_EDT_EXECUTORS = {}
 
 
 def _paged_edt_executor(anisotropy, mesh=None):
-  from ..ops.edt import _edt_sq_kernel
+  from ..ops.edt import _edt_sq_kernel, _line_block
 
   wx, wy, wz = (float(a) for a in anisotropy)
-  key = (wx, wy, wz, _mesh_key(mesh))
+  lb = _line_block()
+  key = (wx, wy, wz, lb, _mesh_key(mesh))
   if key not in _PAGED_EDT_EXECUTORS:
     _PAGED_EDT_EXECUTORS[key] = BatchKernelExecutor(
-      partial(_edt_sq_kernel, anisotropy=(wx, wy, wz)),
+      partial(_edt_sq_kernel, anisotropy=(wx, wy, wz), line_block=lb),
       mesh=mesh,
       name="edt.sq_paged",
+      cache_variant=("edt_paged", wx, wy, wz, lb),
     )
   return _PAGED_EDT_EXECUTORS[key]
 
@@ -669,34 +677,70 @@ class PagedGlobalRunner:
     self._kernel = _make_page_kernel(
       self.factors, method, sparse, self.planes
     )
-    self._fns = {}
+    self.cache_variant = (
+      "paged_global", self.factors, method, bool(sparse), self.planes
+    )
+    self._fns = LRUCache()
+    self._aot = LRUCache()
+
+  def _make(self, tree):
+    """The shard_map'd jit closure for one input structure."""
+    batched = jax.vmap(self._kernel)
+    out_shape = jax.eval_shape(
+      batched,
+      jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+      ),
+    )
+    out_specs = jax.tree.map(lambda _: P(self.axis), out_shape)
+    try:
+      fn = _shard_map(
+        batched, mesh=self.mesh, in_specs=(P(self.axis),),
+        out_specs=out_specs, check_vma=False,
+      )
+    except TypeError:  # older jax: the parameter was named check_rep
+      fn = _shard_map(
+        batched, mesh=self.mesh, in_specs=(P(self.axis),),
+        out_specs=out_specs, check_rep=False,
+      )
+    # lint: allow=IGN201 AOT lower+compile cached by signature at call site
+    return jax.jit(fn)
 
   def __call__(self, pages, exts):
     """pages: global (K, c, pz, py, px) jax.Array (or a (lo, hi) tuple,
     planes=2); exts: global (K, 3) int32. Returns per-mip global arrays."""
+    from .. import compile_cache
+
     tree = (pages, exts)
     leaves = jax.tree.leaves(tree)
     sig = tuple((tuple(a.shape), str(a.dtype)) for a in leaves)
-    if sig not in self._fns:
-      batched = jax.vmap(self._kernel)
-      out_shape = jax.eval_shape(
-        batched,
-        jax.tree.map(
-          lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
-        ),
-      )
-      out_specs = jax.tree.map(lambda _: P(self.axis), out_shape)
+    # persistent cache path (ISSUE 19): a warm worker fetches the AOT
+    # executable instead of compiling; any failure falls through to the
+    # plain-jit path below (the default when no cache is configured)
+    if compile_cache.get_active() is not None:
+      compiled = self._aot.get(sig)
       try:
-        fn = _shard_map(
-          batched, mesh=self.mesh, in_specs=(P(self.axis),),
-          out_specs=out_specs, check_vma=False,
-        )
-      except TypeError:  # older jax: the parameter was named check_rep
-        fn = _shard_map(
-          batched, mesh=self.mesh, in_specs=(P(self.axis),),
-          out_specs=out_specs, check_rep=False,
-        )
-      self._fns[sig] = jax.jit(fn)
+        if compiled is None:
+          compiled = compile_cache.load_or_compile(
+            self.name, sig, self.mesh,
+            lambda: self._make(tree).lower(tree).compile(),
+            variant=self.cache_variant,
+          )
+          self._aot[sig] = compiled
+        with device_telemetry.execute_span(
+          self.name,
+          elements=sum(int(np.prod(a.shape)) for a in leaves),
+          mesh=self.mesh,
+        ):
+          out = compiled(tree)
+          jax.block_until_ready(out)
+        return out
+      except Exception:
+        from ..observability import metrics
+
+        metrics.incr("device.compile_cache.error")
+    if sig not in self._fns:
+      self._fns[sig] = self._make(tree)
     fresh = device_telemetry.LEDGER.note_signature(self.name, sig)
     span = (
       device_telemetry.compile_span(

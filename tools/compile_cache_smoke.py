@@ -1,0 +1,242 @@
+"""Fleet compile cache warm-start smoke (ISSUE 19 CI step).
+
+Two FRESH worker subprocesses share one ``file://`` compile cache and
+run the same ragged (paged) downsample campaign on identical seeded
+volumes. Worker 1 pays the XLA compiles and publishes executables;
+worker 2 must warm-start:
+
+  * >= 1 ``device.compile_cache.hit`` span per paged kernel in worker
+    2's journal;
+  * ZERO ``device.compile`` spans in worker 2's journal for any
+    (kernel, signature) worker 1 published — asserted against the
+    cache's own ``executables/<kernel>/`` listing;
+  * zero ``device.recompiles`` in worker 2's ledger for those shared
+    kernels (the hit enters the seen-set without a recompile tick);
+  * the two campaigns' stored chunks are byte-identical;
+  * ``igneous fleet devices`` exits 0 and reports the fleet-wide
+    compile-seconds-saved rollup.
+
+Writes the headline numbers to --report-out (CI artifact).
+
+Usage: python tools/compile_cache_smoke.py [--size 250]
+       [--report-out compile-cache-report.json]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PAGED_KERNEL_PREFIXES = ("pooling.paged_pyramid[",)
+
+
+def worker_env(cache_root):
+  env = dict(os.environ)
+  env.update({
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "IGNEOUS_POOL_HOST": "0",        # device pyramid, not native host pool
+    "IGNEOUS_PIPELINE": "1",
+    "IGNEOUS_PIPELINE_THREADS": "1",
+    "IGNEOUS_JOURNAL_FLUSH_SEC": "2",
+    "IGNEOUS_TRACE_SAMPLE": "1",
+    "IGNEOUS_COMPILE_CACHE": cache_root,
+  })
+  env.pop("AXON_POOL_SVC_OVERRIDE", None)
+  env.pop("AXON_LOOPBACK_RELAY", None)
+  return env
+
+
+def seed_campaign(tmp, tag, data):
+  """One volume + one queue of downsample tasks; returns (qspec, jpath,
+  volume dir)."""
+  from igneous_tpu import task_creation as tc
+  from igneous_tpu.queues import FileQueue
+  from igneous_tpu.volume import Volume
+
+  path = f"file://{tmp}/img-{tag}"
+  Volume.from_numpy(data, path, chunk_size=(32, 32, 32),
+                    layer_type="image")
+  tasks = list(tc.create_downsampling_tasks(
+    path, mip=0, num_mips=1, memory_target=2 * 1024 * 1024
+  ))
+  assert len(tasks) >= 4, f"want a few tasks, got {len(tasks)}"
+  qdir = f"{tmp}/q-{tag}"
+  FileQueue(f"fq://{qdir}").insert(tasks)
+  return f"fq://{qdir}", f"file://{qdir}/journal", f"{tmp}/img-{tag}"
+
+
+def run_worker(qspec, env):
+  proc = subprocess.run(
+    [sys.executable, "-m", "igneous_tpu", "execute", qspec,
+     "--batch", "4", "--exit-on-empty", "--min-sec", "10", "-q",
+     "--lease-sec", "60"],
+    env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+  )
+  sys.stdout.write(proc.stdout)
+  sys.stderr.write(proc.stderr)
+  assert proc.returncode == 0, f"worker failed rc={proc.returncode}"
+
+
+def journal_view(jpath):
+  """(compile span kernels->count, cache-hit span kernels->count,
+  merged ledger dict) for one worker's journal."""
+  from igneous_tpu.observability import device as device_mod
+  from igneous_tpu.observability import fleet
+
+  records = fleet.load(jpath)
+  spans = [r for r in records if r.get("kind") == "span"]
+  compiles, hits = {}, {}
+  for s in spans:
+    k = s.get("kernel")
+    if s.get("name") == "device.compile":
+      compiles[k] = compiles.get(k, 0) + 1
+    elif s.get("name") == "device.compile_cache.hit":
+      hits[k] = hits.get(k, 0) + 1
+  ledgers = device_mod.device_ledgers(records)
+  assert ledgers, f"no device ledger records in {jpath}"
+  return compiles, hits, next(iter(ledgers.values()))
+
+
+def volume_digests(vol_dir):
+  """rel-path -> content digest for every stored chunk (provenance and
+  the integrity manifests carry timestamps/worker ids and are excluded —
+  the audit plane has its own tests)."""
+  out = {}
+  for root, _dirs, files in os.walk(vol_dir):
+    for fn in files:
+      if "provenance" in fn:
+        continue
+      full = os.path.join(root, fn)
+      rel = os.path.relpath(full, vol_dir)
+      if rel.startswith("integrity"):
+        continue
+      with open(full, "rb") as f:
+        out[rel] = hashlib.blake2b(f.read(), digest_size=16).hexdigest()
+  return out
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--size", type=int, default=250)
+  ap.add_argument("--report-out", default=None)
+  args = ap.parse_args()
+
+  tmp = tempfile.mkdtemp(prefix="igneous-compile-cache-smoke-")
+  cache_root = f"file://{tmp}/compile-cache"
+
+  from igneous_tpu import compile_cache as cc
+
+  # odd-size layer: the task grid clamps at the edges, so ragged cells
+  # ride the paged pyramid — the kernels the warm-start must cover
+  rng = np.random.default_rng(19)
+  n = args.size
+  data = rng.integers(0, 255, (n, n, 64)).astype(np.uint8)
+
+  q1, j1, vol1 = seed_campaign(tmp, "w1", data)
+  q2, j2, vol2 = seed_campaign(tmp, "w2", data)
+  env = worker_env(cache_root)
+
+  run_worker(q1, env)
+  compiles1, hits1, ledger1 = journal_view(j1)
+  assert compiles1, "worker 1 journal has no device.compile spans"
+  cc1 = ledger1.get("compile_cache") or {}
+  assert cc1.get("puts", 0) >= 1, (
+    f"worker 1 published nothing to the cache: {cc1}"
+  )
+
+  # the cache's own listing is the shared-signature ground truth:
+  # executables/<kernel>/<digest>.bin, kernel names sanitize-stable
+  exe_dir = os.path.join(tmp, "compile-cache", cc.ENTRY_PREFIX.rstrip("/"))
+  shared_kernels = sorted(os.listdir(exe_dir))
+  assert shared_kernels, "no executables published under the cache root"
+  paged_shared = [
+    k for k in shared_kernels
+    if any(k.startswith(p) for p in PAGED_KERNEL_PREFIXES)
+  ]
+  assert paged_shared, (
+    f"no paged kernels in the shared cache (saw {shared_kernels})"
+  )
+  print(f"worker 1: compiled {sorted(compiles1)}, "
+        f"published {shared_kernels} ({cc1.get('puts')} puts)")
+
+  run_worker(q2, env)
+  compiles2, hits2, ledger2 = journal_view(j2)
+  cc2 = ledger2.get("compile_cache") or {}
+
+  # warm start: worker 2 never XLA-compiles a published signature
+  overlap = sorted(set(compiles2) & set(shared_kernels))
+  assert not overlap, (
+    f"worker 2 recompiled shared kernels {overlap} — "
+    f"compile spans {compiles2}"
+  )
+  for k in paged_shared:
+    assert hits2.get(k, 0) >= 1, (
+      f"no device.compile_cache.hit span for paged kernel {k} "
+      f"in worker 2's journal (hits: {hits2})"
+    )
+  for k, stats in ledger2.get("kernels", {}).items():
+    if k in shared_kernels:
+      assert stats.get("compiles", 0) == 0, (
+        f"worker 2 ledger shows {stats['compiles']} recompiles for "
+        f"shared kernel {k}"
+      )
+      assert stats.get("cache_hits", 0) >= 1, (k, stats)
+  assert cc2.get("hits", 0) >= len(paged_shared), cc2
+  print(f"worker 2: {cc2.get('hits')} cache hits, "
+        f"{cc2.get('saved_s')}s compile time saved, "
+        f"zero recompiles for {shared_kernels}")
+
+  # identical campaign, identical bytes — warm executables must not
+  # change a single stored chunk
+  d1, d2 = volume_digests(vol1), volume_digests(vol2)
+  assert d1 and d1.keys() == d2.keys(), (
+    f"chunk sets differ: {sorted(set(d1) ^ set(d2))[:8]}"
+  )
+  diff = [k for k in d1 if d1[k] != d2[k]]
+  assert not diff, f"{len(diff)} chunks differ, e.g. {diff[:8]}"
+  print(f"byte-identity: {len(d1)} stored objects identical")
+
+  # fleet rollup: the merged view must surface compile-seconds-saved
+  proc = subprocess.run(
+    [sys.executable, "-m", "igneous_tpu", "fleet", "devices",
+     "--journal", j2],
+    env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+  )
+  sys.stdout.write(proc.stdout)
+  assert proc.returncode == 0, (
+    f"igneous fleet devices exited {proc.returncode}: {proc.stderr}"
+  )
+  assert "compile cache" in proc.stdout, proc.stdout
+
+  report = {
+    "shared_kernels": shared_kernels,
+    "paged_kernels": paged_shared,
+    "worker1_compile_spans": compiles1,
+    "worker1_cache": cc1,
+    "worker2_compile_spans": compiles2,
+    "worker2_hit_spans": hits2,
+    "worker2_cache": cc2,
+    "compile_seconds_saved": cc2.get("saved_s"),
+    "stored_objects_compared": len(d1),
+    "byte_identical": True,
+  }
+  if args.report_out:
+    with open(args.report_out, "w") as f:
+      json.dump(report, f, indent=2, sort_keys=True)
+    print(f"report written to {args.report_out}")
+
+  print("COMPILE_CACHE_SMOKE_OK")
+
+
+if __name__ == "__main__":
+  main()
